@@ -1,0 +1,76 @@
+"""paddle_tpu: a TPU-native deep-learning framework with PaddlePaddle's
+capability surface (see SURVEY.md), built on JAX/XLA/pjit/Pallas.
+
+Public API layout mirrors paddle's: ``paddle_tpu.nn``, ``paddle_tpu.tensor``
+(flattened into the root namespace like ``paddle.*``), ``paddle_tpu.optimizer``,
+``paddle_tpu.distributed`` (fleet), ``paddle_tpu.amp``, ``paddle_tpu.io``,
+``paddle_tpu.jit``, ``paddle_tpu.static``.
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from .framework import (
+    Tensor,
+    backward,
+    convert_dtype,
+    enable_grad,
+    get_default_dtype,
+    get_device,
+    get_flags,
+    is_compiled_with_tpu,
+    is_grad_enabled,
+    no_grad,
+    seed,
+    set_default_dtype,
+    set_device,
+    set_flags,
+    set_grad_enabled,
+)
+from .tensor import *  # noqa: F401,F403 — paddle flattens tensor ops into root
+from .tensor import to_tensor  # noqa: F401
+
+from . import tensor  # noqa: F401
+
+# subpackages are imported lazily-ish at the bottom so circular deps stay sane
+from . import nn  # noqa: F401,E402
+from . import optimizer  # noqa: F401,E402
+from . import amp  # noqa: F401,E402
+from . import io  # noqa: F401,E402
+from . import jit  # noqa: F401,E402
+from . import distributed  # noqa: F401,E402
+from . import metric  # noqa: F401,E402
+from . import profiler  # noqa: F401,E402
+from . import vision  # noqa: F401,E402
+from .framework.io import load, save  # noqa: E402
+from .hapi.model import Model  # noqa: E402
+from .nn.layer.container import ParameterList  # noqa: E402
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=False, create_graph=False, allow_unused=False):
+    """paddle.grad parity (eager): returns grads of outputs w.r.t. inputs."""
+    from .framework import autograd as _ag
+
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    for t in inputs:
+        t.grad = None
+    _ag.backward(outputs, grad_outputs, retain_graph=retain_graph)
+    grads = []
+    for t in inputs:
+        if t.grad is None and not allow_unused:
+            raise ValueError("input tensor unused in graph; pass allow_unused=True")
+        grads.append(t.grad)
+        t.grad = None
+    return grads
+
+
+def ones_like_(x):  # pragma: no cover - paddle private compat
+    from .tensor.creation import ones_like
+
+    return ones_like(x)
+
+
+def device_count() -> int:
+    import jax
+
+    return jax.device_count()

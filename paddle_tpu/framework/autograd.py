@@ -1,0 +1,205 @@
+"""Eager autograd engine.
+
+Paddle parity: the eager autograd graph of ``GradNodeBase`` +
+``egr::Backward`` (reference: paddle/fluid/eager/grad_node_info.h:161,
+paddle/fluid/eager/backward.cc:825). TPU-first design: instead of per-op
+hand-written grad kernels, every recorded primitive stores the ``jax.vjp``
+closure of its forward function — XLA differentiates the op, the tape only
+does graph bookkeeping. Under ``jax.jit`` the tape is bypassed entirely
+(grads come from ``jax.grad`` over the functional step), so the tape is the
+debug/eager path, exactly like dygraph vs static in the reference.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import List, Optional, Sequence
+
+
+class _GradMode(threading.local):
+    enabled = True
+
+
+_MODE = _GradMode()
+
+
+def is_grad_enabled() -> bool:
+    return _MODE.enabled
+
+
+def set_grad_enabled(mode: bool):
+    _MODE.enabled = bool(mode)
+
+
+class no_grad(contextlib.ContextDecorator):
+    """Context manager / decorator disabling tape recording (paddle.no_grad)."""
+
+    def __enter__(self):
+        self._prev = _MODE.enabled
+        _MODE.enabled = False
+        return self
+
+    def __exit__(self, *exc):
+        _MODE.enabled = self._prev
+        return False
+
+
+class enable_grad(contextlib.ContextDecorator):
+    def __enter__(self):
+        self._prev = _MODE.enabled
+        _MODE.enabled = True
+        return self
+
+    def __exit__(self, *exc):
+        _MODE.enabled = self._prev
+        return False
+
+
+class TapeNode:
+    """One recorded primitive: vjp closure + references to the input tensors.
+
+    Mirrors ``GradNodeBase`` (grad_node_info.h:161): ``in_tensors`` are the
+    forward inputs that require grad (the node's "grad outs"), ``n_out`` the
+    number of forward outputs (the node's "grad ins").
+    """
+
+    __slots__ = ("vjp_fn", "in_tensors", "n_out", "out_shapes", "name", "__weakref__")
+
+    def __init__(self, vjp_fn, in_tensors: Sequence, n_out: int, out_shapes, name: str = ""):
+        self.vjp_fn = vjp_fn
+        self.in_tensors = list(in_tensors)
+        self.n_out = n_out
+        self.out_shapes = out_shapes  # [(shape, dtype)] per forward output
+        self.name = name
+
+    def release(self):
+        self.vjp_fn = None
+        self.in_tensors = []
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """Run reverse accumulation from ``tensors``.
+
+    Engine parity with ``egr::Backward`` (backward.cc:825): build the
+    reachable node graph, count in-degrees (pending fan-in), process ready
+    nodes from a queue, accumulate fan-in cotangents, write ``.grad`` on leaf
+    tensors (``GradNodeAccumulation`` parity, accumulation_node.h:23).
+    """
+    import jax.numpy as jnp
+
+    from .core import Tensor, _wrap_value
+
+    if not isinstance(tensors, (list, tuple)):
+        tensors = [tensors]
+    roots: List[Tensor] = list(tensors)
+    if grad_tensors is None:
+        grad_tensors = [None] * len(roots)
+    elif not isinstance(grad_tensors, (list, tuple)):
+        grad_tensors = [grad_tensors]
+
+    # cotangent store: id(node) -> [list of per-output cotangents]
+    node_cots = {}
+    # discover reachable graph & in-degree (number of dependant downstream nodes)
+    indeg = {}
+    nodes = {}
+
+    def discover(node):
+        if id(node) in nodes:
+            return
+        nodes[id(node)] = node
+        indeg.setdefault(id(node), 0)
+        for t in node.in_tensors:
+            prod = t._node
+            if prod is not None:
+                indeg[id(prod)] = indeg.get(id(prod), 0) + 1
+                discover(prod)
+
+    for root in roots:
+        if root._node is not None:
+            discover(root._node)
+
+    # seed cotangents
+    for root, g in zip(roots, grad_tensors):
+        if g is None:
+            gval = jnp.ones_like(root._value)
+        else:
+            gval = g._value if isinstance(g, Tensor) else jnp.asarray(g)
+        node = root._node
+        if node is None:
+            if not root.stop_gradient:
+                _accum_grad(root, gval)
+            continue
+        if node.vjp_fn is None:
+            raise RuntimeError(
+                "Trying to backward through a graph that has already been freed. "
+                "Pass retain_graph=True to backward() if you need to backward twice."
+            )
+        cots = node_cots.setdefault(id(node), [None] * node.n_out)
+        idx = root._out_idx
+        cots[idx] = gval if cots[idx] is None else cots[idx] + gval
+
+    # ready queue = nodes with indeg 0 (no unprocessed consumers)
+    queue = [n for nid, n in nodes.items() if indeg[nid] == 0]
+    processed = []
+    while queue:
+        node = queue.pop()
+        processed.append(node)
+        cots = node_cots.pop(id(node), None)
+        if cots is not None and node.vjp_fn is None:
+            raise RuntimeError(
+                "Trying to backward through a graph that has already been freed. "
+                "Pass retain_graph=True to backward() if you need to backward twice."
+            )
+        if cots is not None and node.vjp_fn is not None:
+            in_cots = _call_vjp(node, cots)
+            for t, c in zip(node.in_tensors, in_cots):
+                prod = t._node
+                if prod is None:
+                    if not t.stop_gradient:
+                        _accum_grad(t, c)
+                else:
+                    pcots = node_cots.setdefault(id(prod), [None] * prod.n_out)
+                    idx = t._out_idx
+                    pcots[idx] = c if pcots[idx] is None else pcots[idx] + c
+        # release consumer edges regardless of whether this node carried grads
+        for t in node.in_tensors:
+            prod = t._node
+            if prod is not None:
+                indeg[id(prod)] -= 1
+                if indeg[id(prod)] == 0:
+                    queue.append(prod)
+
+    if not retain_graph:
+        for node in processed:
+            node.release()
+
+
+def _call_vjp(node, cots):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    # Replace missing cotangents (outputs unused downstream) with zeros of the
+    # shape/dtype recorded at trace time. Integer/bool outputs take float0
+    # cotangents per JAX convention.
+    full = []
+    for c, (shape, dtype) in zip(cots, node.out_shapes):
+        if c is not None:
+            full.append(c)
+        elif jnp.issubdtype(dtype, jnp.floating) or jnp.issubdtype(dtype, jnp.complexfloating):
+            full.append(jnp.zeros(shape, dtype))
+        else:
+            full.append(np.zeros(shape, jax.dtypes.float0))
+    out = node.vjp_fn(tuple(full) if node.n_out > 1 else full[0])
+    return out
+
+
+def _accum_grad(tensor, value):
+    from .core import Tensor
+
+    if tensor.grad is None:
+        g = Tensor.__new__(Tensor)
+        g._init(value, stop_gradient=True)
+        tensor.grad = g
+    else:
+        tensor.grad._value = tensor.grad._value + value

@@ -1,0 +1,272 @@
+"""Tensor and eager op dispatch.
+
+Paddle parity: the eager ``Tensor`` (reference:
+paddle/fluid/pybind/eager_method.cc, python/paddle/fluid/dygraph/
+varbase_patch_methods.py) and the dygraph tracer
+(paddle/fluid/imperative/tracer.cc:175). TPU-first design: a Tensor is a thin
+mutable handle over an immutable ``jax.Array`` living in HBM via PJRT; the
+"tracer" is :func:`primitive`, which executes the forward with jax.numpy and
+records the op's ``jax.vjp`` closure on the autograd tape. There is no op
+registry, kernel factory, or device dispatch — XLA is the kernel library.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import autograd
+from .autograd import TapeNode, is_grad_enabled, no_grad
+from .dtype import convert_dtype, get_default_dtype, to_jax_dtype
+
+
+class _DeviceState(threading.local):
+    device = None  # None = JAX default
+
+
+_DEVICE = _DeviceState()
+
+
+def set_device(device: str):
+    """paddle.set_device parity. Accepts 'tpu', 'cpu', 'tpu:0' etc."""
+    name = device.split(":")[0]
+    if name in ("tpu", "gpu"):  # gpu accepted as an alias for accelerator
+        name = None  # default platform (TPU when present)
+    _DEVICE.device = name
+    return device
+
+
+def get_device() -> str:
+    plat = jax.default_backend() if _DEVICE.device is None else _DEVICE.device
+    return f"{plat}:0"
+
+
+def is_compiled_with_tpu() -> bool:
+    return any(d.platform != "cpu" for d in jax.devices())
+
+
+class Tensor:
+    """Eager tensor: mutable handle over a jax.Array.
+
+    ``stop_gradient`` defaults True like paddle's ``Tensor`` created from
+    data; parameters flip it to False. ``_node``/``_out_idx`` link into the
+    autograd tape (None for leaves).
+    """
+
+    __slots__ = ("_value", "stop_gradient", "grad", "_node", "_out_idx", "name", "persistable", "trainable", "__weakref__", "__dict__")
+
+    def __init__(self, data=None, dtype=None, place=None, stop_gradient=True):
+        if data is None:
+            data = jnp.zeros((), to_jax_dtype(dtype or get_default_dtype()))
+        value = _to_array(data, dtype)
+        self._init(value, stop_gradient=stop_gradient)
+
+    def _init(self, value, stop_gradient=True, name=None):
+        self._value = value
+        self.stop_gradient = stop_gradient
+        self.grad = None
+        self._node = None
+        self._out_idx = 0
+        self.name = name or ""
+        self.persistable = False
+        self.trainable = not stop_gradient
+
+    # -- metadata ---------------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._value.shape)
+
+    @property
+    def ndim(self):
+        return self._value.ndim
+
+    @property
+    def size(self):
+        return int(np.prod(self._value.shape)) if self._value.shape else 1
+
+    @property
+    def dtype(self):
+        return convert_dtype(self._value.dtype)
+
+    @property
+    def place(self):
+        devs = getattr(self._value, "devices", None)
+        if callable(devs):
+            try:
+                return str(next(iter(devs())))
+            except Exception:
+                return "cpu"
+        return "cpu"
+
+    @property
+    def is_leaf(self):
+        return self._node is None
+
+    # -- conversion -------------------------------------------------------
+    def numpy(self):
+        return np.asarray(self._value)
+
+    def item(self):
+        return self._value.item()
+
+    def tolist(self):
+        return np.asarray(self._value).tolist()
+
+    def astype(self, dtype):
+        from ..tensor.manipulation import cast
+
+        return cast(self, dtype)
+
+    def clone(self):
+        from ..tensor.creation import clone
+
+        return clone(self)
+
+    def detach(self):
+        t = Tensor.__new__(Tensor)
+        t._init(self._value, stop_gradient=True, name=self.name)
+        return t
+
+    def numel(self):
+        return self.size
+
+    # -- autograd ---------------------------------------------------------
+    def backward(self, grad_tensor=None, retain_graph=False):
+        autograd.backward([self], [grad_tensor], retain_graph=retain_graph)
+
+    def clear_grad(self):
+        self.grad = None
+
+    clear_gradient = clear_grad
+
+    def register_hook(self, hook):
+        raise NotImplementedError("tensor hooks land with the DDP reducer parity work")
+
+    # -- mutation (leaf-only, used by optimizers / load) ------------------
+    def set_value(self, value):
+        value = _to_array(value, self.dtype)
+        if tuple(value.shape) != tuple(self._value.shape):
+            raise ValueError(f"set_value shape mismatch: {value.shape} vs {self._value.shape}")
+        self._value = value
+
+    def copy_(self, other):
+        self.set_value(other._value if isinstance(other, Tensor) else other)
+        return self
+
+    def _apply_update(self, new_value):
+        """In-place parameter update (optimizer fast path, no checks)."""
+        self._value = new_value
+
+    def __repr__(self):
+        sg = self.stop_gradient
+        return f"Tensor(shape={self.shape}, dtype={self.dtype}, stop_gradient={sg},\n       {np.asarray(self._value)!r})"
+
+    def __len__(self):
+        if not self._value.shape:
+            raise TypeError("len() of a 0-d tensor")
+        return self._value.shape[0]
+
+    def __bool__(self):
+        return bool(self._value)
+
+    def __int__(self):
+        return int(self._value)
+
+    def __float__(self):
+        return float(self._value)
+
+    def __format__(self, spec):
+        return format(self.item() if self._value.ndim == 0 else np.asarray(self._value), spec)
+
+    def __hash__(self):
+        return id(self)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    # arithmetic dunders are patched in paddle_tpu.tensor (monkey_patch_tensor)
+
+    # jax pytree-friendly: expose the raw array
+    def __jax_array__(self):
+        return self._value
+
+
+def _to_array(data, dtype=None):
+    jdt = to_jax_dtype(dtype) if dtype is not None else None
+    if isinstance(data, Tensor):
+        arr = data._value
+        return arr.astype(jdt) if jdt is not None and arr.dtype != jdt else arr
+    if isinstance(data, (jnp.ndarray, jax.Array)):
+        return data.astype(jdt) if jdt is not None and data.dtype != jdt else data
+    arr = np.asarray(data)
+    if jdt is None:
+        # paddle semantics: python floats -> default dtype; ints -> int64
+        if arr.dtype == np.float64 and not isinstance(data, np.ndarray):
+            jdt = to_jax_dtype(get_default_dtype())
+        elif arr.dtype == np.int64 and not isinstance(data, np.ndarray):
+            jdt = to_jax_dtype("int64")
+    return jnp.asarray(arr, dtype=jdt)
+
+
+def _wrap_value(value, stop_gradient=True, node=None, out_idx=0):
+    t = Tensor.__new__(Tensor)
+    t._init(value, stop_gradient=stop_gradient)
+    t._node = node
+    t._out_idx = out_idx
+    return t
+
+
+def unwrap(x):
+    """Tensor -> jax.Array; passthrough otherwise."""
+    return x._value if isinstance(x, Tensor) else x
+
+
+_FLOAT_KINDS = ("f", "V")  # V covers bfloat16 numpy view
+
+
+def _is_float_array(v) -> bool:
+    dt = np.dtype(v.dtype) if hasattr(v, "dtype") else None
+    if dt is None:
+        return False
+    return dt.kind == "f" or v.dtype == jnp.bfloat16
+
+
+def primitive(fn: Callable, *args, _name: str = "", **kwargs):
+    """Execute ``fn(*arrays, **kwargs)`` and record it on the tape.
+
+    ``fn`` must be a pure function of its positional array arguments
+    (keyword args are static). Tensor positional args that require grad are
+    differentiated through via ``jax.vjp``; everything else is closed over.
+    Returns Tensor or tuple of Tensors mirroring fn's output.
+    """
+    vals = [unwrap(a) for a in args]
+    diff_idx = []
+    if is_grad_enabled():
+        for i, a in enumerate(args):
+            if isinstance(a, Tensor) and not a.stop_gradient and _is_float_array(a._value):
+                diff_idx.append(i)
+
+    if not diff_idx:
+        out = fn(*vals, **kwargs)
+        if isinstance(out, (tuple, list)):
+            return tuple(_wrap_value(v) for v in out)
+        return _wrap_value(out)
+
+    def closed(*diff_vals):
+        v = list(vals)
+        for i, dv in zip(diff_idx, diff_vals):
+            v[i] = dv
+        return fn(*v, **kwargs)
+
+    out, vjp_fn = jax.vjp(closed, *[vals[i] for i in diff_idx])
+    multi = isinstance(out, (tuple, list))
+    outs = tuple(out) if multi else (out,)
+    # only float outputs participate in grad flow; but vjp structure covers all
+    out_shapes = [(o.shape, o.dtype) for o in outs]
+    node = TapeNode(vjp_fn, [args[i] for i in diff_idx], len(outs), out_shapes, name=_name or getattr(fn, "__name__", "op"))
+    wrapped = tuple(_wrap_value(v, stop_gradient=not _is_float_array(v), node=node if _is_float_array(v) else None, out_idx=i) for i, v in enumerate(outs))
+    return wrapped if multi else wrapped[0]

@@ -1,0 +1,92 @@
+"""Dtype registry.
+
+Paddle parity: dtype strings/enum of ``VarDesc.VarType`` (reference:
+paddle/fluid/framework/framework.proto:91) mapped onto JAX dtypes. On TPU the
+native matmul dtype is bfloat16; float64 is emulated and discouraged.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# canonical name -> jnp dtype
+_DTYPES = {
+    "bool": jnp.bool_,
+    "uint8": jnp.uint8,
+    "int8": jnp.int8,
+    "int16": jnp.int16,
+    "int32": jnp.int32,
+    "int64": jnp.int64,
+    "float16": jnp.float16,
+    "bfloat16": jnp.bfloat16,
+    "float32": jnp.float32,
+    "float64": jnp.float64,
+    "complex64": jnp.complex64,
+    "complex128": jnp.complex128,
+}
+
+_ALIASES = {
+    "fp16": "float16",
+    "bf16": "bfloat16",
+    "fp32": "float32",
+    "fp64": "float64",
+    "half": "float16",
+    "float": "float32",
+    "double": "float64",
+    "int": "int32",
+    "long": "int64",
+}
+
+_default_dtype = "float32"
+
+
+def convert_dtype(dtype):
+    """Normalize a dtype spec (str / np / jnp dtype) to its canonical name."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        name = _ALIASES.get(dtype, dtype)
+        if name in _DTYPES:
+            return name
+        raise ValueError(f"unsupported dtype {dtype!r}")
+    # jnp/np dtype objects or scalar types
+    name = np.dtype(dtype).name if not hasattr(dtype, "name") else dtype.name
+    name = _ALIASES.get(name, name)
+    if name in _DTYPES:
+        return name
+    raise ValueError(f"unsupported dtype {dtype!r}")
+
+
+_X64_DOWNCAST = {"int64": "int32", "uint64": "uint32", "float64": "float32", "complex128": "complex64"}
+
+
+def to_jax_dtype(dtype):
+    if dtype is None:
+        return None
+    name = convert_dtype(dtype)
+    import jax
+
+    if not jax.config.jax_enable_x64 and name in _X64_DOWNCAST:
+        # Paddle defaults indices to int64; on TPU (x64 off) we canonically run
+        # int32/float32 — the paddle-visible dtype name is preserved by callers.
+        name = _X64_DOWNCAST[name]
+    return _DTYPES[name]
+
+
+def get_default_dtype():
+    return _default_dtype
+
+
+def set_default_dtype(dtype):
+    global _default_dtype
+    name = convert_dtype(dtype)
+    if name not in ("float16", "bfloat16", "float32", "float64"):
+        raise TypeError(f"set_default_dtype only accepts floating dtypes, got {dtype!r}")
+    _default_dtype = name
+
+
+def is_floating(dtype) -> bool:
+    return jnp.issubdtype(np.dtype(to_jax_dtype(dtype)), np.floating) or convert_dtype(dtype) in (
+        "bfloat16",
+        "float16",
+    )

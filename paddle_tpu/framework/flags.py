@@ -1,0 +1,55 @@
+"""Global flag registry.
+
+Paddle parity: ``PADDLE_DEFINE_EXPORTED_*`` gflags exposed to Python via
+``paddle.set_flags``/``get_flags`` (reference: paddle/fluid/platform/flags.cc,
+paddle/fluid/pybind/global_value_getter_setter.cc). Flags are overridable from
+the environment (``FLAGS_*``) just like the reference.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+_REGISTRY: Dict[str, Any] = {}
+
+
+def define_flag(name: str, default, help_str: str = ""):
+    env = os.environ.get(name)
+    value = default
+    if env is not None:
+        if isinstance(default, bool):
+            value = env.lower() in ("1", "true", "yes", "on")
+        elif isinstance(default, int):
+            value = int(env)
+        elif isinstance(default, float):
+            value = float(env)
+        else:
+            value = env
+    _REGISTRY[name] = value
+    return value
+
+
+def set_flags(flags: Dict[str, Any]):
+    for k, v in flags.items():
+        if k not in _REGISTRY:
+            raise KeyError(f"unknown flag {k!r}")
+        _REGISTRY[k] = v
+
+
+def get_flags(flags):
+    if isinstance(flags, str):
+        flags = [flags]
+    return {k: _REGISTRY[k] for k in flags}
+
+
+def flag(name: str):
+    return _REGISTRY[name]
+
+
+# Core flags (subset of reference platform/flags.cc relevant on TPU).
+define_flag("FLAGS_check_nan_inf", False, "check outputs for nan/inf after each eager op")
+define_flag("FLAGS_benchmark", False, "synchronize after each op for timing")
+define_flag("FLAGS_use_flash_attention", True, "use the Pallas flash-attention kernel when on TPU")
+define_flag("FLAGS_eager_delete_tensor_gb", 0.0, "compat no-op: XLA/PJRT manages buffers")
+define_flag("FLAGS_allocator_strategy", "auto_growth", "compat no-op: PJRT BFC allocator is used")
+define_flag("FLAGS_remat_policy", "none", "default rematerialization policy for jit steps")

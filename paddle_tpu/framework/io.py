@@ -1,0 +1,55 @@
+"""paddle.save/load parity (reference: python/paddle/framework/io.py:574,791).
+
+State dicts are pickled with tensors converted to numpy (protocol 4 for >4GB
+chunking parity). Sharded/distributed checkpoints live in
+paddle_tpu.distributed.checkpoint (orbax-backed).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from collections import OrderedDict
+
+import numpy as np
+
+from .core import Tensor, _wrap_value
+
+
+def _to_saveable(obj):
+    if isinstance(obj, Tensor):
+        return ("__tensor__", np.asarray(obj._value), obj.stop_gradient)
+    if isinstance(obj, dict):
+        return {k: _to_saveable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_to_saveable(v) for v in obj)
+    return obj
+
+
+def _from_saved(obj, return_numpy=False):
+    import jax.numpy as jnp
+
+    if isinstance(obj, tuple) and len(obj) == 3 and obj[0] == "__tensor__":
+        if return_numpy:
+            return obj[1]
+        t = _wrap_value(jnp.asarray(obj[1]))
+        t.stop_gradient = obj[2]
+        return t
+    if isinstance(obj, dict):
+        return {k: _from_saved(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)) and not (len(obj) == 3 and obj and obj[0] == "__tensor__"):
+        return type(obj)(_from_saved(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_saveable(obj), f, protocol=protocol)
+
+
+def load(path, return_numpy=False, **config):
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _from_saved(obj, return_numpy=return_numpy)

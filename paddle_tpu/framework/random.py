@@ -1,0 +1,73 @@
+"""RNG state management.
+
+Paddle parity: ``paddle.seed`` + per-op stateful RNG (reference:
+python/paddle/framework/random.py, curand states in
+paddle/fluid/platform/device_context.h). TPU-first design: JAX threaded PRNG
+keys. Eager mode keeps a host-side counter folded into a root key; traced
+(jit) code must use :class:`rng_scope` so the key is an explicit traced value
+— never host state — keeping compiled steps pure and reproducible.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+
+
+class _RngState(threading.local):
+    def __init__(self):
+        self.root_key = jax.random.key(0)
+        self.counter = 0
+        # stack of (key, [counter]) installed by rng_scope for traced code
+        self.scopes = []
+
+
+_STATE = _RngState()
+
+
+def seed(value: int):
+    """Reset the global RNG root key (paddle.seed parity)."""
+    _STATE.root_key = jax.random.key(int(value))
+    _STATE.counter = 0
+    return value
+
+
+def get_rng_state():
+    return (_STATE.root_key, _STATE.counter)
+
+
+def set_rng_state(state):
+    _STATE.root_key, _STATE.counter = state
+
+
+def split_key():
+    """Return a fresh PRNG key.
+
+    Inside an :class:`rng_scope` (i.e. under jit tracing), keys derive from the
+    scope's traced key; otherwise from the host-side eager state.
+    """
+    if _STATE.scopes:
+        key, counter = _STATE.scopes[-1]
+        counter[0] += 1
+        return jax.random.fold_in(key, counter[0])
+    _STATE.counter += 1
+    return jax.random.fold_in(_STATE.root_key, _STATE.counter)
+
+
+@contextlib.contextmanager
+def rng_scope(key):
+    """Install ``key`` as the RNG source for code executed in this scope.
+
+    Used by the functional/jit path to thread an explicit key through
+    stateful-looking layers (Dropout etc.).
+    """
+    _STATE.scopes.append((key, [0]))
+    try:
+        yield
+    finally:
+        _STATE.scopes.pop()
+
+
+def in_rng_scope() -> bool:
+    return bool(_STATE.scopes)

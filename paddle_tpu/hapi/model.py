@@ -1,0 +1,116 @@
+"""High-level Model API (parity: python/paddle/hapi/model.py —
+Model.fit/evaluate/predict/save/load with prepare(optimizer, loss, metrics))."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework.core import Tensor
+from ..framework.io import load as _load
+from ..framework.io import save as _save
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = metrics if isinstance(metrics, (list, tuple)) else ([metrics] if metrics else [])
+        return self
+
+    def train_batch(self, inputs, labels=None):
+        self.network.train()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        outputs = self.network(*inputs)
+        losses = self._loss(outputs, *(labels if isinstance(labels, (list, tuple)) else [labels]))
+        losses.backward()
+        self._optimizer.step()
+        self._optimizer.clear_grad()
+        return losses.numpy()
+
+    def eval_batch(self, inputs, labels=None):
+        from ..framework.autograd import no_grad
+
+        self.network.eval()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        with no_grad():
+            outputs = self.network(*inputs)
+            losses = self._loss(outputs, *(labels if isinstance(labels, (list, tuple)) else [labels]))
+        return losses.numpy(), outputs
+
+    def predict_batch(self, inputs):
+        from ..framework.autograd import no_grad
+
+        self.network.eval()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        with no_grad():
+            return self.network(*inputs)
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1, eval_freq=1, log_freq=10, callbacks=None, verbose=1, shuffle=True, drop_last=False, num_workers=0):
+        history = []
+        for epoch in range(epochs):
+            losses = []
+            for batch in train_data:
+                if isinstance(batch, (list, tuple)) and len(batch) >= 2:
+                    x, y = batch[0], batch[1]
+                else:
+                    x, y = batch, None
+                loss = self.train_batch(x, y)
+                losses.append(float(np.asarray(loss)))
+            avg = float(np.mean(losses)) if losses else 0.0
+            history.append(avg)
+            if verbose:
+                print(f"Epoch {epoch + 1}/{epochs} - loss: {avg:.4f}")
+            if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                self.evaluate(eval_data, verbose=verbose)
+        return history
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=1, num_workers=0, callbacks=None):
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for batch in eval_data:
+            x, y = (batch[0], batch[1]) if isinstance(batch, (list, tuple)) else (batch, None)
+            loss, outputs = self.eval_batch(x, y)
+            losses.append(float(np.asarray(loss)))
+            for m in self._metrics:
+                m.update(*m.compute(outputs, y))
+        result = {"loss": float(np.mean(losses)) if losses else 0.0}
+        for m in self._metrics:
+            result[m.name()] = m.accumulate()
+        if verbose:
+            print("Eval -", result)
+        return result
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False, callbacks=None, verbose=1):
+        outs = []
+        for batch in test_data:
+            x = batch[0] if isinstance(batch, (list, tuple)) else batch
+            outs.append(self.predict_batch(x))
+        return outs
+
+    def save(self, path, training=True):
+        _save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None and hasattr(self._optimizer, "state_dict"):
+            _save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        import os
+
+        state = _load(path + ".pdparams") if not path.endswith(".pdparams") else _load(path)
+        self.network.set_state_dict(state)
+        opt_path = path + ".pdopt"
+        if not reset_optimizer and self._optimizer is not None and os.path.exists(opt_path):
+            self._optimizer.set_state_dict(_load(opt_path))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        n_params = sum(p.size for p in self.network.parameters())
+        print(f"{type(self.network).__name__}: {n_params:,} parameters")
+        return {"total_params": n_params}

@@ -1,0 +1,7 @@
+"""paddle_tpu.nn (parity: python/paddle/nn)."""
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue, clip_grad_norm_  # noqa: F401
+from .functional_api import functional_call, unwrap_tree  # noqa: F401
+from .layer import *  # noqa: F401,F403
+from .layer import Layer, Parameter  # noqa: F401

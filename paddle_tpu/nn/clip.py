@@ -1,0 +1,88 @@
+"""Gradient clipping (parity: python/paddle/nn/clip.py — ClipGradByValue,
+ClipGradByNorm, ClipGradByGlobalNorm).
+
+Dual-form: each clip works eagerly on (param, grad Tensor) pairs and
+functionally on a grads pytree (the jit train-step path).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+class ClipGradBase:
+    def apply_tree(self, grads_tree):
+        raise NotImplementedError
+
+    def __call__(self, params_grads):
+        """Eager form: list of (param, grad) Tensors -> same with clipped grads."""
+        from .functional_api import unwrap_tree
+
+        from ..framework.core import _wrap_value
+
+        grads = {i: g._value for i, (_, g) in enumerate(params_grads) if g is not None}
+        clipped = self.apply_tree(grads)
+        out = []
+        for i, (p, g) in enumerate(params_grads):
+            if g is None:
+                out.append((p, g))
+            else:
+                out.append((p, _wrap_value(clipped[i])))
+        return out
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = max
+        self.min = -max if min is None else min
+
+    def apply_tree(self, grads_tree):
+        return jax.tree_util.tree_map(lambda g: jnp.clip(g, self.min, self.max), grads_tree)
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = clip_norm
+
+    def apply_tree(self, grads_tree):
+        def clip_one(g):
+            n = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+            scale = jnp.minimum(1.0, self.clip_norm / jnp.maximum(n, 1e-12))
+            return (g.astype(jnp.float32) * scale).astype(g.dtype)
+
+        return jax.tree_util.tree_map(clip_one, grads_tree)
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    """Parity: python/paddle/nn/clip.py ClipGradByGlobalNorm. Under pjit the
+    per-leaf square-sums over sharded grads compile to psums across the mesh,
+    matching HybridParallelOptimizer's cross-group norm reduction
+    (fleet/meta_optimizers/dygraph_optimizer/hybrid_parallel_optimizer.py:50)
+    with zero extra code."""
+
+    def __init__(self, clip_norm=1.0, group_name="default_group"):
+        self.clip_norm = clip_norm
+
+    def apply_tree(self, grads_tree):
+        leaves = jax.tree_util.tree_leaves(grads_tree)
+        total = sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in leaves)
+        gnorm = jnp.sqrt(total)
+        scale = jnp.minimum(1.0, self.clip_norm / jnp.maximum(gnorm, 1e-12))
+        return jax.tree_util.tree_map(lambda g: (g.astype(jnp.float32) * scale).astype(g.dtype), grads_tree)
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0, error_if_nonfinite=False):
+    """torch-style utility over eager parameters with .grad set."""
+    params = [p for p in parameters if p.grad is not None]
+    if not params:
+        return None
+    total = sum(jnp.sum(jnp.square(p.grad._value.astype(jnp.float32))) for p in params)
+    gnorm = jnp.sqrt(total)
+    scale = jnp.minimum(1.0, max_norm / jnp.maximum(gnorm, 1e-12))
+    for p in params:
+        p.grad._value = (p.grad._value.astype(jnp.float32) * scale).astype(p.grad._value.dtype)
+    from .functional_api import unwrap_tree  # noqa: F401
+
+    from ..framework.core import _wrap_value
+
+    return _wrap_value(gnorm)

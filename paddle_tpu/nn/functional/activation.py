@@ -1,0 +1,155 @@
+"""Activation functionals (parity: python/paddle/nn/functional/activation.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...tensor._helpers import ensure_tensor, op, unwrap
+
+
+def relu(x, name=None):
+    return op(jax.nn.relu, ensure_tensor(x), _name="relu")
+
+
+def relu6(x, name=None):
+    return op(jax.nn.relu6, ensure_tensor(x), _name="relu6")
+
+
+def elu(x, alpha=1.0, name=None):
+    return op(lambda v: jax.nn.elu(v, alpha=alpha), ensure_tensor(x), _name="elu")
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return op(lambda v: scale * jnp.where(v > 0, v, alpha * jnp.expm1(v)), ensure_tensor(x), _name="selu")
+
+
+def gelu(x, approximate=False, name=None):
+    return op(lambda v: jax.nn.gelu(v, approximate=approximate), ensure_tensor(x), _name="gelu")
+
+
+def sigmoid(x, name=None):
+    return op(jax.nn.sigmoid, ensure_tensor(x), _name="sigmoid")
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return op(lambda v: jnp.clip(slope * v + offset, 0.0, 1.0), ensure_tensor(x), _name="hardsigmoid")
+
+
+def hardswish(x, name=None):
+    return op(lambda v: v * jnp.clip(v + 3.0, 0.0, 6.0) / 6.0, ensure_tensor(x), _name="hardswish")
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return op(lambda v: jnp.clip(v, min, max), ensure_tensor(x), _name="hardtanh")
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return op(lambda v: jnp.where(jnp.abs(v) > threshold, v, 0.0), ensure_tensor(x), _name="hardshrink")
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return op(
+        lambda v: jnp.where(v > threshold, v - threshold, jnp.where(v < -threshold, v + threshold, 0.0)),
+        ensure_tensor(x),
+        _name="softshrink",
+    )
+
+
+def tanhshrink(x, name=None):
+    return op(lambda v: v - jnp.tanh(v), ensure_tensor(x), _name="tanhshrink")
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return op(lambda v: jax.nn.leaky_relu(v, negative_slope), ensure_tensor(x), _name="leaky_relu")
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def fn(v, w):
+        if w.size == 1:
+            return jnp.where(v > 0, v, w.reshape(()) * v)
+        shape = [1] * v.ndim
+        ch_axis = 1 if data_format == "NCHW" else v.ndim - 1
+        shape[ch_axis] = w.size
+        return jnp.where(v > 0, v, w.reshape(shape) * v)
+
+    return op(fn, ensure_tensor(x), ensure_tensor(weight), _name="prelu")
+
+
+def rrelu(x, lower=0.125, upper=0.3333333, training=True, name=None):
+    from ...framework import random as _random
+
+    x = ensure_tensor(x)
+    if training:
+        key = _random.split_key()
+        slope = jax.random.uniform(key, tuple(x.shape), minval=lower, maxval=upper)
+    else:
+        slope = (lower + upper) / 2.0
+    return op(lambda v: jnp.where(v >= 0, v, slope * v), x, _name="rrelu")
+
+
+def swish(x, name=None):
+    return op(jax.nn.silu, ensure_tensor(x), _name="swish")
+
+
+silu = swish
+
+
+def mish(x, name=None):
+    return op(lambda v: v * jnp.tanh(jax.nn.softplus(v)), ensure_tensor(x), _name="mish")
+
+
+def softplus(x, beta=1, threshold=20, name=None):
+    return op(lambda v: jnp.where(beta * v > threshold, v, jax.nn.softplus(beta * v) / beta), ensure_tensor(x), _name="softplus")
+
+
+def softsign(x, name=None):
+    return op(jax.nn.soft_sign, ensure_tensor(x), _name="softsign")
+
+
+def tanh(x, name=None):
+    return op(jnp.tanh, ensure_tensor(x), _name="tanh")
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    return op(lambda v: jax.nn.softmax(v, axis=axis), ensure_tensor(x), _name="softmax")
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    return op(lambda v: jax.nn.log_softmax(v, axis=axis), ensure_tensor(x), _name="log_softmax")
+
+
+def log_sigmoid(x, name=None):
+    return op(jax.nn.log_sigmoid, ensure_tensor(x), _name="log_sigmoid")
+
+
+def maxout(x, groups, axis=1, name=None):
+    def fn(v):
+        shape = list(v.shape)
+        c = shape[axis]
+        new_shape = shape[:axis] + [c // groups, groups] + shape[axis + 1 :]
+        return jnp.max(v.reshape(new_shape), axis=axis + 1)
+
+    return op(fn, ensure_tensor(x), _name="maxout")
+
+
+def glu(x, axis=-1, name=None):
+    return op(lambda v: jax.nn.glu(v, axis=axis), ensure_tensor(x), _name="glu")
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...framework import random as _random
+
+    x = ensure_tensor(x)
+    key = _random.split_key()
+    g = jax.random.gumbel(key, tuple(x.shape))
+
+    def fn(v):
+        y = jax.nn.softmax((v + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            y_hard = jnp.zeros_like(y)
+            y_hard = jnp.put_along_axis(y_hard, idx, 1.0, axis=axis, inplace=False)
+            y = jax.lax.stop_gradient(y_hard - y) + y
+        return y
+
+    return op(fn, x, _name="gumbel_softmax")

@@ -1,0 +1,65 @@
+"""Attention functionals.
+
+Parity: the reference's fused attention CUDA ops
+(paddle/fluid/operators/fused/fused_attention_op.cu,
+fused_softmax_mask.cu.h) — on TPU the hot path is the Pallas
+flash-attention kernel (paddle_tpu/ops/flash_attention.py); the jnp
+path below is the reference implementation XLA fuses on its own.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.flags import flag
+from ...tensor._helpers import ensure_tensor, op, unwrap
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.0, is_causal=False, training=True, name=None):
+    """q/k/v: [batch, seq, heads, head_dim] (paddle layout).
+
+    Dispatches to the Pallas flash kernel on TPU when
+    FLAGS_use_flash_attention is set and shapes are tile-friendly.
+    """
+    q, k, v = ensure_tensor(query), ensure_tensor(key), ensure_tensor(value)
+    mask_val = unwrap(attn_mask) if attn_mask is not None else None
+
+    use_flash = flag("FLAGS_use_flash_attention") and dropout_p == 0.0 and mask_val is None
+    if use_flash:
+        from ...ops.flash_attention import flash_attention_available, flash_attention
+
+        if flash_attention_available(tuple(q.shape), tuple(k.shape)):
+            return op(lambda qq, kk, vv: flash_attention(qq, kk, vv, causal=is_causal), q, k, v, _name="flash_attention")
+
+    from ...framework import random as _random
+
+    drop_key = _random.split_key() if (dropout_p > 0.0 and training) else None
+
+    def fn(qq, kk, vv):
+        return _sdpa_reference(qq, kk, vv, mask_val, is_causal, dropout_p if training else 0.0, drop_key)
+
+    return op(fn, q, k, v, _name="sdpa")
+
+
+def _sdpa_reference(q, k, v, mask=None, causal=False, dropout_p=0.0, drop_key=None):
+    # [B, S, H, D] -> [B, H, S, D]
+    qh = jnp.swapaxes(q, 1, 2)
+    kh = jnp.swapaxes(k, 1, 2)
+    vh = jnp.swapaxes(v, 1, 2)
+    scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], jnp.float32))
+    logits = jnp.einsum("bhqd,bhkd->bhqk", qh, kh).astype(jnp.float32) * scale
+    if causal:
+        sq, sk = logits.shape[-2], logits.shape[-1]
+        cm = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        logits = jnp.where(cm, logits, -1e30)
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            logits = jnp.where(mask, logits, -1e30)
+        else:
+            logits = logits + mask.astype(logits.dtype)
+    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    if dropout_p > 0.0 and drop_key is not None:
+        keep = jax.random.bernoulli(drop_key, 1.0 - dropout_p, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0).astype(probs.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vh)
+    return jnp.swapaxes(out, 1, 2)

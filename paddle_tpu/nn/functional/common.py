@@ -1,0 +1,198 @@
+"""Common functionals: linear/dropout/embedding/pad/... (parity:
+python/paddle/nn/functional/common.py, input.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework import random as _random
+from ...tensor._helpers import Tensor, ensure_tensor, op, to_jax_dtype, unwrap, _wrap_value
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W + b. Weight layout [in, out] (paddle convention,
+    python/paddle/nn/functional/common.py:1584)."""
+    if bias is None:
+        return op(lambda v, w: v @ w, ensure_tensor(x), ensure_tensor(weight), _name="linear")
+    return op(lambda v, w, b: v @ w + b, ensure_tensor(x), ensure_tensor(weight), ensure_tensor(bias), _name="linear")
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    x = ensure_tensor(x)
+    if not training or p == 0.0:
+        return x if mode == "upscale_in_train" else op(lambda v: v * (1.0 - p), x, _name="dropout_eval")
+    key = _random.split_key()
+    shape = tuple(x.shape)
+    if axis is not None:
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        shape = tuple(s if i in axes else 1 for i, s in enumerate(shape))
+
+    def fn(v):
+        keep = jax.random.bernoulli(key, 1.0 - p, shape)
+        if mode == "upscale_in_train":
+            return jnp.where(keep, v / (1.0 - p), 0.0).astype(v.dtype)
+        return jnp.where(keep, v, 0.0).astype(v.dtype)
+
+    return op(fn, x, _name="dropout")
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    x = ensure_tensor(x)
+    if not training or p == 0.0:
+        return x
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    key = _random.split_key()
+
+    def fn(v):
+        keep = jax.random.bernoulli(key, 1.0 - p, tuple(v.shape))
+        a = (1.0 / (scale * ((1.0 - p) * (1.0 + p * alpha_p**2)) ** 0.5))
+        b = -a * alpha_p * p
+        return a * jnp.where(keep, v, alpha_p) + b
+
+    return op(fn, x, _name="alpha_dropout")
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    idx = unwrap(ensure_tensor(x))
+
+    def fn(w):
+        out = jnp.take(w, idx, axis=0)
+        if padding_idx is not None:
+            mask = (idx == padding_idx)[..., None]
+            out = jnp.where(mask, 0.0, out)
+        return out
+
+    return op(fn, ensure_tensor(weight), _name="embedding")
+
+
+def one_hot(x, num_classes, name=None):
+    idx = unwrap(ensure_tensor(x))
+    return _wrap_value(jax.nn.one_hot(idx, num_classes, dtype=to_jax_dtype("float32")))
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def fn(v):
+        k = v.shape[-1]
+        if prior_dist is not None:
+            return (1.0 - epsilon) * v + epsilon * unwrap(prior_dist)
+        return (1.0 - epsilon) * v + epsilon / k
+
+    return op(fn, ensure_tensor(label), _name="label_smooth")
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    x = ensure_tensor(x)
+    nd = x.ndim
+    pad = [int(unwrap(p)) for p in pad]
+
+    def fn(v):
+        if len(pad) == 2 * nd:
+            cfg = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+        else:
+            # paddle semantics: pairs apply to spatial dims starting from the
+            # LAST dim: [left, right, top, bottom, front, back] for NCHW means
+            # (left,right)->W, (top,bottom)->H (nn/functional/common.py pad).
+            n_spatial = len(pad) // 2
+            cfg = [(0, 0)] * nd
+            if data_format.startswith("NC"):
+                dims = list(range(nd - 1, nd - 1 - n_spatial, -1))
+            else:
+                dims = list(range(nd - 2, nd - 2 - n_spatial, -1))
+            for i, d in enumerate(dims):
+                cfg[d] = (pad[2 * i], pad[2 * i + 1])
+        jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]
+        if jmode == "constant":
+            return jnp.pad(v, cfg, mode="constant", constant_values=value)
+        return jnp.pad(v, cfg, mode=jmode)
+
+    return op(fn, x, _name="pad")
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    return op(
+        lambda v: v / jnp.maximum(jnp.linalg.norm(v, ord=p, axis=axis, keepdims=True), epsilon),
+        ensure_tensor(x),
+        _name="normalize",
+    )
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    def fn(a, b):
+        dot = jnp.sum(a * b, axis=axis)
+        na = jnp.linalg.norm(a, axis=axis)
+        nb = jnp.linalg.norm(b, axis=axis)
+        return dot / jnp.maximum(na * nb, eps)
+
+    return op(fn, ensure_tensor(x1), ensure_tensor(x2), _name="cosine_similarity")
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    def fn(a, b, w, *rest):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if rest:
+            out = out + rest[0]
+        return out
+
+    args = [ensure_tensor(x1), ensure_tensor(x2), ensure_tensor(weight)]
+    if bias is not None:
+        args.append(ensure_tensor(bias))
+    return op(fn, *args, _name="bilinear")
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest", align_corners=False, data_format="NCHW", name=None):
+    x = ensure_tensor(x)
+
+    def fn(v):
+        if data_format == "NCHW":
+            spatial = v.shape[2:]
+        else:
+            spatial = v.shape[1:-1]
+        if size is not None:
+            out_sp = [int(unwrap(s)) for s in (size if isinstance(size, (list, tuple)) else [size] * len(spatial))]
+        else:
+            sf = scale_factor if isinstance(scale_factor, (list, tuple)) else [scale_factor] * len(spatial)
+            out_sp = [int(s * f) for s, f in zip(spatial, sf)]
+        jmode = {"nearest": "nearest", "bilinear": "linear", "linear": "linear", "trilinear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
+        if data_format == "NCHW":
+            out_shape = (*v.shape[:2], *out_sp)
+        else:
+            out_shape = (v.shape[0], *out_sp, v.shape[-1])
+        return jax.image.resize(v, out_shape, method=jmode)
+
+    return op(fn, x, _name="interpolate")
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, data_format)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    x = ensure_tensor(x)
+    ks = kernel_sizes if isinstance(kernel_sizes, (list, tuple)) else [kernel_sizes] * 2
+    st = strides if isinstance(strides, (list, tuple)) else [strides] * 2
+    pd = paddings if isinstance(paddings, (list, tuple)) else [paddings] * 2
+    dl = dilations if isinstance(dilations, (list, tuple)) else [dilations] * 2
+
+    def fn(v):
+        n, c, h, w = v.shape
+        v2 = jnp.pad(v, [(0, 0), (0, 0), (pd[0], pd[0]), (pd[1], pd[1])])
+        patches = jax.lax.conv_general_dilated_patches(
+            v2, filter_shape=ks, window_strides=st, padding="VALID", rhs_dilation=dl,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        )
+        # -> [N, C*kh*kw, L]
+        return patches.reshape(n, c * ks[0] * ks[1], -1)
+
+    return op(fn, x, _name="unfold")

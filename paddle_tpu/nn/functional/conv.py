@@ -1,0 +1,121 @@
+"""Convolution functionals (parity: python/paddle/nn/functional/conv.py).
+
+Reference's conv kernels (paddle/phi/kernels/gpu/conv_kernel.cu via cuDNN)
+map to ``jax.lax.conv_general_dilated`` — XLA tiles convs directly onto the
+MXU; layout assignment is the compiler's job, so the NCHW paddle API is kept.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...tensor._helpers import ensure_tensor, op, unwrap
+
+
+def _pair(v, n):
+    if isinstance(v, (list, tuple)):
+        return list(v)
+    return [v] * n
+
+
+def _conv_padding(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()  # SAME / VALID
+    p = _pair(padding, n)
+    if len(p) == n:
+        return [(int(x), int(x)) for x in p]
+    # [before0, after0, before1, after1...]
+    return [(int(p[2 * i]), int(p[2 * i + 1])) for i in range(n)]
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCL", name=None):
+    return _convnd(x, weight, bias, stride, padding, dilation, groups, 1, data_format)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCHW", name=None):
+    return _convnd(x, weight, bias, stride, padding, dilation, groups, 2, data_format)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCDHW", name=None):
+    return _convnd(x, weight, bias, stride, padding, dilation, groups, 3, data_format)
+
+
+def _convnd(x, weight, bias, stride, padding, dilation, groups, n, data_format):
+    strides = _pair(stride, n)
+    dilations = _pair(dilation, n)
+    pad = _conv_padding(padding, n)
+    chan_first = data_format in ("NCL", "NCHW", "NCDHW")
+    spatial = "DHW"[3 - n :] if n < 3 else "DHW"
+    if n == 1:
+        spatial = "W"
+    elif n == 2:
+        spatial = "HW"
+    lhs_spec = ("NC" + spatial) if chan_first else ("N" + spatial + "C")
+    dn = (lhs_spec, "OI" + spatial, lhs_spec)
+
+    def fn(v, w, *rest):
+        out = jax.lax.conv_general_dilated(
+            v, w, window_strides=strides, padding=pad, rhs_dilation=dilations,
+            dimension_numbers=dn, feature_group_count=groups,
+        )
+        if rest:
+            b = rest[0]
+            shape = [1] * out.ndim
+            shape[1 if chan_first else -1] = b.shape[0]
+            out = out + b.reshape(shape)
+        return out
+
+    args = [ensure_tensor(x), ensure_tensor(weight)]
+    if bias is not None:
+        args.append(ensure_tensor(bias))
+    return op(fn, *args, _name=f"conv{n}d")
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0, dilation=1, groups=1, output_size=None, data_format="NCL", name=None):
+    return _convnd_transpose(x, weight, bias, stride, padding, output_padding, dilation, groups, 1, data_format)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0, dilation=1, groups=1, output_size=None, data_format="NCHW", name=None):
+    return _convnd_transpose(x, weight, bias, stride, padding, output_padding, dilation, groups, 2, data_format)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0, dilation=1, groups=1, output_size=None, data_format="NCDHW", name=None):
+    return _convnd_transpose(x, weight, bias, stride, padding, output_padding, dilation, groups, 3, data_format)
+
+
+def _convnd_transpose(x, weight, bias, stride, padding, output_padding, dilation, groups, n, data_format):
+    strides = _pair(stride, n)
+    dilations = _pair(dilation, n)
+    pads = _pair(padding, n)
+    opads = _pair(output_padding, n)
+    chan_first = data_format in ("NCL", "NCHW", "NCDHW")
+    spatial = {1: "W", 2: "HW", 3: "DHW"}[n]
+    lhs_spec = ("NC" + spatial) if chan_first else ("N" + spatial + "C")
+    # paddle weight layout for transpose conv: [in, out/groups, *k]
+    dn = (lhs_spec, "IO" + spatial, lhs_spec)
+
+    def fn(v, w, *rest):
+        k = w.shape[2:]
+        pad_cfg = [
+            (k[i] - 1 - pads[i], k[i] - 1 - pads[i] + opads[i]) for i in range(n)
+        ]
+        out = jax.lax.conv_general_dilated(
+            v, w, window_strides=[1] * n, padding=pad_cfg, lhs_dilation=strides,
+            rhs_dilation=dilations, dimension_numbers=dn, feature_group_count=groups,
+            # flip kernel for true transposed conv
+        )
+        if rest:
+            b = rest[0]
+            shape = [1] * out.ndim
+            shape[1 if chan_first else -1] = b.shape[0]
+            out = out + b.reshape(shape)
+        return out
+
+    def fn_flipped(v, w, *rest):
+        w = jnp.flip(w, axis=tuple(range(2, 2 + n)))
+        return fn(v, w, *rest)
+
+    args = [ensure_tensor(x), ensure_tensor(weight)]
+    if bias is not None:
+        args.append(ensure_tensor(bias))
+    return op(fn_flipped, *args, _name=f"conv{n}d_transpose")

@@ -1,0 +1,198 @@
+"""Loss functionals (parity: python/paddle/nn/functional/loss.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...tensor._helpers import Tensor, ensure_tensor, op, unwrap
+
+
+def _reduce(v, reduction):
+    if reduction == "mean":
+        return jnp.mean(v)
+    if reduction == "sum":
+        return jnp.sum(v)
+    return v
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean", soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0, name=None):
+    lbl = unwrap(ensure_tensor(label))
+    w = unwrap(ensure_tensor(weight)) if weight is not None else None
+
+    def fn(logits):
+        logp = jax.nn.log_softmax(logits, axis=axis) if use_softmax else jnp.log(jnp.maximum(logits, 1e-30))
+        if soft_label:
+            loss = -jnp.sum(lbl * logp, axis=axis)
+        else:
+            lab = lbl
+            if lab.ndim == logits.ndim and lab.shape[axis] == 1:
+                lab = jnp.squeeze(lab, axis=axis)
+            lab = lab.astype(jnp.int32)
+            if label_smoothing > 0.0:
+                n = logits.shape[axis]
+                onehot = jax.nn.one_hot(lab, n, dtype=logp.dtype, axis=axis)
+                smoothed = onehot * (1.0 - label_smoothing) + label_smoothing / n
+                loss = -jnp.sum(smoothed * logp, axis=axis)
+            else:
+                loss = -jnp.take_along_axis(logp, jnp.expand_dims(lab, axis), axis=axis).squeeze(axis)
+            valid = lab != ignore_index
+            loss = jnp.where(valid, loss, 0.0)
+            if w is not None:
+                loss = loss * jnp.take(w, jnp.maximum(lab, 0))
+            if reduction == "mean":
+                denom = jnp.maximum(jnp.sum(valid.astype(loss.dtype)), 1.0) if w is None else jnp.sum(
+                    jnp.where(valid, jnp.take(w, jnp.maximum(lab, 0)), 0.0)
+                )
+                return jnp.sum(loss) / denom
+        return _reduce(loss, reduction)
+
+    return op(fn, ensure_tensor(input), _name="cross_entropy")
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100, axis=-1, return_softmax=False):
+    out = cross_entropy(logits, label, soft_label=soft_label, ignore_index=ignore_index, reduction="none", axis=axis)
+    if return_softmax:
+        from .activation import softmax as _softmax
+
+        return out, _softmax(logits, axis=axis)
+    return out
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return op(lambda a, b: _reduce(jnp.square(a - b), reduction), ensure_tensor(input), ensure_tensor(label), _name="mse_loss")
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return op(lambda a, b: _reduce(jnp.abs(a - b), reduction), ensure_tensor(input), ensure_tensor(label), _name="l1_loss")
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def fn(a, b):
+        d = a - b
+        absd = jnp.abs(d)
+        loss = jnp.where(absd < delta, 0.5 * d * d / delta, absd - 0.5 * delta)
+        return _reduce(loss, reduction)
+
+    return op(fn, ensure_tensor(input), ensure_tensor(label), _name="smooth_l1_loss")
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):
+    lbl = unwrap(ensure_tensor(label)).astype(jnp.int32)
+    w = unwrap(ensure_tensor(weight)) if weight is not None else None
+
+    def fn(logp):
+        # class axis is 1 for ndim>=2 ([N,C] or [N,C,d1,...]); gather the
+        # label's log-prob along it.
+        loss = -jnp.take_along_axis(logp, jnp.expand_dims(lbl, 1), axis=1).squeeze(1)
+        valid = lbl != ignore_index
+        loss = jnp.where(valid, loss, 0.0)
+        if w is not None:
+            loss = loss * jnp.take(w, jnp.maximum(lbl, 0))
+        if reduction == "mean":
+            denom = jnp.maximum(jnp.sum(valid), 1) if w is None else jnp.sum(jnp.where(valid, jnp.take(w, jnp.maximum(lbl, 0)), 0.0))
+            return jnp.sum(loss) / denom
+        return _reduce(loss, reduction)
+
+    return op(fn, ensure_tensor(input), _name="nll_loss")
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    def fn(p, y):
+        p2 = jnp.clip(p, 1e-12, 1.0 - 1e-7)
+        loss = -(y * jnp.log(p2) + (1.0 - y) * jnp.log(1.0 - p2))
+        if weight is not None:
+            loss = loss * unwrap(weight)
+        return _reduce(loss, reduction)
+
+    return op(fn, ensure_tensor(input), ensure_tensor(label), _name="bce")
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean", pos_weight=None, name=None):
+    def fn(z, y):
+        if pos_weight is not None:
+            pw = unwrap(pos_weight)
+            loss = (1 - y) * z + (1 + (pw - 1) * y) * (jnp.logaddexp(0.0, -jnp.abs(z)) + jnp.maximum(-z, 0))
+        else:
+            loss = jnp.maximum(z, 0) - z * y + jnp.logaddexp(0.0, -jnp.abs(z))
+        if weight is not None:
+            loss = loss * unwrap(weight)
+        return _reduce(loss, reduction)
+
+    return op(fn, ensure_tensor(logit), ensure_tensor(label), _name="bce_with_logits")
+
+
+def kl_div(input, label, reduction="mean", name=None):
+    def fn(logp, y):
+        loss = y * (jnp.log(jnp.maximum(y, 1e-30)) - logp)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / logp.shape[0]
+        return _reduce(loss, reduction)
+
+    return op(fn, ensure_tensor(input), ensure_tensor(label), _name="kl_div")
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):
+    return op(
+        lambda a, b, y: _reduce(jnp.maximum(0.0, -y * (a - b) + margin), reduction),
+        ensure_tensor(input),
+        ensure_tensor(other),
+        ensure_tensor(label),
+        _name="margin_ranking_loss",
+    )
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    return op(
+        lambda a, y: _reduce(jnp.where(y == 1.0, a, jnp.maximum(0.0, margin - a)), reduction),
+        ensure_tensor(input),
+        ensure_tensor(label),
+        _name="hinge_embedding_loss",
+    )
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean", name=None):
+    def fn(a, b, y):
+        cos = jnp.sum(a * b, axis=-1) / jnp.maximum(jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12)
+        loss = jnp.where(y == 1, 1.0 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce(loss, reduction)
+
+    return op(fn, ensure_tensor(input1), ensure_tensor(input2), ensure_tensor(label), _name="cosine_embedding_loss")
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0, epsilon=1e-6, swap=False, reduction="mean", name=None):
+    def fn(a, pos, neg):
+        dp = jnp.linalg.norm(a - pos + epsilon, ord=p, axis=-1)
+        dn = jnp.linalg.norm(a - neg + epsilon, ord=p, axis=-1)
+        if swap:
+            dn2 = jnp.linalg.norm(pos - neg + epsilon, ord=p, axis=-1)
+            dn = jnp.minimum(dn, dn2)
+        return _reduce(jnp.maximum(dp - dn + margin, 0.0), reduction)
+
+    return op(fn, ensure_tensor(input), ensure_tensor(positive), ensure_tensor(negative), _name="triplet_margin_loss")
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    return op(
+        lambda p, y: -y * jnp.log(p + epsilon) - (1.0 - y) * jnp.log(1.0 - p + epsilon),
+        ensure_tensor(input),
+        ensure_tensor(label),
+        _name="log_loss",
+    )
+
+
+def square_error_cost(input, label):
+    return op(lambda a, b: jnp.square(a - b), ensure_tensor(input), ensure_tensor(label), _name="square_error_cost")
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0, reduction="sum", name=None):
+    def fn(z, y):
+        p = jax.nn.sigmoid(z)
+        ce = jnp.maximum(z, 0) - z * y + jnp.logaddexp(0.0, -jnp.abs(z))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        loss = a_t * jnp.power(1 - p_t, gamma) * ce
+        if normalizer is not None:
+            loss = loss / unwrap(normalizer)
+        return _reduce(loss, reduction)
+
+    return op(fn, ensure_tensor(logit), ensure_tensor(label), _name="sigmoid_focal_loss")

@@ -1,0 +1,162 @@
+"""Normalization functionals (parity: python/paddle/nn/functional/norm.py).
+
+On TPU, batch-norm "sync" across data-parallel shards is free under GSPMD:
+with the batch axis sharded, the mean/var reductions compile to psums over the
+mesh — the reference's SyncBatchNorm C++ machinery
+(paddle/fluid/operators/sync_batch_norm_op.cu) has no TPU analog needed.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...tensor._helpers import Tensor, ensure_tensor, op, unwrap
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=None):
+    ns = normalized_shape if isinstance(normalized_shape, (list, tuple)) else [normalized_shape]
+    axes = tuple(range(-len(ns), 0))
+
+    def fn(v, *rest):
+        mean = jnp.mean(v, axis=axes, keepdims=True)
+        var = jnp.var(v, axis=axes, keepdims=True)
+        out = (v - mean) / jnp.sqrt(var + epsilon)
+        it = iter(rest)
+        if weight is not None:
+            out = out * next(it)
+        if bias is not None:
+            out = out + next(it)
+        return out
+
+    args = [ensure_tensor(x)]
+    if weight is not None:
+        args.append(ensure_tensor(weight))
+    if bias is not None:
+        args.append(ensure_tensor(bias))
+    return op(fn, *args, _name="layer_norm")
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None, training=False, momentum=0.9, epsilon=1e-5, data_format="NCHW", use_global_stats=None, name=None):
+    ch_axis = 1 if data_format.startswith("NC") else -1
+
+    rm, rv = ensure_tensor(running_mean), ensure_tensor(running_var)
+    x = ensure_tensor(x)
+    reduce_axes = tuple(i for i in range(x.ndim) if i != (ch_axis % x.ndim))
+    use_batch_stats = training and not use_global_stats
+
+    if use_batch_stats:
+        # compute batch stats (differentiable), update running stats in-place
+        def stats_fn(v):
+            m = jnp.mean(v, axis=reduce_axes)
+            var = jnp.var(v, axis=reduce_axes)
+            return m, var
+
+        m_t, var_t = op(stats_fn, x, _name="bn_stats")
+        # running-stat update is a side effect on buffer tensors (paddle parity)
+        rm._value = momentum * rm._value + (1 - momentum) * m_t._value
+        rv._value = momentum * rv._value + (1 - momentum) * var_t._value
+        mean_in, var_in = m_t, var_t
+    else:
+        mean_in, var_in = rm.detach(), rv.detach()
+
+    def fn(v, m, var, *rest):
+        shape = [1] * v.ndim
+        shape[ch_axis] = v.shape[ch_axis]
+        out = (v - m.reshape(shape)) / jnp.sqrt(var.reshape(shape) + epsilon)
+        it = iter(rest)
+        if weight is not None:
+            out = out * next(it).reshape(shape)
+        if bias is not None:
+            out = out + next(it).reshape(shape)
+        return out
+
+    args = [x, mean_in, var_in]
+    if weight is not None:
+        args.append(ensure_tensor(weight))
+    if bias is not None:
+        args.append(ensure_tensor(bias))
+    return op(fn, *args, _name="batch_norm")
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None, use_input_stats=True, momentum=0.9, eps=1e-5, data_format="NCHW", name=None):
+    x = ensure_tensor(x)
+    ch_axis = 1 if data_format.startswith("NC") else -1
+    spatial_axes = tuple(range(2, x.ndim)) if ch_axis == 1 else tuple(range(1, x.ndim - 1))
+
+    def fn(v, *rest):
+        m = jnp.mean(v, axis=spatial_axes, keepdims=True)
+        var = jnp.var(v, axis=spatial_axes, keepdims=True)
+        out = (v - m) / jnp.sqrt(var + eps)
+        shape = [1] * v.ndim
+        shape[ch_axis] = v.shape[ch_axis]
+        it = iter(rest)
+        if weight is not None:
+            out = out * next(it).reshape(shape)
+        if bias is not None:
+            out = out + next(it).reshape(shape)
+        return out
+
+    args = [x]
+    if weight is not None:
+        args.append(ensure_tensor(weight))
+    if bias is not None:
+        args.append(ensure_tensor(bias))
+    return op(fn, *args, _name="instance_norm")
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None, data_format="NCHW", name=None):
+    x = ensure_tensor(x)
+    if not data_format.startswith("NC"):
+        raise NotImplementedError("group_norm currently supports channel-first")
+
+    def fn(v, *rest):
+        n, c = v.shape[0], v.shape[1]
+        g = num_groups
+        vv = v.reshape(n, g, c // g, *v.shape[2:])
+        axes = tuple(range(2, vv.ndim))
+        m = jnp.mean(vv, axis=axes, keepdims=True)
+        var = jnp.var(vv, axis=axes, keepdims=True)
+        out = ((vv - m) / jnp.sqrt(var + epsilon)).reshape(v.shape)
+        shape = [1, c] + [1] * (v.ndim - 2)
+        it = iter(rest)
+        if weight is not None:
+            out = out * next(it).reshape(shape)
+        if bias is not None:
+            out = out + next(it).reshape(shape)
+        return out
+
+    args = [x]
+    if weight is not None:
+        args.append(ensure_tensor(weight))
+    if bias is not None:
+        args.append(ensure_tensor(bias))
+    return op(fn, *args, _name="group_norm")
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0, data_format="NCHW", name=None):
+    x = ensure_tensor(x)
+
+    def fn(v):
+        sq = jnp.square(v)
+        half = size // 2
+        c = v.shape[1]
+        pad_sq = jnp.pad(sq, [(0, 0), (half, size - half - 1)] + [(0, 0)] * (v.ndim - 2))
+        acc = sum(pad_sq[:, i : i + c] for i in range(size))
+        return v / jnp.power(k + alpha * acc / size, beta)
+
+    return op(fn, x, _name="local_response_norm")
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    """RMSNorm (not in the reference snapshot; standard for modern LLMs)."""
+
+    def fn(v, *rest):
+        var = jnp.mean(jnp.square(v.astype(jnp.float32)), axis=-1, keepdims=True)
+        out = (v.astype(jnp.float32) / jnp.sqrt(var + epsilon)).astype(v.dtype)
+        if rest:
+            out = out * rest[0]
+        return out
+
+    args = [ensure_tensor(x)]
+    if weight is not None:
+        args.append(ensure_tensor(weight))
+    return op(fn, *args, _name="rms_norm")

@@ -1,0 +1,109 @@
+"""Pooling functionals (parity: python/paddle/nn/functional/pooling.py).
+
+reduce_window is the XLA-native pooling primitive (reference's
+paddle/phi/kernels/gpu/pool_kernel.cu equivalent).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...tensor._helpers import ensure_tensor, op, unwrap
+
+
+def _pair(v, n):
+    return list(v) if isinstance(v, (list, tuple)) else [v] * n
+
+
+def _pool(x, kernel, stride, padding, n, init, reduce_fn, avg=False, ceil_mode=False, exclusive=True, count_include_pad=False):
+    ks = _pair(kernel, n)
+    st = _pair(stride if stride is not None else kernel, n)
+    pd = _pair(padding, n)
+
+    def fn(v):
+        window = [1, 1] + ks
+        strides = [1, 1] + st
+        pads = [(0, 0), (0, 0)] + [(p, p) for p in pd]
+        if avg:
+            summed = jax.lax.reduce_window(v, 0.0, jax.lax.add, window, strides, pads)
+            if exclusive and not count_include_pad and any(pd):
+                ones = jnp.ones_like(v)
+                counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides, pads)
+                return summed / counts
+            return summed / np.prod(ks)
+        return jax.lax.reduce_window(v, init, reduce_fn, window, strides, pads)
+
+    return op(fn, ensure_tensor(x), _name="pool")
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, name=None):
+    return _pool(x, kernel_size, stride, padding, 1, -jnp.inf, jax.lax.max)
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 2, -jnp.inf, jax.lax.max)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, -jnp.inf, jax.lax.max)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True, ceil_mode=False, name=None):
+    return _pool(x, kernel_size, stride, padding, 1, 0.0, jax.lax.add, avg=True, exclusive=exclusive)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True, divisor_override=None, data_format="NCHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 2, 0.0, jax.lax.add, avg=True, exclusive=exclusive)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True, divisor_override=None, data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, 0.0, jax.lax.add, avg=True, exclusive=exclusive)
+
+
+def _adaptive_pool(x, output_size, n, avg=True):
+    out_sp = _pair(output_size, n)
+
+    def fn(v):
+        in_sp = v.shape[2:]
+        out = v
+        # decompose into per-dim variable-window pooling using mean over splits
+        for d in range(n):
+            osz = out_sp[d] if out_sp[d] is not None else in_sp[d]
+            isz = out.shape[2 + d]
+            # windows: start[i] = floor(i*isz/osz), end[i] = ceil((i+1)*isz/osz)
+            starts = [int(np.floor(i * isz / osz)) for i in range(osz)]
+            ends = [int(np.ceil((i + 1) * isz / osz)) for i in range(osz)]
+            slices = []
+            for s, e in zip(starts, ends):
+                seg = jax.lax.slice_in_dim(out, s, e, axis=2 + d)
+                red = jnp.mean(seg, axis=2 + d, keepdims=True) if avg else jnp.max(seg, axis=2 + d, keepdims=True)
+                slices.append(red)
+            out = jnp.concatenate(slices, axis=2 + d)
+        return out
+
+    return op(fn, ensure_tensor(x), _name="adaptive_pool")
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_pool(x, output_size, 1, avg=True)
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_pool(x, output_size, 2, avg=True)
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_pool(x, output_size, 3, avg=True)
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 1, avg=False)
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 2, avg=False)
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 3, avg=False)

@@ -1,0 +1,59 @@
+"""Functional bridge: run a Layer's forward as a pure function.
+
+This is the dygraph→static seam. Parity: ``@paddle.jit.to_static`` +
+``run_program`` op (reference: python/paddle/fluid/dygraph/dygraph_to_static/
+program_translator.py:239, partial_program.py) — but TPU-first: no AST
+transpiler; the layer's Python forward *is* the trace, parameters are bound
+to traced values, the tape is disabled (grads come from jax.grad over this
+pure function), and RNG is an explicit key.
+"""
+from __future__ import annotations
+
+import contextlib
+
+from ..framework import random as _random
+from ..framework.autograd import no_grad
+from ..framework.core import Tensor, _wrap_value, unwrap
+
+
+def _wrap_tree(x):
+    import jax
+
+    if isinstance(x, Tensor):
+        return x
+    if isinstance(x, (list, tuple)):
+        return type(x)(_wrap_tree(v) for v in x)
+    if isinstance(x, dict):
+        return {k: _wrap_tree(v) for k, v in x.items()}
+    if isinstance(x, (jax.Array,)) or hasattr(x, "dtype"):
+        return _wrap_value(x)
+    return x
+
+
+def unwrap_tree(x):
+    if isinstance(x, Tensor):
+        return x._value
+    if isinstance(x, (list, tuple)):
+        return type(x)(unwrap_tree(v) for v in x)
+    if isinstance(x, dict):
+        return {k: unwrap_tree(v) for k, v in x.items()}
+    return x
+
+
+def functional_call(layer, arrays, *args, training=False, rng=None, **kwargs):
+    """Run ``layer(*args)`` with params/buffers replaced by ``arrays``.
+
+    Pure w.r.t. ``arrays`` and ``args``; jit/grad-safe. ``rng`` (a PRNG key)
+    feeds Dropout etc. via the rng scope.
+    """
+    modes = [(l, l.training) for l in layer.sublayers(include_self=True)]
+    for l, _ in modes:
+        l.training = training
+    rng_ctx = _random.rng_scope(rng) if rng is not None else contextlib.nullcontext()
+    try:
+        with no_grad(), layer.bind(arrays), rng_ctx:
+            out = layer(*_wrap_tree(list(args)), **kwargs)
+    finally:
+        for l, was in modes:
+            l.training = was
+    return unwrap_tree(out)

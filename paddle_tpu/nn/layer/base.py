@@ -1,0 +1,363 @@
+"""Layer base class.
+
+Parity: ``paddle.nn.Layer`` (reference:
+python/paddle/fluid/dygraph/layers.py:84) — parameters, buffers, sublayers,
+state_dict, train/eval, forward hooks. TPU-first addition: every Layer is
+also a *functional* module — ``layer.functional()`` returns
+``(apply_fn, params)`` where apply_fn is pure and jit/pjit-able; parameters
+carry optional ``dist_spec`` (a PartitionSpec) consumed by the distributed
+jit path (GSPMD), replacing the reference's per-layer collective calls.
+"""
+from __future__ import annotations
+
+import contextlib
+from collections import OrderedDict
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from ...framework.core import Tensor, _wrap_value
+from ...framework.dtype import get_default_dtype
+from .. import initializer as I
+
+
+class Parameter(Tensor):
+    """Trainable tensor (parity: paddle.fluid.framework.Parameter)."""
+
+    def _init_from_value(self, value, name=""):
+        self._init(value, stop_gradient=False, name=name)
+        self.dist_spec = None  # optional jax PartitionSpec for pjit sharding
+        self.is_distributed = False
+
+
+def _make_param(value, name=""):
+    p = Parameter.__new__(Parameter)
+    p._init_from_value(value, name)
+    return p
+
+
+class Layer:
+    def __init__(self, name_scope=None, dtype="float32"):
+        self._parameters: "OrderedDict[str, Parameter]" = OrderedDict()
+        self._buffers: "OrderedDict[str, Tensor]" = OrderedDict()
+        self._sub_layers: "OrderedDict[str, Layer]" = OrderedDict()
+        self._non_persistable_buffer_names = set()
+        self._forward_pre_hooks: "OrderedDict[int, Callable]" = OrderedDict()
+        self._forward_post_hooks: "OrderedDict[int, Callable]" = OrderedDict()
+        self.training = True
+        self._dtype = dtype
+        self._name = name_scope or type(self).__name__
+
+    # -- construction -----------------------------------------------------
+    def create_parameter(self, shape, dtype=None, default_initializer=None, attr=None, is_bias=False):
+        dtype = dtype or self._dtype or get_default_dtype()
+        init = default_initializer
+        if attr is not None and getattr(attr, "initializer", None) is not None:
+            init = attr.initializer
+        if init is None:
+            init = I.Constant(0.0) if is_bias else I.XavierNormal()
+        value = init(shape, dtype)
+        return _make_param(value)
+
+    def create_tensor(self, value=None, dtype=None):
+        import jax.numpy as jnp
+
+        from ...framework.dtype import to_jax_dtype
+
+        if value is None:
+            value = jnp.zeros((), to_jax_dtype(dtype or self._dtype))
+        return _wrap_value(value)
+
+    def add_parameter(self, name, parameter):
+        if parameter is None:
+            self._parameters[name] = None
+        else:
+            self._parameters[name] = parameter
+        return parameter
+
+    def add_sublayer(self, name, sublayer):
+        self._sub_layers[str(name)] = sublayer
+        return sublayer
+
+    def register_buffer(self, name, tensor, persistable=True):
+        self._buffers[name] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names.add(name)
+        return tensor
+
+    # -- attribute routing -------------------------------------------------
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        layers = self.__dict__.get("_sub_layers")
+        buffers = self.__dict__.get("_buffers")
+        if isinstance(value, Parameter) and params is not None:
+            for d in (layers, buffers):
+                if d is not None and name in d:
+                    del d[name]
+            self.__dict__.pop(name, None)
+            params[name] = value
+        elif isinstance(value, Layer) and layers is not None:
+            for d in (params, buffers):
+                if d is not None and name in d:
+                    del d[name]
+            self.__dict__.pop(name, None)
+            layers[name] = value
+        else:
+            for d in (params, layers, buffers):
+                if d is not None and name in d:
+                    del d[name]
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(f"{type(self).__name__!r} object has no attribute {name!r}")
+
+    def __delattr__(self, name):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                del d[name]
+                return
+        object.__delattr__(self, name)
+
+    # -- iteration ---------------------------------------------------------
+    def named_parameters(self, prefix="", include_sublayers=True) -> Iterator[Tuple[str, Parameter]]:
+        for name, p in self._parameters.items():
+            if p is not None:
+                yield (f"{prefix}.{name}" if prefix else name), p
+        if include_sublayers:
+            for lname, layer in self._sub_layers.items():
+                if layer is None:
+                    continue
+                sub_prefix = f"{prefix}.{lname}" if prefix else lname
+                yield from layer.named_parameters(prefix=sub_prefix)
+
+    def parameters(self, include_sublayers=True) -> List[Parameter]:
+        return [p for _, p in self.named_parameters(include_sublayers=include_sublayers)]
+
+    def named_buffers(self, prefix="", include_sublayers=True):
+        for name, b in self._buffers.items():
+            if b is not None:
+                yield (f"{prefix}.{name}" if prefix else name), b
+        if include_sublayers:
+            for lname, layer in self._sub_layers.items():
+                if layer is None:
+                    continue
+                sub_prefix = f"{prefix}.{lname}" if prefix else lname
+                yield from layer.named_buffers(prefix=sub_prefix)
+
+    def buffers(self, include_sublayers=True):
+        return [b for _, b in self.named_buffers(include_sublayers=include_sublayers)]
+
+    def named_sublayers(self, prefix="", include_self=False):
+        if include_self:
+            yield prefix, self
+        for name, layer in self._sub_layers.items():
+            if layer is None:
+                continue
+            sub_prefix = f"{prefix}.{name}" if prefix else name
+            yield sub_prefix, layer
+            yield from layer.named_sublayers(prefix=sub_prefix)
+
+    def sublayers(self, include_self=False):
+        return [l for _, l in self.named_sublayers(include_self=include_self)]
+
+    def children(self):
+        return iter(l for l in self._sub_layers.values() if l is not None)
+
+    def named_children(self):
+        return iter((n, l) for n, l in self._sub_layers.items() if l is not None)
+
+    def apply(self, fn):
+        for layer in self.children():
+            layer.apply(fn)
+        fn(self)
+        return self
+
+    # -- mode --------------------------------------------------------------
+    def train(self):
+        self.training = True
+        for layer in self.children():
+            layer.train()
+        return self
+
+    def eval(self):
+        self.training = False
+        for layer in self.children():
+            layer.eval()
+        return self
+
+    # -- state dict --------------------------------------------------------
+    def state_dict(self, destination=None, include_sublayers=True, structured_name_prefix=""):
+        dest = destination if destination is not None else OrderedDict()
+        for name, p in self.named_parameters(prefix=structured_name_prefix.rstrip(".")):
+            dest[name] = p
+        for name, b in self.named_buffers(prefix=structured_name_prefix.rstrip(".")):
+            short = name.rsplit(".", 1)[-1]
+            # skip non-persistable buffers (parity: layers.py state_dict)
+            owner = self._locate_owner(name)
+            if owner is not None and short in owner._non_persistable_buffer_names:
+                continue
+            dest[name] = b
+        return dest
+
+    def _locate_owner(self, qualified_name):
+        parts = qualified_name.split(".")[:-1]
+        layer = self
+        for p in parts:
+            nxt = layer._sub_layers.get(p)
+            if nxt is None:
+                return None
+            layer = nxt
+        return layer
+
+    def set_state_dict(self, state_dict, use_structured_name=True):
+        own = self.state_dict()
+        missing, unexpected = [], []
+        for name, t in own.items():
+            if name in state_dict:
+                src = state_dict[name]
+                value = src._value if isinstance(src, Tensor) else np.asarray(src)
+                t.set_value(value)
+            else:
+                missing.append(name)
+        for name in state_dict:
+            if name not in own:
+                unexpected.append(name)
+        return missing, unexpected
+
+    load_dict = set_state_dict
+
+    # -- dtype/device ------------------------------------------------------
+    def to(self, device=None, dtype=None, blocking=None):
+        if dtype is not None:
+            self._cast_all(dtype)
+        return self
+
+    def astype(self, dtype):
+        self._cast_all(dtype)
+        return self
+
+    def float(self):
+        return self.astype("float32")
+
+    def bfloat16(self):
+        return self.astype("bfloat16")
+
+    def half(self):
+        return self.astype("float16")
+
+    def _cast_all(self, dtype):
+        from ...framework.dtype import to_jax_dtype
+        import jax.numpy as jnp
+
+        jdt = to_jax_dtype(dtype)
+        for p in self.parameters():
+            if jnp.issubdtype(p._value.dtype, jnp.floating):
+                p._value = p._value.astype(jdt)
+        for b in self.buffers():
+            if jnp.issubdtype(b._value.dtype, jnp.floating):
+                b._value = b._value.astype(jdt)
+
+    # -- hooks -------------------------------------------------------------
+    def register_forward_pre_hook(self, hook):
+        handle = _HookHandle(self._forward_pre_hooks)
+        self._forward_pre_hooks[handle.id] = hook
+        return handle
+
+    def register_forward_post_hook(self, hook):
+        handle = _HookHandle(self._forward_post_hooks)
+        self._forward_post_hooks[handle.id] = hook
+        return handle
+
+    # -- call --------------------------------------------------------------
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *args, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            result = hook(self, args)
+            if result is not None:
+                args = result if isinstance(result, tuple) else (result,)
+        out = self.forward(*args, **kwargs)
+        for hook in self._forward_post_hooks.values():
+            result = hook(self, args, out)
+            if result is not None:
+                out = result
+        return out
+
+    # -- functional bridge (TPU-first; see module docstring) ---------------
+    def raw_state(self) -> Dict[str, "np.ndarray"]:
+        """name -> raw jax.Array for all params+buffers (the jit pytree)."""
+        out = {}
+        for name, p in self.named_parameters():
+            out[name] = p._value
+        for name, b in self.named_buffers():
+            out[name] = b._value
+        return out
+
+    def param_arrays(self) -> Dict[str, "np.ndarray"]:
+        return {name: p._value for name, p in self.named_parameters()}
+
+    def buffer_arrays(self) -> Dict[str, "np.ndarray"]:
+        return {name: b._value for name, b in self.named_buffers()}
+
+    def dist_specs(self):
+        """name -> PartitionSpec (or None) for every parameter."""
+        return {name: getattr(p, "dist_spec", None) for name, p in self.named_parameters()}
+
+    @contextlib.contextmanager
+    def bind(self, arrays: Dict[str, object]):
+        """Temporarily replace param/buffer values with ``arrays`` (tracers
+        under jit). The layer's forward then runs functionally."""
+        handles = {}
+        for name, p in self.named_parameters():
+            if name in arrays:
+                handles[name] = (p, p._value)
+                p._value = arrays[name]
+        for name, b in self.named_buffers():
+            if name in arrays:
+                handles[name] = (b, b._value)
+                b._value = arrays[name]
+        try:
+            yield self
+        finally:
+            for t, old in handles.values():
+                t._value = old
+
+    def functional(self):
+        """Return ``(apply_fn, params, buffers)``; ``apply_fn(params, buffers,
+        *args, training=False, rng=None)`` is pure and jit-able."""
+        from ..functional_api import functional_call
+
+        params = self.param_arrays()
+        buffers = self.buffer_arrays()
+
+        def apply_fn(params, buffers, *args, training=False, rng=None, **kwargs):
+            return functional_call(self, {**params, **buffers}, *args, training=training, rng=rng, **kwargs)
+
+        return apply_fn, params, buffers
+
+    def __repr__(self):
+        extra = []
+        for name, layer in self._sub_layers.items():
+            extra.append(f"  ({name}): {layer!r}".replace("\n", "\n  "))
+        head = type(self).__name__
+        if not extra:
+            return f"{head}()"
+        return head + "(\n" + "\n".join(extra) + "\n)"
+
+
+class _HookHandle:
+    _next_id = [0]
+
+    def __init__(self, store):
+        self.id = _HookHandle._next_id[0]
+        _HookHandle._next_id[0] += 1
+        self._store = store
+
+    def remove(self):
+        self._store.pop(self.id, None)

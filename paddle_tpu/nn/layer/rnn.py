@@ -1,0 +1,269 @@
+"""Recurrent layers (parity: python/paddle/nn/layer/rnn.py).
+
+TPU-first: the time loop is a ``jax.lax.scan`` inside one primitive — XLA
+compiles the whole sequence as a single fused loop (the reference's cuDNN RNN
+kernels, paddle/phi/kernels/gpu/rnn_kernel.cu, have no other TPU analog).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor, _wrap_value
+from ...tensor._helpers import ensure_tensor, op, unwrap
+from .. import initializer as I
+from .base import Layer
+
+
+def _rnn_scan(cell_step, x, h0, time_major=False):
+    # x: [B, T, I] (batch-major) -> scan over T
+    def step(carry, xt):
+        new_carry, out = cell_step(carry, xt)
+        return new_carry, out
+
+    xs = x if time_major else jnp.swapaxes(x, 0, 1)
+    carry, outs = jax.lax.scan(step, h0, xs)
+    outs = outs if time_major else jnp.swapaxes(outs, 0, 1)
+    return outs, carry
+
+
+class SimpleRNNCell(Layer):
+    def __init__(self, input_size, hidden_size, activation="tanh", weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        std = 1.0 / hidden_size**0.5
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter([hidden_size, input_size], attr=weight_ih_attr, default_initializer=u)
+        self.weight_hh = self.create_parameter([hidden_size, hidden_size], attr=weight_hh_attr, default_initializer=u)
+        self.bias_ih = self.create_parameter([hidden_size], attr=bias_ih_attr, default_initializer=u)
+        self.bias_hh = self.create_parameter([hidden_size], attr=bias_hh_attr, default_initializer=u)
+        self.activation = activation
+
+    def forward(self, inputs, states=None):
+        h = states
+        if h is None:
+            from ...tensor.creation import zeros
+
+            h = zeros([inputs.shape[0], self.hidden_size])
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+
+        def fn(x, hh, wih, whh, bih, bhh):
+            return act(x @ wih.T + bih + hh @ whh.T + bhh)
+
+        out = op(fn, ensure_tensor(inputs), ensure_tensor(h), self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh, _name="rnn_cell")
+        return out, out
+
+
+class LSTMCell(Layer):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        std = 1.0 / hidden_size**0.5
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter([4 * hidden_size, input_size], attr=weight_ih_attr, default_initializer=u)
+        self.weight_hh = self.create_parameter([4 * hidden_size, hidden_size], attr=weight_hh_attr, default_initializer=u)
+        self.bias_ih = self.create_parameter([4 * hidden_size], attr=bias_ih_attr, default_initializer=u)
+        self.bias_hh = self.create_parameter([4 * hidden_size], attr=bias_hh_attr, default_initializer=u)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            from ...tensor.creation import zeros
+
+            b = inputs.shape[0]
+            states = (zeros([b, self.hidden_size]), zeros([b, self.hidden_size]))
+        h, c = states
+
+        def fn(x, hh, cc, wih, whh, bih, bhh):
+            gates = x @ wih.T + bih + hh @ whh.T + bhh
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            c2 = f * cc + i * g
+            h2 = o * jnp.tanh(c2)
+            return h2, c2
+
+        h2, c2 = op(fn, ensure_tensor(inputs), ensure_tensor(h), ensure_tensor(c), self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh, _name="lstm_cell")
+        return h2, (h2, c2)
+
+
+class GRUCell(Layer):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        std = 1.0 / hidden_size**0.5
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter([3 * hidden_size, input_size], attr=weight_ih_attr, default_initializer=u)
+        self.weight_hh = self.create_parameter([3 * hidden_size, hidden_size], attr=weight_hh_attr, default_initializer=u)
+        self.bias_ih = self.create_parameter([3 * hidden_size], attr=bias_ih_attr, default_initializer=u)
+        self.bias_hh = self.create_parameter([3 * hidden_size], attr=bias_hh_attr, default_initializer=u)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            from ...tensor.creation import zeros
+
+            states = zeros([inputs.shape[0], self.hidden_size])
+        h = states
+
+        def fn(x, hh, wih, whh, bih, bhh):
+            gi = x @ wih.T + bih
+            gh = hh @ whh.T + bhh
+            ir, iz, ic = jnp.split(gi, 3, axis=-1)
+            hr, hz, hc = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(ir + hr)
+            z = jax.nn.sigmoid(iz + hz)
+            c = jnp.tanh(ic + r * hc)
+            return (1 - z) * c + z * hh
+
+        h2 = op(fn, ensure_tensor(inputs), ensure_tensor(h), self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh, _name="gru_cell")
+        return h2, h2
+
+
+class _RNNBase(Layer):
+    MODE = "RNN"
+
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward", time_major=False, dropout=0.0, **kwargs):
+        super().__init__()
+        self.input_size, self.hidden_size, self.num_layers = input_size, hidden_size, num_layers
+        self.time_major = time_major
+        self.direction = direction
+        self.bidirect = direction in ("bidirect", "bidirectional")
+        ndir = 2 if self.bidirect else 1
+        self.ndir = ndir
+        std = 1.0 / hidden_size**0.5
+        u = I.Uniform(-std, std)
+        g = {"RNN": 1, "GRU": 3, "LSTM": 4}[self.MODE]
+        self._g = g
+        for l in range(num_layers):
+            for d in range(ndir):
+                in_sz = input_size if l == 0 else hidden_size * ndir
+                self.add_parameter(f"weight_ih_l{l}_d{d}", self.create_parameter([g * hidden_size, in_sz], default_initializer=u))
+                self.add_parameter(f"weight_hh_l{l}_d{d}", self.create_parameter([g * hidden_size, hidden_size], default_initializer=u))
+                self.add_parameter(f"bias_ih_l{l}_d{d}", self.create_parameter([g * hidden_size], default_initializer=u))
+                self.add_parameter(f"bias_hh_l{l}_d{d}", self.create_parameter([g * hidden_size], default_initializer=u))
+
+    def _cell(self, gates_fn, wih, whh, bih, bhh):
+        def step(carry, xt):
+            return gates_fn(carry, xt, wih, whh, bih, bhh)
+
+        return step
+
+    def _gates(self, carry, xt, wih, whh, bih, bhh):
+        if self.MODE == "RNN":
+            h = carry
+            h2 = jnp.tanh(xt @ wih.T + bih + h @ whh.T + bhh)
+            return h2, h2
+        if self.MODE == "GRU":
+            h = carry
+            gi = xt @ wih.T + bih
+            gh = h @ whh.T + bhh
+            ir, iz, ic = jnp.split(gi, 3, axis=-1)
+            hr, hz, hc = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(ir + hr)
+            z = jax.nn.sigmoid(iz + hz)
+            c = jnp.tanh(ic + r * hc)
+            h2 = (1 - z) * c + z * h
+            return h2, h2
+        h, c = carry
+        gates = xt @ wih.T + bih + h @ whh.T + bhh
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        g = jnp.tanh(g)
+        c2 = f * c + i * g
+        h2 = o * jnp.tanh(c2)
+        return (h2, c2), h2
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        x = ensure_tensor(inputs)
+        b = x.shape[0] if not self.time_major else x.shape[1]
+        params = []
+        for l in range(self.num_layers):
+            for d in range(self.ndir):
+                params += [
+                    getattr(self, f"weight_ih_l{l}_d{d}"),
+                    getattr(self, f"weight_hh_l{l}_d{d}"),
+                    getattr(self, f"bias_ih_l{l}_d{d}"),
+                    getattr(self, f"bias_hh_l{l}_d{d}"),
+                ]
+
+        mode = self.MODE
+        nl, nd, hs, tm = self.num_layers, self.ndir, self.hidden_size, self.time_major
+
+        def fn(xv, *pv):
+            def zero_state():
+                if mode == "LSTM":
+                    return (jnp.zeros((b, hs), xv.dtype), jnp.zeros((b, hs), xv.dtype))
+                return jnp.zeros((b, hs), xv.dtype)
+
+            out = xv
+            final_h, final_c = [], []
+            pi = 0
+            for l in range(nl):
+                dir_outs = []
+                for d in range(nd):
+                    wih, whh, bih, bhh = pv[pi : pi + 4]
+                    pi += 4
+                    seq = out if tm else jnp.swapaxes(out, 0, 1)
+                    if d == 1:
+                        seq = jnp.flip(seq, axis=0)
+
+                    def step(carry, xt, wih=wih, whh=whh, bih=bih, bhh=bhh):
+                        return self._gates(carry, xt, wih, whh, bih, bhh)
+
+                    carry, outs = jax.lax.scan(step, zero_state(), seq)
+                    if d == 1:
+                        outs = jnp.flip(outs, axis=0)
+                    dir_outs.append(outs if tm else jnp.swapaxes(outs, 0, 1))
+                    if mode == "LSTM":
+                        final_h.append(carry[0])
+                        final_c.append(carry[1])
+                    else:
+                        final_h.append(carry)
+                out = dir_outs[0] if nd == 1 else jnp.concatenate(dir_outs, axis=-1)
+            hstack = jnp.stack(final_h, axis=0)
+            if mode == "LSTM":
+                return out, hstack, jnp.stack(final_c, axis=0)
+            return out, hstack
+
+        res = op(fn, x, *params, _name=f"{mode.lower()}")
+        if mode == "LSTM":
+            out, h, c = res
+            return out, (h, c)
+        out, h = res
+        return out, h
+
+
+class SimpleRNN(_RNNBase):
+    MODE = "RNN"
+
+
+class LSTM(_RNNBase):
+    MODE = "LSTM"
+
+
+class GRU(_RNNBase):
+    MODE = "GRU"
+
+
+class RNN(Layer):
+    """Wrap a cell into a scan-based runner (parity: paddle.nn.RNN)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse, self.time_major = is_reverse, time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        x = ensure_tensor(inputs)
+        T = x.shape[0] if self.time_major else x.shape[1]
+        outs = []
+        states = initial_states
+        rng = range(T - 1, -1, -1) if self.is_reverse else range(T)
+        for tstep in rng:
+            xt = x[tstep] if self.time_major else x[:, tstep]
+            out, states = self.cell(xt, states)
+            outs.append(out)
+        if self.is_reverse:
+            outs = outs[::-1]
+        from ...tensor.manipulation import stack
+
+        return stack(outs, axis=0 if self.time_major else 1), states

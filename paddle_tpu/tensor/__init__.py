@@ -1,0 +1,123 @@
+"""paddle_tpu.tensor — the tensor op namespace.
+
+Parity: python/paddle/tensor/__init__.py, which also monkey-patches ~300
+methods onto Tensor (reference: python/paddle/tensor/__init__.py tensor_method_func
+list). Here the same patching wires methods + operator dunders.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, _wrap_value, primitive, unwrap
+from . import creation, linalg, logic, manipulation, math, search, stat
+from .creation import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+from .stat import *  # noqa: F401,F403
+
+_MODULES = (creation, math, manipulation, logic, search, stat, linalg)
+
+
+def _public_funcs():
+    out = {}
+    for m in _MODULES:
+        for name in dir(m):
+            if name.startswith("_"):
+                continue
+            fn = getattr(m, name)
+            if callable(fn) and getattr(fn, "__module__", "").startswith("paddle_tpu.tensor"):
+                out.setdefault(name, fn)
+    return out
+
+
+def _getitem(self, idx):
+    def norm(i):
+        if isinstance(i, Tensor):
+            return i._value
+        if isinstance(i, (list, np.ndarray)):
+            return jnp.asarray(i)
+        return i
+
+    if isinstance(idx, tuple):
+        jidx = tuple(norm(i) for i in idx)
+    else:
+        jidx = norm(idx)
+    return primitive(lambda v: v[jidx], self, _name="getitem")
+
+
+def _setitem(self, idx, value):
+    def norm(i):
+        if isinstance(i, Tensor):
+            return i._value
+        if isinstance(i, (list, np.ndarray)):
+            return jnp.asarray(i)
+        return i
+
+    jidx = tuple(norm(i) for i in idx) if isinstance(idx, tuple) else norm(idx)
+    val = unwrap(value)
+    self._value = self._value.at[jidx].set(val)
+
+
+def _binop(fn_name, reverse=False):
+    def method(self, other):
+        fn = getattr(math, fn_name)
+        if reverse:
+            return fn(other, self)
+        return fn(self, other)
+
+    return method
+
+
+def _cmpop(fn_name):
+    def method(self, other):
+        return getattr(logic, fn_name)(self, other)
+
+    return method
+
+
+def monkey_patch_tensor():
+    funcs = _public_funcs()
+    skip = {"Tensor", "to_tensor"}
+    for name, fn in funcs.items():
+        if name in skip or hasattr(Tensor, name):
+            continue
+        setattr(Tensor, name, fn)
+
+    Tensor.__add__ = _binop("add")
+    Tensor.__radd__ = _binop("add", reverse=True)
+    Tensor.__sub__ = _binop("subtract")
+    Tensor.__rsub__ = _binop("subtract", reverse=True)
+    Tensor.__mul__ = _binop("multiply")
+    Tensor.__rmul__ = _binop("multiply", reverse=True)
+    Tensor.__truediv__ = _binop("divide")
+    Tensor.__rtruediv__ = _binop("divide", reverse=True)
+    Tensor.__floordiv__ = _binop("floor_divide")
+    Tensor.__mod__ = _binop("remainder")
+    Tensor.__pow__ = _binop("pow")
+    Tensor.__rpow__ = _binop("pow", reverse=True)
+    Tensor.__matmul__ = _binop("matmul")
+    Tensor.__neg__ = lambda self: math.neg(self)
+    Tensor.__abs__ = lambda self: math.abs(self)
+    Tensor.__eq__ = _cmpop("equal")
+    Tensor.__ne__ = _cmpop("not_equal")
+    Tensor.__lt__ = _cmpop("less_than")
+    Tensor.__le__ = _cmpop("less_equal")
+    Tensor.__gt__ = _cmpop("greater_than")
+    Tensor.__ge__ = _cmpop("greater_equal")
+    Tensor.__and__ = _cmpop("logical_and")
+    Tensor.__or__ = _cmpop("logical_or")
+    Tensor.__invert__ = lambda self: logic.logical_not(self)
+    Tensor.__getitem__ = _getitem
+    Tensor.__setitem__ = _setitem
+    Tensor.T = property(lambda self: manipulation.t(self))
+    Tensor.dim = lambda self: self.ndim
+    Tensor.cpu = lambda self: self
+    Tensor.cuda = lambda self: self
+    Tensor.pin_memory = lambda self: self
+
+
+monkey_patch_tensor()

@@ -1,0 +1,41 @@
+"""Shared dispatch helpers for the tensor op namespace.
+
+Every public op is a thin wrapper: normalize arguments, then route through
+:func:`paddle_tpu.framework.core.primitive` which executes with jax.numpy and
+records the autograd tape. Paddle parity: the per-op branching in
+python/paddle/tensor/* (``in_dygraph_mode() -> _C_ops...``) collapses to this
+single path because there is no legacy/static split — jit tracing reuses the
+same jnp implementations.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, primitive, unwrap, _wrap_value, _to_array
+from ..framework.dtype import to_jax_dtype, convert_dtype, get_default_dtype
+
+__all__ = [
+    "Tensor",
+    "primitive",
+    "unwrap",
+    "_wrap_value",
+    "_to_array",
+    "to_jax_dtype",
+    "convert_dtype",
+    "get_default_dtype",
+    "ensure_tensor",
+    "op",
+]
+
+
+def ensure_tensor(x, dtype=None):
+    if isinstance(x, Tensor):
+        return x
+    t = Tensor.__new__(Tensor)
+    t._init(_to_array(x, dtype))
+    return t
+
+
+def op(fn, *args, _name="", **kwargs):
+    return primitive(fn, *args, _name=_name or getattr(fn, "__name__", "op"), **kwargs)

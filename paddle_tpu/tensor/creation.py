@@ -1,0 +1,227 @@
+"""Tensor creation ops (parity: python/paddle/tensor/creation.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ._helpers import (
+    Tensor,
+    _to_array,
+    _wrap_value,
+    ensure_tensor,
+    get_default_dtype,
+    op,
+    to_jax_dtype,
+    unwrap,
+)
+from ..framework import random as _random
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    t = Tensor(data, dtype=dtype)
+    t.stop_gradient = stop_gradient
+    return t
+
+
+def _shape_list(shape):
+    if isinstance(shape, Tensor):
+        return [int(s) for s in np.asarray(shape._value)]
+    if isinstance(shape, (int, np.integer)):
+        return [int(shape)]
+    return [int(unwrap(s)) if not isinstance(s, (int, np.integer)) else int(s) for s in shape]
+
+
+def zeros(shape, dtype=None, name=None):
+    return _wrap_value(jnp.zeros(_shape_list(shape), to_jax_dtype(dtype or get_default_dtype())))
+
+
+def ones(shape, dtype=None, name=None):
+    return _wrap_value(jnp.ones(_shape_list(shape), to_jax_dtype(dtype or get_default_dtype())))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    fill = unwrap(fill_value)
+    dt = to_jax_dtype(dtype) if dtype else None
+    return _wrap_value(jnp.full(_shape_list(shape), fill, dtype=dt))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype=dtype)
+
+
+def zeros_like(x, dtype=None, name=None):
+    return op(lambda v: jnp.zeros_like(v, dtype=to_jax_dtype(dtype) if dtype else None), ensure_tensor(x))
+
+
+def ones_like(x, dtype=None, name=None):
+    return op(lambda v: jnp.ones_like(v, dtype=to_jax_dtype(dtype) if dtype else None), ensure_tensor(x))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return op(
+        lambda v: jnp.full_like(v, unwrap(fill_value), dtype=to_jax_dtype(dtype) if dtype else None),
+        ensure_tensor(x),
+    )
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype=dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    if end is None:
+        start, end = 0, start
+    start, end, step = unwrap(start), unwrap(end), unwrap(step)
+    dt = to_jax_dtype(dtype) if dtype else None
+    return _wrap_value(jnp.arange(start, end, step, dtype=dt))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    dt = to_jax_dtype(dtype) if dtype else None
+    return _wrap_value(jnp.linspace(unwrap(start), unwrap(stop), int(unwrap(num)), dtype=dt))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    dt = to_jax_dtype(dtype) if dtype else None
+    return _wrap_value(jnp.logspace(unwrap(start), unwrap(stop), int(unwrap(num)), base=base, dtype=dt))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return _wrap_value(jnp.eye(num_rows, num_columns, dtype=to_jax_dtype(dtype or get_default_dtype())))
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    x = ensure_tensor(x)
+
+    def fn(v):
+        if v.ndim == 1 and padding_value != 0:
+            n = v.shape[0] + abs(offset)
+            base = jnp.full((n, n), padding_value, v.dtype)
+            return base + jnp.diag(v, k=offset) - jnp.diag(jnp.full(v.shape, padding_value, v.dtype), k=offset)
+        return jnp.diag(v, k=offset)
+
+    return op(fn, x)
+
+
+def diagflat(x, offset=0, name=None):
+    return op(lambda v: jnp.diagflat(v, k=offset), ensure_tensor(x))
+
+
+def tril(x, diagonal=0, name=None):
+    return op(lambda v: jnp.tril(v, k=diagonal), ensure_tensor(x))
+
+
+def triu(x, diagonal=0, name=None):
+    return op(lambda v: jnp.triu(v, k=diagonal), ensure_tensor(x))
+
+
+def meshgrid(*args, **kwargs):
+    tensors = args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) else args
+    vals = jnp.meshgrid(*[unwrap(ensure_tensor(t)) for t in tensors], indexing="ij")
+    return [_wrap_value(v) for v in vals]
+
+
+def clone(x, name=None):
+    return op(lambda v: v + jnp.zeros((), v.dtype), ensure_tensor(x))
+
+
+def assign(x, output=None):
+    val = _to_array(unwrap(x))
+    if output is not None:
+        output.set_value(val)
+        return output
+    return _wrap_value(val)
+
+
+def tril_indices(row, col, offset=0, dtype="int64"):
+    r, c = jnp.tril_indices(row, k=offset, m=col)
+    return _wrap_value(jnp.stack([r, c]).astype(to_jax_dtype(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    r, c = jnp.triu_indices(row, k=offset, m=col or row)
+    return _wrap_value(jnp.stack([r, c]).astype(to_jax_dtype(dtype)))
+
+
+def complex(real, imag, name=None):
+    return op(lambda r, i: jax.lax.complex(r, i), ensure_tensor(real), ensure_tensor(imag))
+
+
+# ---- random creation (parity: python/paddle/tensor/random.py) ------------
+
+
+def rand(shape, dtype=None, name=None):
+    return uniform(shape, dtype=dtype, min=0.0, max=1.0)
+
+
+def randn(shape, dtype=None, name=None):
+    return standard_normal(shape, dtype=dtype)
+
+
+def standard_normal(shape, dtype=None, name=None):
+    key = _random.split_key()
+    dt = to_jax_dtype(dtype or get_default_dtype())
+    return _wrap_value(jax.random.normal(key, _shape_list(shape), dtype=dt))
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m, s = ensure_tensor(mean), ensure_tensor(std)
+        shp = jnp.broadcast_shapes(tuple(m.shape), tuple(s.shape))
+        key = _random.split_key()
+        noise_dt = m._value.dtype if jnp.issubdtype(m._value.dtype, jnp.floating) else jnp.float32
+        noise = jax.random.normal(key, shp, dtype=noise_dt)
+        return op(lambda mv, sv: mv + sv * noise, m, s)
+    key = _random.split_key()
+    dt = to_jax_dtype(get_default_dtype())
+    return _wrap_value(mean + std * jax.random.normal(key, _shape_list(shape or [1]), dtype=dt))
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    key = jax.random.key(seed) if seed else _random.split_key()
+    dt = to_jax_dtype(dtype or get_default_dtype())
+    return _wrap_value(jax.random.uniform(key, _shape_list(shape), dtype=dt, minval=min, maxval=max))
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    key = _random.split_key()
+    return _wrap_value(jax.random.randint(key, _shape_list(shape), low, high, dtype=to_jax_dtype(dtype)))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    x = ensure_tensor(x)
+    return randint(low, high, shape=x.shape, dtype=dtype or x.dtype)
+
+
+def randperm(n, dtype="int64", name=None):
+    key = _random.split_key()
+    return _wrap_value(jax.random.permutation(key, n).astype(to_jax_dtype(dtype)))
+
+
+def bernoulli(x, name=None):
+    x = ensure_tensor(x)
+    key = _random.split_key()
+    return _wrap_value(jax.random.bernoulli(key, unwrap(x)).astype(x._value.dtype))
+
+
+def poisson(x, name=None):
+    x = ensure_tensor(x)
+    key = _random.split_key()
+    return _wrap_value(jax.random.poisson(key, unwrap(x)).astype(x._value.dtype))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    x = ensure_tensor(x)
+    key = _random.split_key()
+    v = unwrap(x)
+    logits = jnp.log(jnp.maximum(v, 1e-30))
+    if replacement:
+        out = jax.random.categorical(key, logits, axis=-1, shape=(*v.shape[:-1], num_samples))
+    else:
+        # Gumbel top-k trick for sampling without replacement
+        g = jax.random.gumbel(key, v.shape)
+        _, out = jax.lax.top_k(logits + g, num_samples)
+    return _wrap_value(out.astype(to_jax_dtype("int64")))

@@ -1,0 +1,145 @@
+"""Linear algebra ops (parity: python/paddle/tensor/linalg.py).
+
+matmul/bmm live in math.py (re-exported); decompositions map to
+jax.numpy.linalg which XLA lowers natively (no cuSOLVER dynload needed —
+reference: paddle/fluid/platform/dynload/cusolver.h).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ._helpers import ensure_tensor, op, unwrap, _wrap_value
+from .math import matmul, bmm, dot, mv, mm, addmm, einsum  # noqa: F401  (re-export)
+
+
+def norm(x, p="fro", axis=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+
+    def fn(v):
+        if p == "fro" and axis is None:
+            return jnp.sqrt(jnp.sum(v * v))
+        if axis is None:
+            flat = v.reshape(-1)
+            return jnp.linalg.norm(flat, ord=p)
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        return jnp.linalg.norm(v, ord=p if p != "fro" else "fro" if isinstance(ax, tuple) else 2, axis=ax, keepdims=keepdim)
+
+    return op(fn, x, _name="norm")
+
+
+def dist(x, y, p=2, name=None):
+    return op(lambda a, b: jnp.linalg.norm((a - b).reshape(-1), ord=p), ensure_tensor(x), ensure_tensor(y), _name="dist")
+
+
+def cross(x, y, axis=9, name=None):
+    x = ensure_tensor(x)
+    ax = axis if axis != 9 else next(i for i, s in enumerate(x.shape) if s == 3)
+    return op(lambda a, b: jnp.cross(a, b, axis=ax), x, ensure_tensor(y), _name="cross")
+
+
+def cholesky(x, upper=False, name=None):
+    def fn(v):
+        l = jnp.linalg.cholesky(v)
+        return jnp.swapaxes(l, -1, -2) if upper else l
+
+    return op(fn, ensure_tensor(x), _name="cholesky")
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def fn(b, l):
+        lo = jnp.swapaxes(l, -1, -2) if upper else l
+        z = jax.scipy.linalg.solve_triangular(lo, b, lower=True)
+        return jax.scipy.linalg.solve_triangular(jnp.swapaxes(lo, -1, -2), z, lower=False)
+
+    return op(fn, ensure_tensor(x), ensure_tensor(y), _name="cholesky_solve")
+
+
+def inverse(x, name=None):
+    return op(jnp.linalg.inv, ensure_tensor(x), _name="inverse")
+
+
+inv = inverse
+
+
+def det(x, name=None):
+    return op(jnp.linalg.det, ensure_tensor(x), _name="det")
+
+
+def slogdet(x, name=None):
+    x = ensure_tensor(x)
+
+    def fn(v):
+        sign, logdet = jnp.linalg.slogdet(v)
+        return jnp.stack([sign, logdet])
+
+    return op(fn, x, _name="slogdet")
+
+
+def svd(x, full_matrices=False, name=None):
+    return op(lambda v: jnp.linalg.svd(v, full_matrices=full_matrices), ensure_tensor(x), _name="svd")
+
+
+def qr(x, mode="reduced", name=None):
+    return op(lambda v: jnp.linalg.qr(v, mode=mode), ensure_tensor(x), _name="qr")
+
+
+def eig(x, name=None):
+    v = unwrap(ensure_tensor(x))
+    w, vec = jnp.linalg.eig(v)
+    return _wrap_value(w), _wrap_value(vec)
+
+
+def eigh(x, UPLO="L", name=None):
+    return op(lambda v: jnp.linalg.eigh(v, UPLO=UPLO), ensure_tensor(x), _name="eigh")
+
+
+def eigvals(x, name=None):
+    return _wrap_value(jnp.linalg.eigvals(unwrap(ensure_tensor(x))))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return op(lambda v: jnp.linalg.eigvalsh(v, UPLO=UPLO), ensure_tensor(x), _name="eigvalsh")
+
+
+def solve(x, y, name=None):
+    return op(jnp.linalg.solve, ensure_tensor(x), ensure_tensor(y), _name="solve")
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    def fn(a, b):
+        return jax.scipy.linalg.solve_triangular(a, b, lower=not upper, trans=1 if transpose else 0, unit_diagonal=unitriangular)
+
+    return op(fn, ensure_tensor(x), ensure_tensor(y), _name="triangular_solve")
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    v, w = unwrap(ensure_tensor(x)), unwrap(ensure_tensor(y))
+    sol, res, rank_, sv = jnp.linalg.lstsq(v, w, rcond=rcond)
+    return _wrap_value(sol), _wrap_value(res), _wrap_value(rank_), _wrap_value(sv)
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return op(lambda v: jnp.linalg.pinv(v, rtol=rcond, hermitian=hermitian), ensure_tensor(x), _name="pinv")
+
+
+def matrix_power(x, n, name=None):
+    return op(lambda v: jnp.linalg.matrix_power(v, n), ensure_tensor(x), _name="matrix_power")
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return _wrap_value(jnp.linalg.matrix_rank(unwrap(ensure_tensor(x)), rtol=tol))
+
+
+def multi_dot(x, name=None):
+    tensors = [ensure_tensor(t) for t in x]
+    return op(lambda *vals: jnp.linalg.multi_dot(list(vals)), *tensors, _name="multi_dot")
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    v = unwrap(ensure_tensor(x))
+    lu_, piv = jax.scipy.linalg.lu_factor(v)
+    outs = (_wrap_value(lu_), _wrap_value(piv.astype(jnp.int32)))
+    if get_infos:
+        outs = outs + (_wrap_value(jnp.zeros((), jnp.int32)),)
+    return outs

@@ -1,0 +1,86 @@
+"""Comparison / logical ops (parity: python/paddle/tensor/logic.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ._helpers import Tensor, ensure_tensor, op, unwrap, _wrap_value
+
+
+def _cmp(fn, x, y, name=""):
+    return op(fn, ensure_tensor(x), ensure_tensor(y), _name=name)
+
+
+def equal(x, y, name=None):
+    return _cmp(jnp.equal, x, y, "equal")
+
+
+def not_equal(x, y, name=None):
+    return _cmp(jnp.not_equal, x, y, "not_equal")
+
+
+def less_than(x, y, name=None):
+    return _cmp(jnp.less, x, y, "less_than")
+
+
+def less_equal(x, y, name=None):
+    return _cmp(jnp.less_equal, x, y, "less_equal")
+
+
+def greater_than(x, y, name=None):
+    return _cmp(jnp.greater, x, y, "greater_than")
+
+
+def greater_equal(x, y, name=None):
+    return _cmp(jnp.greater_equal, x, y, "greater_equal")
+
+
+def logical_and(x, y, out=None, name=None):
+    return _cmp(jnp.logical_and, x, y, "logical_and")
+
+
+def logical_or(x, y, out=None, name=None):
+    return _cmp(jnp.logical_or, x, y, "logical_or")
+
+
+def logical_xor(x, y, out=None, name=None):
+    return _cmp(jnp.logical_xor, x, y, "logical_xor")
+
+
+def logical_not(x, out=None, name=None):
+    return op(jnp.logical_not, ensure_tensor(x), _name="logical_not")
+
+
+def bitwise_and(x, y, out=None, name=None):
+    return _cmp(jnp.bitwise_and, x, y, "bitwise_and")
+
+
+def bitwise_or(x, y, out=None, name=None):
+    return _cmp(jnp.bitwise_or, x, y, "bitwise_or")
+
+
+def bitwise_xor(x, y, out=None, name=None):
+    return _cmp(jnp.bitwise_xor, x, y, "bitwise_xor")
+
+
+def bitwise_not(x, out=None, name=None):
+    return op(jnp.bitwise_not, ensure_tensor(x), _name="bitwise_not")
+
+
+def equal_all(x, y, name=None):
+    return _wrap_value(jnp.array_equal(unwrap(ensure_tensor(x)), unwrap(ensure_tensor(y))))
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return _wrap_value(jnp.allclose(unwrap(ensure_tensor(x)), unwrap(ensure_tensor(y)), rtol=rtol, atol=atol, equal_nan=equal_nan))
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return _cmp(lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan), x, y, "isclose")
+
+
+def is_empty(x, name=None):
+    return _wrap_value(jnp.asarray(ensure_tensor(x).size == 0))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)

@@ -1,0 +1,371 @@
+"""Shape/layout manipulation ops (parity: python/paddle/tensor/manipulation.py)."""
+from __future__ import annotations
+
+import builtins
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+slice_builtin = builtins.slice
+
+from ._helpers import Tensor, ensure_tensor, op, to_jax_dtype, unwrap, _wrap_value
+
+
+def cast(x, dtype):
+    dt = to_jax_dtype(dtype)
+    x = ensure_tensor(x)
+    src_float = jnp.issubdtype(x._value.dtype, jnp.floating)
+    dst_float = jnp.issubdtype(np.dtype(dt), np.floating) or dt == jnp.bfloat16
+    if src_float and dst_float:
+        return op(lambda v: v.astype(dt), x, _name="cast")
+    # non-differentiable cast
+    return _wrap_value(x._value.astype(dt))
+
+
+def reshape(x, shape, name=None):
+    shape = [int(unwrap(s)) if not isinstance(s, int) else s for s in shape]
+    return op(lambda v: jnp.reshape(v, shape), ensure_tensor(x), _name="reshape")
+
+
+def reshape_(x, shape, name=None):
+    x._value = jnp.reshape(x._value, shape)
+    return x
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    x = ensure_tensor(x)
+    nd = x.ndim
+
+    def fn(v):
+        s = start_axis % nd if nd else 0
+        e = stop_axis % nd if nd else 0
+        new_shape = list(v.shape[:s]) + [-1] + list(v.shape[e + 1 :])
+        return jnp.reshape(v, new_shape)
+
+    return op(fn, x, _name="flatten")
+
+
+def transpose(x, perm=None, name=None):
+    return op(lambda v: jnp.transpose(v, perm), ensure_tensor(x), _name="transpose")
+
+
+def t(x, name=None):
+    return op(lambda v: v.T, ensure_tensor(x), _name="t")
+
+
+def moveaxis(x, source, destination, name=None):
+    return op(lambda v: jnp.moveaxis(v, source, destination), ensure_tensor(x), _name="moveaxis")
+
+
+def swapaxes(x, axis1, axis2, name=None):
+    return op(lambda v: jnp.swapaxes(v, axis1, axis2), ensure_tensor(x), _name="swapaxes")
+
+
+def squeeze(x, axis=None, name=None):
+    def fn(v):
+        if axis is None:
+            return jnp.squeeze(v)
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        axes = tuple(a % v.ndim for a in axes if v.shape[a % v.ndim] == 1)
+        return jnp.squeeze(v, axis=axes) if axes else v
+
+    return op(fn, ensure_tensor(x), _name="squeeze")
+
+
+def unsqueeze(x, axis, name=None):
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    return op(lambda v: jnp.expand_dims(v, tuple(axes)), ensure_tensor(x), _name="unsqueeze")
+
+
+def concat(x, axis=0, name=None):
+    tensors = [ensure_tensor(t) for t in x]
+    ax = int(unwrap(axis))
+    return op(lambda *vals: jnp.concatenate(vals, axis=ax), *tensors, _name="concat")
+
+
+def stack(x, axis=0, name=None):
+    tensors = [ensure_tensor(t) for t in x]
+    return op(lambda *vals: jnp.stack(vals, axis=axis), *tensors, _name="stack")
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    x = ensure_tensor(x)
+    ax = int(unwrap(axis))
+    dim = x.shape[ax]
+    if isinstance(num_or_sections, int):
+        if dim % num_or_sections != 0:
+            raise ValueError(
+                f"split: dimension {ax} of size {dim} is not divisible by num_or_sections={num_or_sections}"
+            )
+        sections = [dim // num_or_sections] * num_or_sections
+    else:
+        sections = [int(unwrap(s)) for s in num_or_sections]
+        neg = [i for i, s in enumerate(sections) if s < 0]
+        if neg:
+            sections[neg[0]] = dim - sum(s for s in sections if s >= 0)
+    offsets = np.cumsum([0] + sections)
+
+    def fn(v):
+        return tuple(jax.lax.slice_in_dim(v, int(offsets[i]), int(offsets[i + 1]), axis=ax) for i in range(len(sections)))
+
+    return list(op(fn, x, _name="split"))
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def unbind(x, axis=0, name=None):
+    x = ensure_tensor(x)
+    n = x.shape[axis]
+
+    def fn(v):
+        return tuple(jnp.squeeze(s, axis=axis) for s in jnp.split(v, n, axis=axis))
+
+    return list(op(fn, x, _name="unbind"))
+
+
+def tile(x, repeat_times, name=None):
+    reps = [int(unwrap(r)) for r in repeat_times] if isinstance(repeat_times, (list, tuple)) else int(repeat_times)
+    return op(lambda v: jnp.tile(v, reps), ensure_tensor(x), _name="tile")
+
+
+def expand(x, shape, name=None):
+    x = ensure_tensor(x)
+    shape = [int(unwrap(s)) for s in shape]
+
+    def fn(v):
+        tgt = list(shape)
+        for i in range(len(tgt)):
+            if tgt[i] == -1:
+                tgt[i] = v.shape[i - len(tgt) + v.ndim] if i - len(tgt) + v.ndim >= 0 else 1
+        return jnp.broadcast_to(v, tgt)
+
+    return op(fn, x, _name="expand")
+
+
+def expand_as(x, y, name=None):
+    y = ensure_tensor(y)
+    return expand(x, y.shape)
+
+
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+def broadcast_tensors(inputs, name=None):
+    tensors = [ensure_tensor(t) for t in inputs]
+    shape = jnp.broadcast_shapes(*[tuple(t.shape) for t in tensors])
+    return [op(lambda v: jnp.broadcast_to(v, shape), t) for t in tensors]
+
+
+def gather(x, index, axis=0, name=None):
+    idx = unwrap(ensure_tensor(index))
+    ax = int(unwrap(axis))
+    return op(lambda v: jnp.take(v, idx, axis=ax), ensure_tensor(x), _name="gather")
+
+
+def gather_nd(x, index, name=None):
+    idx = unwrap(ensure_tensor(index))
+
+    def fn(v):
+        return v[tuple(jnp.moveaxis(idx, -1, 0))]
+
+    return op(fn, ensure_tensor(x), _name="gather_nd")
+
+
+def take_along_axis(arr, indices, axis, name=None):
+    idx = unwrap(ensure_tensor(indices))
+    return op(lambda v: jnp.take_along_axis(v, idx, axis=axis), ensure_tensor(arr), _name="take_along_axis")
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):
+    idx = unwrap(ensure_tensor(indices))
+
+    def fn(v, val):
+        val = jnp.broadcast_to(val, idx.shape).astype(v.dtype)
+        dims = list(range(v.ndim))
+        ii = jnp.meshgrid(*[jnp.arange(s) for s in idx.shape], indexing="ij")
+        ii[axis] = idx
+        if reduce == "assign":
+            return v.at[tuple(ii)].set(val)
+        if reduce == "add":
+            return v.at[tuple(ii)].add(val)
+        if reduce == "multiply":
+            return v.at[tuple(ii)].multiply(val)
+        raise ValueError(reduce)
+
+    return op(fn, ensure_tensor(arr), ensure_tensor(values), _name="put_along_axis")
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    idx = unwrap(ensure_tensor(index)).reshape(-1)
+
+    def fn(v, u):
+        if overwrite:
+            return v.at[idx].set(u)
+        return v.at[idx].add(u)
+
+    return op(fn, ensure_tensor(x), ensure_tensor(updates), _name="scatter")
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    idx = unwrap(ensure_tensor(index))
+
+    def fn(v, u):
+        return v.at[tuple(jnp.moveaxis(idx, -1, 0))].add(u)
+
+    return op(fn, ensure_tensor(x), ensure_tensor(updates), _name="scatter_nd_add")
+
+
+def scatter_nd(index, updates, shape, name=None):
+    idx = unwrap(ensure_tensor(index))
+
+    def fn(u):
+        z = jnp.zeros(shape, u.dtype)
+        return z.at[tuple(jnp.moveaxis(idx, -1, 0))].add(u)
+
+    return op(fn, ensure_tensor(updates), _name="scatter_nd")
+
+
+def index_select(x, index, axis=0, name=None):
+    return gather(x, index, axis)
+
+
+def index_sample(x, index, name=None):
+    idx = unwrap(ensure_tensor(index))
+    return op(lambda v: jnp.take_along_axis(v, idx, axis=1), ensure_tensor(x), _name="index_sample")
+
+
+def masked_select(x, mask, name=None):
+    m = unwrap(ensure_tensor(mask))
+    # dynamic output shape: eager-only (documented; same restriction as XLA)
+    return op(lambda v: v[m], ensure_tensor(x), _name="masked_select")
+
+
+def masked_fill(x, mask, value, name=None):
+    m = unwrap(ensure_tensor(mask))
+    val = unwrap(value)
+    return op(lambda v: jnp.where(m, jnp.asarray(val, v.dtype), v), ensure_tensor(x), _name="masked_fill")
+
+
+def where(condition, x=None, y=None, name=None):
+    cond = unwrap(ensure_tensor(condition))
+    if x is None and y is None:
+        return tuple(_wrap_value(i) for i in jnp.nonzero(cond))
+    return op(lambda a, b: jnp.where(cond, a, b), ensure_tensor(x), ensure_tensor(y), _name="where")
+
+
+def roll(x, shifts, axis=None, name=None):
+    return op(lambda v: jnp.roll(v, shifts, axis=axis), ensure_tensor(x), _name="roll")
+
+
+def flip(x, axis, name=None):
+    return op(lambda v: jnp.flip(v, axis=axis), ensure_tensor(x), _name="flip")
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return op(lambda v: jnp.rot90(v, k=k, axes=tuple(axes)), ensure_tensor(x), _name="rot90")
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    r = unwrap(repeats)
+    return op(lambda v: jnp.repeat(v, r, axis=axis), ensure_tensor(x), _name="repeat_interleave")
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None, dtype="int64", name=None):
+    v = unwrap(ensure_tensor(x))
+    res = jnp.unique(v, return_index=return_index, return_inverse=return_inverse, return_counts=return_counts, axis=axis)
+    if isinstance(res, tuple):
+        return tuple(_wrap_value(r) for r in res)
+    return _wrap_value(res)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None, dtype="int64", name=None):
+    v = np.asarray(unwrap(ensure_tensor(x)))
+    if axis is None:
+        v = v.reshape(-1)
+    keep = np.concatenate([[True], v[1:] != v[:-1]]) if v.ndim == 1 else None
+    out = v[keep]
+    outs = [_wrap_value(jnp.asarray(out))]
+    if return_inverse:
+        inv = np.cumsum(keep) - 1
+        outs.append(_wrap_value(jnp.asarray(inv)))
+    if return_counts:
+        idx = np.flatnonzero(keep)
+        counts = np.diff(np.append(idx, len(v)))
+        outs.append(_wrap_value(jnp.asarray(counts)))
+    return tuple(outs) if len(outs) > 1 else outs[0]
+
+
+def slice(input, axes, starts, ends, name=None):
+    x = ensure_tensor(input)
+    starts = [int(unwrap(s)) for s in starts]
+    ends = [int(unwrap(e)) for e in ends]
+
+    def fn(v):
+        idx = [slice_builtin(None)] * v.ndim
+        for ax, s, e in zip(axes, starts, ends):
+            dim = v.shape[ax]
+            s2 = max(s + dim, 0) if s < 0 else min(s, dim)
+            e2 = max(e + dim, 0) if e < 0 else min(e, dim)
+            idx[ax] = slice_builtin(s2, e2)
+        return v[tuple(idx)]
+
+    return op(fn, x, _name="slice")
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    x = ensure_tensor(x)
+
+    def fn(v):
+        idx = [slice_builtin(None)] * v.ndim
+        for ax, s, e, st in zip(axes, starts, ends, strides):
+            idx[ax] = slice_builtin(int(unwrap(s)), int(unwrap(e)), int(unwrap(st)))
+        return v[tuple(idx)]
+
+    return op(fn, x, _name="strided_slice")
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    v = unwrap(ensure_tensor(input))
+    shard_size = (index_num + nshards - 1) // nshards
+    in_shard = (v // shard_size) == shard_id
+    return _wrap_value(jnp.where(in_shard, v % shard_size, ignore_value))
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    x = ensure_tensor(x)
+    shape = [int(unwrap(s)) for s in shape]
+    offsets = [int(unwrap(o)) for o in (offsets or [0] * len(shape))]
+
+    def fn(v):
+        idx = tuple(slice_builtin(o, o + (s if s != -1 else v.shape[i] - o)) for i, (o, s) in enumerate(zip(offsets, shape)))
+        return v[idx]
+
+    return op(fn, x, _name="crop")
+
+
+def as_complex(x, name=None):
+    return op(lambda v: jax.lax.complex(v[..., 0], v[..., 1]), ensure_tensor(x), _name="as_complex")
+
+
+def as_real(x, name=None):
+    return op(lambda v: jnp.stack([jnp.real(v), jnp.imag(v)], axis=-1), ensure_tensor(x), _name="as_real")
+
+
+def tensordot(x, y, axes=2, name=None):
+    return op(lambda a, b: jnp.tensordot(a, b, axes=axes), ensure_tensor(x), ensure_tensor(y), _name="tensordot")
+
+
+def numel(x, name=None):
+    return _wrap_value(jnp.asarray(int(np.prod(ensure_tensor(x).shape)) if ensure_tensor(x).shape else 1))
+
+
+def rank(x):
+    return _wrap_value(jnp.asarray(ensure_tensor(x).ndim))
+
+
+def shape(x):
+    return _wrap_value(jnp.asarray(ensure_tensor(x).shape, dtype=jnp.int32))

@@ -1,0 +1,520 @@
+"""Elementwise + reduction math ops (parity: python/paddle/tensor/math.py).
+
+Each op is a jnp lambda routed through the tape (`framework/core.primitive`);
+XLA provides the kernel and its gradient. Reference kernel equivalents live in
+paddle/phi/kernels/* — none of that is needed on TPU.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ._helpers import Tensor, ensure_tensor, op, to_jax_dtype, unwrap
+
+
+def _binary(fn, x, y, name=""):
+    return op(fn, ensure_tensor(x), ensure_tensor(y), _name=name)
+
+
+def _unary(fn, x, name=""):
+    return op(fn, ensure_tensor(x), _name=name)
+
+
+# ---- elementwise binary ---------------------------------------------------
+
+def add(x, y, name=None):
+    return _binary(jnp.add, x, y, "add")
+
+
+def subtract(x, y, name=None):
+    return _binary(jnp.subtract, x, y, "subtract")
+
+
+def multiply(x, y, name=None):
+    return _binary(jnp.multiply, x, y, "multiply")
+
+
+def divide(x, y, name=None):
+    return _binary(jnp.divide, x, y, "divide")
+
+
+def floor_divide(x, y, name=None):
+    return _binary(jnp.floor_divide, x, y, "floor_divide")
+
+
+def remainder(x, y, name=None):
+    return _binary(jnp.remainder, x, y, "remainder")
+
+
+mod = remainder
+floor_mod = remainder
+
+
+def pow(x, y, name=None):
+    return _binary(jnp.power, x, y, "pow")
+
+
+def maximum(x, y, name=None):
+    return _binary(jnp.maximum, x, y, "maximum")
+
+
+def minimum(x, y, name=None):
+    return _binary(jnp.minimum, x, y, "minimum")
+
+
+def fmax(x, y, name=None):
+    return _binary(jnp.fmax, x, y, "fmax")
+
+
+def fmin(x, y, name=None):
+    return _binary(jnp.fmin, x, y, "fmin")
+
+
+def atan2(x, y, name=None):
+    return _binary(jnp.arctan2, x, y, "atan2")
+
+
+def kron(x, y, name=None):
+    return _binary(jnp.kron, x, y, "kron")
+
+
+def inner(x, y, name=None):
+    return _binary(jnp.inner, x, y, "inner")
+
+
+def outer(x, y, name=None):
+    return _binary(jnp.outer, x, y, "outer")
+
+
+def logaddexp(x, y, name=None):
+    return _binary(jnp.logaddexp, x, y, "logaddexp")
+
+
+def heaviside(x, y, name=None):
+    return _binary(jnp.heaviside, x, y, "heaviside")
+
+
+def copysign(x, y, name=None):
+    return _binary(jnp.copysign, x, y, "copysign")
+
+
+def nextafter(x, y, name=None):
+    return _binary(jnp.nextafter, x, y, "nextafter")
+
+
+def hypot(x, y, name=None):
+    return _binary(jnp.hypot, x, y, "hypot")
+
+
+def gcd(x, y, name=None):
+    return _binary(jnp.gcd, x, y, "gcd")
+
+
+def lcm(x, y, name=None):
+    return _binary(jnp.lcm, x, y, "lcm")
+
+
+# ---- elementwise unary ----------------------------------------------------
+
+def sqrt(x, name=None):
+    return _unary(jnp.sqrt, x, "sqrt")
+
+
+def rsqrt(x, name=None):
+    return _unary(jax.lax.rsqrt, x, "rsqrt")
+
+
+def exp(x, name=None):
+    return _unary(jnp.exp, x, "exp")
+
+
+def expm1(x, name=None):
+    return _unary(jnp.expm1, x, "expm1")
+
+
+def log(x, name=None):
+    return _unary(jnp.log, x, "log")
+
+
+def log2(x, name=None):
+    return _unary(jnp.log2, x, "log2")
+
+
+def log10(x, name=None):
+    return _unary(jnp.log10, x, "log10")
+
+
+def log1p(x, name=None):
+    return _unary(jnp.log1p, x, "log1p")
+
+
+def abs(x, name=None):
+    return _unary(jnp.abs, x, "abs")
+
+
+def neg(x, name=None):
+    return _unary(jnp.negative, x, "neg")
+
+
+def sign(x, name=None):
+    return _unary(jnp.sign, x, "sign")
+
+
+def floor(x, name=None):
+    return _unary(jnp.floor, x, "floor")
+
+
+def ceil(x, name=None):
+    return _unary(jnp.ceil, x, "ceil")
+
+
+def round(x, name=None):
+    return _unary(jnp.round, x, "round")
+
+
+def trunc(x, name=None):
+    return _unary(jnp.trunc, x, "trunc")
+
+
+def frac(x, name=None):
+    return _unary(lambda v: v - jnp.trunc(v), x, "frac")
+
+
+def sin(x, name=None):
+    return _unary(jnp.sin, x, "sin")
+
+
+def cos(x, name=None):
+    return _unary(jnp.cos, x, "cos")
+
+
+def tan(x, name=None):
+    return _unary(jnp.tan, x, "tan")
+
+
+def asin(x, name=None):
+    return _unary(jnp.arcsin, x, "asin")
+
+
+def acos(x, name=None):
+    return _unary(jnp.arccos, x, "acos")
+
+
+def atan(x, name=None):
+    return _unary(jnp.arctan, x, "atan")
+
+
+def sinh(x, name=None):
+    return _unary(jnp.sinh, x, "sinh")
+
+
+def cosh(x, name=None):
+    return _unary(jnp.cosh, x, "cosh")
+
+
+def tanh(x, name=None):
+    return _unary(jnp.tanh, x, "tanh")
+
+
+def asinh(x, name=None):
+    return _unary(jnp.arcsinh, x, "asinh")
+
+
+def acosh(x, name=None):
+    return _unary(jnp.arccosh, x, "acosh")
+
+
+def atanh(x, name=None):
+    return _unary(jnp.arctanh, x, "atanh")
+
+
+def reciprocal(x, name=None):
+    return _unary(jnp.reciprocal, x, "reciprocal")
+
+
+def square(x, name=None):
+    return _unary(jnp.square, x, "square")
+
+
+def erf(x, name=None):
+    return _unary(jax.scipy.special.erf, x, "erf")
+
+
+def erfinv(x, name=None):
+    return _unary(jax.scipy.special.erfinv, x, "erfinv")
+
+
+def lgamma(x, name=None):
+    return _unary(jax.scipy.special.gammaln, x, "lgamma")
+
+
+def digamma(x, name=None):
+    return _unary(jax.scipy.special.digamma, x, "digamma")
+
+
+def sigmoid(x, name=None):
+    return _unary(jax.nn.sigmoid, x, "sigmoid")
+
+
+def logit(x, eps=None, name=None):
+    def fn(v):
+        if eps is not None:
+            v = jnp.clip(v, eps, 1.0 - eps)
+        return jnp.log(v / (1.0 - v))
+
+    return _unary(fn, x, "logit")
+
+
+def isnan(x, name=None):
+    return _unary(jnp.isnan, ensure_tensor(x), "isnan")
+
+
+def isinf(x, name=None):
+    return _unary(jnp.isinf, ensure_tensor(x), "isinf")
+
+
+def isfinite(x, name=None):
+    return _unary(jnp.isfinite, ensure_tensor(x), "isfinite")
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return _unary(lambda v: jnp.nan_to_num(v, nan=nan, posinf=posinf, neginf=neginf), x, "nan_to_num")
+
+
+def clip(x, min=None, max=None, name=None):
+    lo = unwrap(min) if min is not None else None
+    hi = unwrap(max) if max is not None else None
+    return _unary(lambda v: jnp.clip(v, lo, hi), x, "clip")
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    s, b = unwrap(scale), unwrap(bias)
+
+    def fn(v):
+        out = v * s + b if bias_after_scale else (v + b) * s
+        return out
+
+    return _unary(fn, x, "scale")
+
+
+def increment(x, value=1.0, name=None):
+    x._value = x._value + value
+    return x
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return _unary(lambda v: scale_b * jnp.tanh(scale_a * v), x, "stanh")
+
+
+def softplus_op(x, beta=1, threshold=20, name=None):
+    return _unary(lambda v: jax.nn.softplus(beta * v) / beta, x, "softplus")
+
+
+def angle(x, name=None):
+    return _unary(jnp.angle, x, "angle")
+
+
+def conj(x, name=None):
+    return _unary(jnp.conj, x, "conj")
+
+
+def real(x, name=None):
+    return _unary(jnp.real, x, "real")
+
+
+def imag(x, name=None):
+    return _unary(jnp.imag, x, "imag")
+
+
+def deg2rad(x, name=None):
+    return _unary(jnp.deg2rad, x, "deg2rad")
+
+
+def rad2deg(x, name=None):
+    return _unary(jnp.rad2deg, x, "rad2deg")
+
+
+def lerp(x, y, weight, name=None):
+    w = ensure_tensor(weight) if isinstance(weight, Tensor) else weight
+    if isinstance(w, Tensor):
+        return op(lambda a, b, ww: a + ww * (b - a), ensure_tensor(x), ensure_tensor(y), w, _name="lerp")
+    return op(lambda a, b: a + w * (b - a), ensure_tensor(x), ensure_tensor(y), _name="lerp")
+
+
+# ---- reductions -----------------------------------------------------------
+
+def _norm_axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    dt = to_jax_dtype(dtype) if dtype else None
+    return _unary(lambda v: jnp.sum(v, axis=_norm_axis(axis), dtype=dt, keepdims=keepdim), x, "sum")
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    return _unary(lambda v: jnp.mean(v, axis=_norm_axis(axis), keepdims=keepdim), x, "mean")
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    return _unary(lambda v: jnp.max(v, axis=_norm_axis(axis), keepdims=keepdim), x, "max")
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    return _unary(lambda v: jnp.min(v, axis=_norm_axis(axis), keepdims=keepdim), x, "min")
+
+
+def amax(x, axis=None, keepdim=False, name=None):
+    return max(x, axis, keepdim)
+
+
+def amin(x, axis=None, keepdim=False, name=None):
+    return min(x, axis, keepdim)
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    dt = to_jax_dtype(dtype) if dtype else None
+    return _unary(lambda v: jnp.prod(v, axis=_norm_axis(axis), dtype=dt, keepdims=keepdim), x, "prod")
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    dt = to_jax_dtype(dtype) if dtype else None
+    return _unary(lambda v: jnp.nansum(v, axis=_norm_axis(axis), dtype=dt, keepdims=keepdim), x, "nansum")
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    return _unary(lambda v: jnp.nanmean(v, axis=_norm_axis(axis), keepdims=keepdim), x, "nanmean")
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return _unary(lambda v: jax.scipy.special.logsumexp(v, axis=_norm_axis(axis), keepdims=keepdim), x, "logsumexp")
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    return _unary(lambda v: jnp.all(v, axis=_norm_axis(axis), keepdims=keepdim), ensure_tensor(x), "all")
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    return _unary(lambda v: jnp.any(v, axis=_norm_axis(axis), keepdims=keepdim), ensure_tensor(x), "any")
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return _unary(lambda v: jnp.count_nonzero(v, axis=_norm_axis(axis), keepdims=keepdim), ensure_tensor(x), "count_nonzero")
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    dt = to_jax_dtype(dtype) if dtype else None
+
+    def fn(v):
+        if axis is None:
+            return jnp.cumsum(v.reshape(-1), dtype=dt)
+        return jnp.cumsum(v, axis=int(axis), dtype=dt)
+
+    return _unary(fn, x, "cumsum")
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    dt = to_jax_dtype(dtype) if dtype else None
+    return _unary(lambda v: jnp.cumprod(v, axis=dim, dtype=dt), x, "cumprod")
+
+
+def _cum_extreme(x, axis, dtype, op_name):
+    from ._helpers import to_jax_dtype
+
+    x = ensure_tensor(x)
+    cum = jax.lax.cummax if op_name == "cummax" else jax.lax.cummin
+
+    def fn(v):
+        ax = 0 if axis is None else int(axis)
+        vv = v.reshape(-1) if axis is None else v
+        vals = cum(vv, axis=ax)
+        n = vv.shape[ax]
+        iota = jax.lax.broadcasted_iota(jnp.int32, vv.shape, ax)
+        # index of the running extreme: latest position where v equals it
+        idx = jax.lax.cummax(jnp.where(vv == vals, iota, -1), axis=ax)
+        return vals, idx.astype(to_jax_dtype(dtype))
+
+    return op(fn, x, _name=op_name)
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    """Returns (values, indices) like paddle.cummax."""
+    return _cum_extreme(x, axis, dtype, "cummax")
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    """Returns (values, indices) like paddle.cummin."""
+    return _cum_extreme(x, axis, dtype, "cummin")
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return _unary(lambda v: jnp.trace(v, offset=offset, axis1=axis1, axis2=axis2), x, "trace")
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    pre = unwrap(prepend) if prepend is not None else None
+    app = unwrap(append) if append is not None else None
+    return _unary(lambda v: jnp.diff(v, n=n, axis=axis, prepend=pre, append=app), x, "diff")
+
+
+def add_n(inputs, name=None):
+    if isinstance(inputs, Tensor):
+        return inputs
+    tensors = [ensure_tensor(t) for t in inputs]
+
+    def fn(*vals):
+        out = vals[0]
+        for v in vals[1:]:
+            out = out + v
+        return out
+
+    return op(fn, *tensors, _name="add_n")
+
+
+# ---- matmul-family (parity: python/paddle/tensor/linalg.py:128) ----------
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def fn(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+
+    return _binary(fn, x, y, "matmul")
+
+
+def dot(x, y, name=None):
+    return _binary(lambda a, b: jnp.sum(a * b, axis=-1), x, y, "dot")
+
+
+def bmm(x, y, name=None):
+    return _binary(jnp.matmul, x, y, "bmm")
+
+
+def mv(x, vec, name=None):
+    return _binary(jnp.matmul, x, vec, "mv")
+
+
+def mm(x, y, name=None):
+    return _binary(jnp.matmul, x, y, "mm")
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return op(
+        lambda i, a, b: beta * i + alpha * jnp.matmul(a, b),
+        ensure_tensor(input),
+        ensure_tensor(x),
+        ensure_tensor(y),
+        _name="addmm",
+    )
+
+
+def einsum(equation, *operands):
+    tensors = [ensure_tensor(t) for t in operands]
+    return op(lambda *vals: jnp.einsum(equation, *vals), *tensors, _name="einsum")

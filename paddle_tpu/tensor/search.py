@@ -1,0 +1,111 @@
+"""Search/sort ops (parity: python/paddle/tensor/search.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ._helpers import ensure_tensor, op, to_jax_dtype, unwrap, _wrap_value
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    v = unwrap(ensure_tensor(x))
+    out = jnp.argmax(v if axis is not None else v.reshape(-1), axis=axis if axis is not None else 0)
+    if keepdim and axis is not None:
+        out = jnp.expand_dims(out, axis)
+    return _wrap_value(out.astype(to_jax_dtype(dtype)))
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    v = unwrap(ensure_tensor(x))
+    out = jnp.argmin(v if axis is not None else v.reshape(-1), axis=axis if axis is not None else 0)
+    if keepdim and axis is not None:
+        out = jnp.expand_dims(out, axis)
+    return _wrap_value(out.astype(to_jax_dtype(dtype)))
+
+
+def argsort(x, axis=-1, descending=False, name=None):
+    v = unwrap(ensure_tensor(x))
+    out = jnp.argsort(-v if descending else v, axis=axis)
+    return _wrap_value(out.astype(to_jax_dtype("int64")))
+
+
+def sort(x, axis=-1, descending=False, name=None):
+    def fn(v):
+        out = jnp.sort(v, axis=axis)
+        return jnp.flip(out, axis=axis) if descending else out
+
+    return op(fn, ensure_tensor(x), _name="sort")
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):
+    x = ensure_tensor(x)
+    k = int(unwrap(k))
+    ax = -1 if axis is None else axis
+
+    def fn(v):
+        vv = jnp.moveaxis(v, ax, -1)
+        vals, idx = jax.lax.top_k(vv if largest else -vv, k)
+        if not largest:
+            vals = -vals
+        return jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx, -1, ax).astype(to_jax_dtype("int64"))
+
+    return op(fn, x, _name="topk")
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    x = ensure_tensor(x)
+
+    def fn(v):
+        s = jnp.sort(v, axis=axis)
+        i = jnp.argsort(v, axis=axis)
+        vals = jnp.take(s, k - 1, axis=axis)
+        idx = jnp.take(i, k - 1, axis=axis)
+        if keepdim:
+            vals = jnp.expand_dims(vals, axis)
+            idx = jnp.expand_dims(idx, axis)
+        return vals, idx.astype(to_jax_dtype("int64"))
+
+    return op(fn, x, _name="kthvalue")
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    import numpy as np
+    from scipy import stats  # available via numpy ecosystem
+
+    v = np.asarray(unwrap(ensure_tensor(x)))
+    m = stats.mode(v, axis=axis, keepdims=keepdim)
+    return _wrap_value(jnp.asarray(m.mode)), _wrap_value(jnp.asarray(m.count))
+
+
+def nonzero(x, as_tuple=False):
+    v = unwrap(ensure_tensor(x))
+    idx = jnp.nonzero(v)
+    if as_tuple:
+        return tuple(_wrap_value(i[:, None]) for i in idx)
+    return _wrap_value(jnp.stack(idx, axis=1).astype(to_jax_dtype("int64")))
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    s = unwrap(ensure_tensor(sorted_sequence))
+    v = unwrap(ensure_tensor(values))
+    side = "right" if right else "left"
+    if s.ndim == 1:
+        out = jnp.searchsorted(s, v, side=side)
+    else:
+        out = jax.vmap(lambda ss, vv: jnp.searchsorted(ss, vv, side=side))(s.reshape(-1, s.shape[-1]), v.reshape(-1, v.shape[-1])).reshape(v.shape)
+    return _wrap_value(out.astype(jnp.int32 if out_int32 else to_jax_dtype("int64")))
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    idx = tuple(unwrap(ensure_tensor(i)) for i in indices)
+
+    def fn(v, val):
+        if accumulate:
+            return v.at[idx].add(val)
+        return v.at[idx].set(val)
+
+    return op(fn, ensure_tensor(x), ensure_tensor(value), _name="index_put")

@@ -1,0 +1,65 @@
+"""Statistics ops (parity: python/paddle/tensor/stat.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ._helpers import ensure_tensor, op, to_jax_dtype, unwrap, _wrap_value
+
+
+def _norm_axis(axis):
+    if axis is None:
+        return None
+    return tuple(axis) if isinstance(axis, (list, tuple)) else int(axis)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return op(
+        lambda v: jnp.std(v, axis=_norm_axis(axis), ddof=1 if unbiased else 0, keepdims=keepdim),
+        ensure_tensor(x),
+        _name="std",
+    )
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return op(
+        lambda v: jnp.var(v, axis=_norm_axis(axis), ddof=1 if unbiased else 0, keepdims=keepdim),
+        ensure_tensor(x),
+        _name="var",
+    )
+
+
+def median(x, axis=None, keepdim=False, name=None):
+    return op(lambda v: jnp.median(v, axis=_norm_axis(axis), keepdims=keepdim), ensure_tensor(x), _name="median")
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    return op(lambda v: jnp.nanmedian(v, axis=_norm_axis(axis), keepdims=keepdim), ensure_tensor(x), _name="nanmedian")
+
+
+def quantile(x, q, axis=None, keepdim=False, name=None):
+    return op(lambda v: jnp.quantile(v, jnp.asarray(q), axis=_norm_axis(axis), keepdims=keepdim), ensure_tensor(x), _name="quantile")
+
+
+def nanquantile(x, q, axis=None, keepdim=False, name=None):
+    return op(lambda v: jnp.nanquantile(v, jnp.asarray(q), axis=_norm_axis(axis), keepdims=keepdim), ensure_tensor(x), _name="nanquantile")
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):
+    v = unwrap(ensure_tensor(input))
+    lo, hi = (None, None) if (min == 0 and max == 0) else (min, max)
+    hist, _ = jnp.histogram(v, bins=bins, range=(lo, hi) if lo is not None else None)
+    return _wrap_value(hist.astype(to_jax_dtype("int64")))
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    v = unwrap(ensure_tensor(x))
+    w = unwrap(ensure_tensor(weights)) if weights is not None else None
+    return _wrap_value(jnp.bincount(v, weights=w, minlength=minlength))
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return op(lambda v: jnp.corrcoef(v, rowvar=rowvar), ensure_tensor(x), _name="corrcoef")
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return op(lambda v: jnp.cov(v, rowvar=rowvar, ddof=1 if ddof else 0), ensure_tensor(x), _name="cov")
