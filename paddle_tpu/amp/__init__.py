@@ -1,0 +1,180 @@
+"""AMP (parity: python/paddle/amp — auto_cast + GradScaler).
+
+TPU-first: bfloat16 is the native mixed-precision dtype; it shares float32's
+exponent range so loss scaling is unnecessary — ``GradScaler`` exists for
+fp16 API parity and is an identity pass-through for bf16 (the reference's
+dynamic loss scaling machinery, python/paddle/amp/grad_scaler.py:26 +
+check_finite_and_unscale op, is only needed for fp16).
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, _wrap_value
+from ..framework.dtype import to_jax_dtype
+
+__all__ = ["auto_cast", "amp_guard", "GradScaler", "decorate", "amp_state"]
+
+
+class _AmpState(threading.local):
+    enabled = False
+    dtype = "bfloat16"
+    level = "O1"
+    custom_white_list = None
+    custom_black_list = None
+
+
+_STATE = _AmpState()
+
+# Ops safe to run in low precision (parity: the C++ AMP lists in
+# paddle/fluid/imperative/amp_auto_cast.cc). On TPU the list only matters for
+# the eager path; under jit, `decorate`-style param casting + XLA do the rest.
+WHITE_LIST = {"matmul", "linear", "conv1d", "conv2d", "conv3d", "bmm", "mm", "einsum", "flash_attention", "sdpa"}
+BLACK_LIST = {"exp", "log", "log2", "log10", "mean", "sum", "softmax", "log_softmax", "cross_entropy", "layer_norm", "batch_norm", "norm", "logsumexp", "cumsum"}
+
+
+def amp_state():
+    return _STATE
+
+
+def _install_hook():
+    from ..framework import core as _core
+
+    def hook(op_name, vals):
+        if not _STATE.enabled:
+            return vals
+        return maybe_cast_inputs(op_name, vals)
+
+    _core._amp_hook = hook
+
+
+_install_hook()
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None, level="O1", dtype="bfloat16"):
+    prev = (_STATE.enabled, _STATE.dtype, _STATE.level, _STATE.custom_white_list, _STATE.custom_black_list)
+    _STATE.enabled = enable
+    _STATE.dtype = dtype
+    _STATE.level = level
+    _STATE.custom_white_list = set(custom_white_list) if custom_white_list else None
+    _STATE.custom_black_list = set(custom_black_list) if custom_black_list else None
+    try:
+        yield
+    finally:
+        (_STATE.enabled, _STATE.dtype, _STATE.level, _STATE.custom_white_list, _STATE.custom_black_list) = prev
+
+
+amp_guard = auto_cast
+
+
+def maybe_cast_inputs(op_name, vals):
+    """Called by the eager dispatcher: cast float inputs per AMP lists."""
+    if not _STATE.enabled:
+        return vals
+    white = WHITE_LIST | (_STATE.custom_white_list or set())
+    black = BLACK_LIST | (_STATE.custom_black_list or set())
+    dt = to_jax_dtype(_STATE.dtype)
+    if op_name in white:
+        return [v.astype(dt) if hasattr(v, "dtype") and jnp.issubdtype(v.dtype, jnp.floating) and v.dtype != dt else v for v in vals]
+    if op_name in black:
+        return [v.astype(jnp.float32) if hasattr(v, "dtype") and v.dtype == dt else v for v in vals]
+    # unlisted ops run in the incoming dtype (paddle O1 gray-list semantics)
+    return vals
+
+
+def decorate(models=None, optimizers=None, level="O2", dtype="bfloat16", master_weight=None, save_dtype=None):
+    """O2: cast model params to the compute dtype (master weights stay fp32
+    in the optimizer state on the jit path)."""
+    if models is not None:
+        ms = models if isinstance(models, (list, tuple)) else [models]
+        for m in ms:
+            m.astype(dtype)
+    if optimizers is None:
+        return models
+    return models, optimizers
+
+
+class GradScaler:
+    """Dynamic loss scaling (parity: python/paddle/amp/grad_scaler.py:26).
+    No-op for bf16; functional for fp16."""
+
+    def __init__(self, enable=True, init_loss_scaling=2.0**15, incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=1000, decr_every_n_nan_or_inf=2, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = init_loss_scaling
+        self._incr_ratio, self._decr_ratio = incr_ratio, decr_ratio
+        self._incr_every, self._decr_every = incr_every_n_steps, decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+        self._unscaled = False
+
+    def scale(self, loss):
+        if not self._enable:
+            return loss
+        return loss * self._scale
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        if self._unscaled:
+            raise RuntimeError("unscale_() has already been called on this optimizer since the last update()")
+        inv = 1.0 / self._scale
+        found = False
+        for p in optimizer._params:
+            if p.grad is not None:
+                g = p.grad._value * inv
+                found = found or bool(jnp.any(~jnp.isfinite(g)))
+                p.grad._value = g
+        self._found_inf = found
+        self._unscaled = True
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        if not self._unscaled:
+            self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self.update()
+
+    def minimize(self, optimizer, scaled_loss):
+        scaled_loss.backward()
+        self.step(optimizer)
+
+    def update(self):
+        self._unscaled = False
+        if not (self._enable and self._dynamic):
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+
+    def is_enable(self):
+        return self._enable
+
+    def get_loss_scaling(self):
+        return self._scale
+
+    def state_dict(self):
+        return {"scale": self._scale, "good_steps": self._good_steps, "bad_steps": self._bad_steps}
+
+    def set_state_dict(self, state):
+        self._scale = state["scale"]
+        self._good_steps = state["good_steps"]
+        self._bad_steps = state["bad_steps"]

@@ -1,0 +1,39 @@
+"""paddle_tpu.distributed (parity: python/paddle/distributed)."""
+from . import checkpoint  # noqa: F401
+from . import fleet as fleet_mod  # noqa: F401
+from .collective import (  # noqa: F401
+    ReduceOp,
+    all_gather,
+    all_reduce,
+    all_to_all,
+    alltoall,
+    barrier,
+    broadcast,
+    get_group,
+    new_group,
+    ppermute,
+    recv,
+    reduce,
+    reduce_scatter,
+    scatter,
+    send,
+    wait,
+)
+from .env import ParallelEnv, get_rank, get_world_size, init_parallel_env  # noqa: F401
+from .fleet import Fleet, fleet  # noqa: F401
+from .moe import MoELayer  # noqa: F401
+from .mp_layers import (  # noqa: F401
+    ColumnParallelLinear,
+    ParallelCrossEntropy,
+    RowParallelLinear,
+    TensorParallel,
+    VocabParallelEmbedding,
+    get_rng_state_tracker,
+)
+from .parallel import DataParallel, spawn  # noqa: F401
+from .pipeline import LayerDesc, PipelineLayer, SegmentLayers, SharedLayerDesc, spmd_pipeline  # noqa: F401
+from .recompute import recompute, remat  # noqa: F401
+from .ring_attention import ring_attention, ulysses_attention  # noqa: F401
+from .sharding import build_state_specs, group_sharded_parallel, state_shardings  # noqa: F401
+from .strategy import DistributedStrategy  # noqa: F401
+from .topology import AXES, HybridCommunicateGroup, build_mesh  # noqa: F401

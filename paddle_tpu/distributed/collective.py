@@ -1,0 +1,172 @@
+"""Functional collectives (parity: python/paddle/distributed/collective.py —
+all_reduce:618, all_gather:840, alltoall:1769, broadcast:533, etc).
+
+TPU-first semantics: these are *traced* collectives for use inside
+``shard_map`` regions over mesh axes (the manual-SPMD escape hatch). In the
+pjit/GSPMD path you normally never call them — sharding annotations make XLA
+insert them. The reference's three-way branch (eager ProcessGroup / legacy
+c_* op / static append_op) collapses to jax.lax collectives.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, _wrap_value, unwrap
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+def _axis(group):
+    if group is None:
+        return "dp"
+    if isinstance(group, str):
+        return group
+    return getattr(group, "axis_name", "dp")
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    ax = _axis(group)
+    v = unwrap(tensor)
+    if op == ReduceOp.SUM:
+        out = jax.lax.psum(v, ax)
+    elif op == ReduceOp.MAX:
+        out = jax.lax.pmax(v, ax)
+    elif op == ReduceOp.MIN:
+        out = jax.lax.pmin(v, ax)
+    elif op == ReduceOp.AVG:
+        out = jax.lax.pmean(v, ax)
+    else:
+        raise ValueError(f"unsupported reduce op {op}")
+    if isinstance(tensor, Tensor):
+        tensor._value = out
+        return tensor
+    return out
+
+
+def all_gather(tensor_list, tensor=None, group=None, sync_op=True, axis=0):
+    ax = _axis(group)
+    v = unwrap(tensor if tensor is not None else tensor_list)
+    out = jax.lax.all_gather(v, ax, tiled=False)
+    if isinstance(tensor_list, list):
+        n = out.shape[0]
+        tensor_list.clear()
+        tensor_list.extend(_wrap_value(out[i]) for i in range(n))
+        return tensor_list
+    return out
+
+
+def all_gather_concat(x, group=None, concat_axis=0):
+    ax = _axis(group)
+    return jax.lax.all_gather(unwrap(x), ax, axis=concat_axis, tiled=True)
+
+
+def reduce_scatter(output, input, op=ReduceOp.SUM, group=None, sync_op=True, scatter_axis=0):
+    ax = _axis(group)
+    v = unwrap(input)
+    out = jax.lax.psum_scatter(v, ax, scatter_dimension=scatter_axis, tiled=True)
+    if isinstance(output, Tensor):
+        output._value = out
+        return output
+    return out
+
+
+def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True, split_axis=0, concat_axis=0):
+    ax = _axis(group)
+    if isinstance(in_tensor_list, (list, tuple)):
+        v = jnp.stack([unwrap(t) for t in in_tensor_list])
+        out = jax.lax.all_to_all(v, ax, split_axis=0, concat_axis=0, tiled=False)
+        if out_tensor_list is not None:
+            out_tensor_list.clear()
+            out_tensor_list.extend(_wrap_value(out[i]) for i in range(out.shape[0]))
+            return out_tensor_list
+        return out
+    return jax.lax.all_to_all(unwrap(in_tensor_list), ax, split_axis=split_axis, concat_axis=concat_axis, tiled=True)
+
+
+all_to_all = alltoall
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    """Inside shard_map all ranks trace identically; broadcast = take src's
+    value. Implemented as psum of masked value (the XLA idiom)."""
+    ax = _axis(group)
+    v = unwrap(tensor)
+    idx = jax.lax.axis_index(ax)
+    masked = jnp.where(idx == src, v, jnp.zeros_like(v))
+    out = jax.lax.psum(masked, ax)
+    if isinstance(tensor, Tensor):
+        tensor._value = out
+        return tensor
+    return out
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    # all ranks get the reduction; non-dst ranks simply may ignore it
+    return all_reduce(tensor, op=op, group=group)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    ax = _axis(group)
+    if tensor_list is not None:
+        v = jnp.stack([unwrap(t) for t in tensor_list])
+    else:
+        v = unwrap(tensor)
+    idx = jax.lax.axis_index(ax)
+    src_val = broadcast(_wrap_value(v), src=src, group=group)
+    out = unwrap(src_val)[idx]
+    if isinstance(tensor, Tensor):
+        tensor._value = out
+        return tensor
+    return out
+
+
+def ppermute(x, perm, group=None):
+    """collective_permute (reference send_v2/recv_v2 pairs,
+    operators/collective/send_v2_op.cu.cc:162)."""
+    ax = _axis(group)
+    return jax.lax.ppermute(unwrap(x), ax, perm)
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    raise NotImplementedError(
+        "point-to-point send/recv have no single-program XLA analog; use "
+        "ppermute (collective_permute) inside shard_map — see "
+        "paddle_tpu.distributed.pipeline for the pipeline-parallel pattern"
+    )
+
+
+recv = send
+
+
+def barrier(group=None):
+    """No-op under a single controller: program order is the barrier."""
+    return None
+
+
+def get_group(name="dp"):
+    class _Group:
+        def __init__(self, axis_name):
+            self.axis_name = axis_name
+
+    return _Group(name)
+
+
+def new_group(ranks=None, backend=None, timeout=None):
+    """Parity shim (collective.py:343): groups are mesh axes on TPU."""
+    return get_group("dp")
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    """Stream-sync parity (c_wait_comm): XLA schedules; block_until_ready for
+    the eager-host case."""
+    v = unwrap(tensor)
+    if hasattr(v, "block_until_ready"):
+        v.block_until_ready()
+    return tensor

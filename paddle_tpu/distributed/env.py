@@ -1,0 +1,70 @@
+"""Process/device environment (parity: python/paddle/distributed/parallel.py
+env reading + paddle.distributed.launch).
+
+TPU-first: one process per *host*, all devices visible to JAX;
+``init_parallel_env`` maps to ``jax.distributed.initialize`` (DCN rendezvous
+— the TCPStore/gen_comm_id analog, reference
+paddle/fluid/distributed/store/tcp_store.h:97) and "rank" means process
+(host) index, while device-level parallelism is mesh axes, not processes.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+_initialized = False
+
+
+def init_parallel_env(coordinator_address=None, num_processes=None, process_id=None):
+    """Multi-host init. Single-host (the common case for tests/one-chip) is a
+    no-op: every device is already visible."""
+    global _initialized
+    if _initialized:
+        return
+    addr = coordinator_address or os.environ.get("PADDLE_MASTER") or os.environ.get("COORDINATOR_ADDRESS")
+    nproc = num_processes or int(os.environ.get("PADDLE_TRAINERS_NUM", "0")) or None
+    pid = process_id if process_id is not None else int(os.environ.get("PADDLE_TRAINER_ID", "-1"))
+    if addr and nproc and nproc > 1:
+        jax.distributed.initialize(coordinator_address=addr, num_processes=nproc, process_id=pid if pid >= 0 else None)
+    _initialized = True
+
+
+def get_rank() -> int:
+    return jax.process_index()
+
+
+def get_world_size() -> int:
+    return jax.process_count()
+
+
+def get_local_device_count() -> int:
+    return jax.local_device_count()
+
+
+def get_device_count() -> int:
+    return jax.device_count()
+
+
+class ParallelEnv:
+    """Parity shim for paddle.distributed.ParallelEnv."""
+
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def device_id(self):
+        return 0
+
+    @property
+    def nranks(self):
+        return get_world_size()
+
+    @property
+    def local_rank(self):
+        return get_rank()

@@ -1,0 +1,127 @@
+"""Fleet orchestration (parity: python/paddle/distributed/fleet/base/
+fleet_base.py — fleet.init:210, distributed_model:946,
+distributed_optimizer, save_persistables:833).
+
+TPU-first: ``fleet.init`` builds the hybrid mesh (topology.py);
+``fleet.distributed_step`` is the load-bearing API — it assembles a pjit
+TrainStep whose in/out shardings encode ALL the parallelisms at once:
+batch over dp×sdp, TP specs from mp-annotated layers, ZeRO stage over sdp,
+and remat. The reference's per-strategy model wrappers
+(DataParallel/TensorParallel/PipelineParallel) + HybridParallelOptimizer
+collapse into this one sharded compilation.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .env import get_rank, get_world_size, init_parallel_env
+from .sharding import state_shardings
+from .strategy import DistributedStrategy
+from .topology import HybridCommunicateGroup
+
+
+class Fleet:
+    def __init__(self):
+        self._hcg: Optional[HybridCommunicateGroup] = None
+        self._strategy: Optional[DistributedStrategy] = None
+        self._is_initialized = False
+
+    # -- lifecycle ---------------------------------------------------------
+    def init(self, role_maker=None, is_collective=True, strategy=None, devices=None):
+        self._strategy = strategy or DistributedStrategy()
+        hc = self._strategy.hybrid_configs
+        init_parallel_env()
+        self._hcg = HybridCommunicateGroup(
+            dp_degree=hc.dp_degree,
+            mp_degree=hc.mp_degree,
+            pp_degree=hc.pp_degree,
+            sharding_degree=hc.sharding_degree,
+            sep_degree=hc.sep_degree,
+            devices=devices,
+        )
+        self._is_initialized = True
+        return self
+
+    def is_first_worker(self):
+        return get_rank() == 0
+
+    def worker_index(self):
+        return get_rank()
+
+    def worker_num(self):
+        return get_world_size()
+
+    def barrier_worker(self):
+        return None
+
+    def get_hybrid_communicate_group(self):
+        return self._hcg
+
+    @property
+    def mesh(self):
+        return self._hcg.mesh if self._hcg else None
+
+    # -- model/optimizer wrappers (paddle API parity) ----------------------
+    def distributed_model(self, model):
+        """Parity: fleet_base.py:946. Under GSPMD no wrapper is needed —
+        specs already live on the parameters; return the model unchanged."""
+        model._fleet = self
+        return model
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        optimizer._fleet = self
+        return optimizer
+
+    # -- the TPU-native training entry ------------------------------------
+    def distributed_step(self, model, optimizer, loss_fn, seed=0):
+        """Build a sharded jit TrainStep per the active DistributedStrategy."""
+        from ..jit import TrainStep
+
+        assert self._hcg is not None, "call fleet.init(strategy=...) first"
+        mesh = self._hcg.mesh
+        strat = self._strategy
+        stage = strat.sharding_configs.sharding_stage if (strat.sharding or strat.hybrid_configs.sharding_degree > 1) else 0
+        remat = strat.recompute or strat.recompute_configs.enable
+
+        # mp/expert specs collected from annotated parameters
+        mp_specs = {name: p.dist_spec for name, p in model.named_parameters() if getattr(p, "dist_spec", None) is not None}
+
+        step = TrainStep(model, optimizer, loss_fn, remat=remat, seed=seed)
+        shardings = state_shardings(step.state, mesh, stage=stage, mp_specs=mp_specs)
+        batch_sharding = None  # leaves XLA free; inputs pre-placed by caller
+        step.mesh = mesh
+        step.state = jax.device_put(step.state, shardings)
+        step._jit = jax.jit(step._step, donate_argnums=0, in_shardings=(shardings, batch_sharding), out_shardings=(shardings, None))
+        step.state_shardings = shardings
+        return step
+
+    def shard_batch(self, *arrays):
+        """Place a host batch sharded over the data axes (dp×sdp) —
+        parity with the per-rank feed split in
+        fleet/utils/hybrid_parallel_util.py:111."""
+        import jax.numpy as jnp
+
+        from ..framework.core import Tensor, unwrap
+
+        mesh = self._hcg.mesh
+        sh = NamedSharding(mesh, P(("dp", "sdp")))
+        out = tuple(jax.device_put(jnp.asarray(unwrap(a)), sh) for a in arrays)
+        return out if len(out) > 1 else out[0]
+
+    # -- save/load (parity: fleet_base.py:795,833) -------------------------
+    def save_persistables(self, executor_or_model, dirname, **kwargs):
+        from ..framework.io import save
+
+        model = executor_or_model
+        save(model.state_dict(), f"{dirname}/model.pdparams")
+
+    def save_inference_model(self, model, dirname, input_spec=None, **kwargs):
+        from ..jit import save as jit_save
+
+        jit_save(model, f"{dirname}/inference", input_spec=input_spec)
+
+
+fleet = Fleet()

@@ -1,0 +1,134 @@
+"""Mixture-of-Experts / expert parallelism (parity:
+python/paddle/incubate/distributed/models/moe/moe_layer.py + gates
+moe/gate/{naive,gshard,switch}_gate.py; dispatch via global_scatter/
+global_gather ops, operators/collective/global_scatter_op.cu.cc).
+
+TPU-first: GShard-style dense dispatch/combine einsums with expert weights
+stacked on a leading axis sharded over the expert mesh axis. Under pjit the
+dispatch einsum against the sharded expert dim compiles to the all-to-all
+the reference implements as count-aware NCCL alltoall; capacity-dropping
+keeps shapes static (the XLA contract).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..framework import random as _random
+from ..nn import functional as Fnn
+from ..nn import initializer as I
+from ..nn.layer.base import Layer
+from ..tensor._helpers import ensure_tensor, op
+
+
+class NaiveGate(Layer):
+    """moe/gate/naive_gate.py: linear scores + top-k."""
+
+    def __init__(self, d_model, num_expert, world_size=1, topk=2):
+        super().__init__()
+        self.topk = topk
+        self.num_expert = num_expert
+        self.weight = self.create_parameter([d_model, num_expert], default_initializer=I.XavierNormal())
+
+    def score(self, x_val):
+        return x_val @ self.weight._value
+
+
+class GShardGate(NaiveGate):
+    """moe/gate/gshard_gate.py: top-2 with random second-expert jitter +
+    aux load-balance loss."""
+
+    def __init__(self, d_model, num_expert, world_size=1, topk=2, capacity=(1.2, 2.4)):
+        super().__init__(d_model, num_expert, world_size, topk)
+        self.capacity = capacity
+
+
+class SwitchGate(NaiveGate):
+    """moe/gate/switch_gate.py: top-1 routing."""
+
+    def __init__(self, d_model, num_expert, world_size=1, topk=1, capacity=(1.2, 2.4)):
+        super().__init__(d_model, num_expert, world_size, topk)
+        self.capacity = capacity
+
+
+class MoELayer(Layer):
+    """Expert-parallel FFN MoE.
+
+    experts: stacked FFN weights [E, ...] with dist_spec over the expert axis.
+    gate: 'naive' | 'gshard' | 'switch' (reference moe_layer.py gate arg).
+    """
+
+    def __init__(self, d_model, d_hidden, num_experts, top_k=2, capacity_factor=1.25, gate="gshard", expert_axis="dp", activation="gelu", name=None):
+        super().__init__()
+        self.num_experts = num_experts
+        self.top_k = 1 if gate == "switch" else top_k
+        self.capacity_factor = capacity_factor
+        self.activation = activation
+        gate_cls = {"naive": NaiveGate, "gshard": GShardGate, "switch": SwitchGate}[gate]
+        self.gate = gate_cls(d_model, num_experts, topk=self.top_k)
+        self.w1 = self.create_parameter([num_experts, d_model, d_hidden], default_initializer=I.XavierNormal())
+        self.b1 = self.create_parameter([num_experts, 1, d_hidden], is_bias=True)
+        self.w2 = self.create_parameter([num_experts, d_hidden, d_model], default_initializer=I.XavierNormal())
+        self.b2 = self.create_parameter([num_experts, 1, d_model], is_bias=True)
+        for p, spec in ((self.w1, P(expert_axis, None, None)), (self.b1, P(expert_axis, None, None)), (self.w2, P(expert_axis, None, None)), (self.b2, P(expert_axis, None, None))):
+            p.dist_spec = spec
+            p.is_distributed = True
+        self.aux_loss = None
+
+    def forward(self, x):
+        """x: [batch, seq, d_model] (or [tokens, d_model])."""
+        x = ensure_tensor(x)
+        squeeze_back = x.ndim == 2
+        act = {"gelu": jax.nn.gelu, "relu": jax.nn.relu, "silu": jax.nn.silu}[self.activation]
+        E, K, cf = self.num_experts, self.top_k, self.capacity_factor
+
+        def fn(xv, gate_w, w1, b1, w2, b2):
+            xs = xv if xv.ndim == 3 else xv[None]
+            B, S, D = xs.shape
+            tokens = xs.reshape(B * S, D)
+            n_tok = B * S
+            capacity = max(1, int(math.ceil(n_tok * K * cf / E)))
+
+            logits = tokens @ gate_w  # [T, E]
+            probs = jax.nn.softmax(logits, axis=-1)
+            gate_vals, gate_idx = jax.lax.top_k(probs, K)  # [T, K]
+
+            # aux load-balance loss (GShard eq.4): mean prob * token fraction
+            me = jnp.mean(probs, axis=0)
+            one_hot_top1 = jax.nn.one_hot(gate_idx[:, 0], E)
+            ce = jnp.mean(one_hot_top1, axis=0)
+            aux = E * jnp.sum(me * ce)
+
+            # position of each (token, k) within its expert queue
+            flat_idx = gate_idx.reshape(-1)  # [T*K] expert ids (k-major per token)
+            onehot = jax.nn.one_hot(flat_idx, E, dtype=jnp.int32)  # [T*K, E]
+            pos_in_expert = jnp.cumsum(onehot, axis=0) - 1  # rank within expert
+            pos = jnp.sum(pos_in_expert * onehot, axis=-1)  # [T*K]
+            keep = pos < capacity
+            gv = gate_vals.reshape(-1) * keep.astype(gate_vals.dtype)
+
+            # dispatch: [E, capacity, D]
+            disp = jnp.zeros((E, capacity, D), tokens.dtype)
+            tok_rep = jnp.repeat(jnp.arange(n_tok), K)
+            e_ids = jnp.where(keep, flat_idx, 0)
+            p_ids = jnp.where(keep, pos, 0)
+            contrib = tokens[tok_rep] * keep[:, None].astype(tokens.dtype)
+            disp = disp.at[e_ids, p_ids].add(contrib)
+
+            # expert FFN, batched over E — one big MXU matmul per projection
+            h = act(jnp.einsum("ecd,edh->ech", disp, w1) + b1)
+            y = jnp.einsum("ech,ehd->ecd", h, w2) + b2
+
+            # combine back: weighted gather
+            gathered = y[e_ids, p_ids]  # [T*K, D]
+            combined = jnp.zeros((n_tok, D), y.dtype)
+            combined = combined.at[tok_rep].add(gathered * gv[:, None])
+            out = combined.reshape(B, S, D)
+            return (out[0] if xv.ndim == 2 else out), aux
+
+        out, aux = op(fn, x, self.gate.weight, self.w1, self.b1, self.w2, self.b2, _name="moe")
+        self.aux_loss = aux
+        return out

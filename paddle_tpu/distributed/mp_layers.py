@@ -1,0 +1,142 @@
+"""Tensor-parallel layers (parity:
+python/paddle/distributed/fleet/meta_parallel/parallel_layers/mp_layers.py —
+VocabParallelEmbedding:30, ColumnParallelLinear:95, RowParallelLinear:171,
+ParallelCrossEntropy:251).
+
+TPU-first: these are *sharding-annotated* layers. The weight carries a
+PartitionSpec on the 'mp' mesh axis (consumed by the jit path's GSPMD
+partitioner) and the forward inserts sharding constraints; XLA emits the
+all-reduce/all-gather the reference hand-writes with c_* collectives
+(c_allreduce in RowParallelLinear, c_softmax_with_cross_entropy for the
+vocab-parallel loss, operators/collective/c_softmax_with_cross_entropy_op.cu:139).
+Single-device eager runs ignore the specs — same numerics.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.layer.base import Layer
+from ..tensor._helpers import ensure_tensor, op
+
+
+def _constraint(x_val, spec):
+    """Apply a sharding constraint if a fleet mesh is active."""
+    from .fleet import fleet
+
+    if fleet._hcg is None:
+        return x_val
+    mesh = fleet._hcg.mesh
+    if mesh.shape.get("mp", 1) == 1:
+        return x_val
+    try:
+        return jax.lax.with_sharding_constraint(x_val, NamedSharding(mesh, spec))
+    except ValueError:
+        # eager (uncommitted to mesh) — constraint only matters under jit
+        return x_val
+
+
+class ColumnParallelLinear(Layer):
+    """y = x @ W, W [in, out] sharded on out over 'mp'."""
+
+    def __init__(self, in_features, out_features, weight_attr=None, has_bias=True, gather_output=True, fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        self.gather_output = gather_output
+        self.weight = self.create_parameter([in_features, out_features], attr=weight_attr, default_initializer=I.XavierNormal())
+        self.weight.dist_spec = P(None, "mp")
+        self.weight.is_distributed = True
+        self.bias = None
+        if has_bias:
+            self.bias = self.create_parameter([out_features], is_bias=True)
+            self.bias.dist_spec = P("mp")
+            self.bias.is_distributed = True
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, self.bias)
+        spec = P(*([None] * (out.ndim - 1)), None if self.gather_output else "mp")
+        out._value = _constraint(out._value, spec)
+        return out
+
+
+class RowParallelLinear(Layer):
+    """y = x @ W, W [in, out] sharded on in over 'mp'; XLA inserts the
+    all-reduce the reference does manually (mp_layers.py:171)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None, has_bias=True, input_is_parallel=False, fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        self.input_is_parallel = input_is_parallel
+        self.weight = self.create_parameter([in_features, out_features], attr=weight_attr, default_initializer=I.XavierNormal())
+        self.weight.dist_spec = P("mp", None)
+        self.weight.is_distributed = True
+        self.bias = None
+        if has_bias:
+            self.bias = self.create_parameter([out_features], is_bias=True)
+
+    def forward(self, x):
+        if self.input_is_parallel:
+            x = ensure_tensor(x)
+            x._value = _constraint(x._value, P(*([None] * (x.ndim - 1)), "mp"))
+        out = F.linear(x, self.weight, self.bias)
+        out._value = _constraint(out._value, P(*([None] * out.ndim)))
+        return out
+
+
+class VocabParallelEmbedding(Layer):
+    """Embedding with the vocab dim sharded over 'mp' (mp_layers.py:30)."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None, mp_group=None, name=None):
+        super().__init__()
+        self.weight = self.create_parameter([num_embeddings, embedding_dim], attr=weight_attr, default_initializer=I.Normal(0.0, 0.02))
+        self.weight.dist_spec = P("mp", None)
+        self.weight.is_distributed = True
+
+    def forward(self, x):
+        return F.embedding(x, self.weight)
+
+
+class ParallelCrossEntropy(Layer):
+    """Vocab-parallel softmax CE: annotate logits sharded on the class axis;
+    GSPMD partitions the softmax reductions (the
+    c_softmax_with_cross_entropy kernel's job)."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):
+        input = ensure_tensor(input)
+        input._value = _constraint(input._value, P(*([None] * (input.ndim - 1)), "mp"))
+        return F.cross_entropy(input, label, reduction="none", ignore_index=self.ignore_index)
+
+
+class TensorParallel(Layer):
+    """Wrapper parity (fleet/meta_parallel/tensor_parallel.py:25): on TPU the
+    wrapped model needs no broadcast/param-sync — the single controller owns
+    one copy of every param; it simply marks the model as mp-annotated."""
+
+    def __init__(self, layers, hcg=None, **kwargs):
+        super().__init__()
+        self._layers = layers
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+
+def get_rng_state_tracker():
+    """Parity shim for parallel_layers/random.py RNG tracker: JAX keys are
+    explicit, so 'local' vs 'global' dropout seeds are just different fold-in
+    constants; provided for API compat."""
+
+    class _Tracker:
+        def add(self, name, seed):
+            pass
+
+        def rng_state(self, name="global_seed"):
+            import contextlib
+
+            return contextlib.nullcontext()
+
+    return _Tracker()

@@ -1,0 +1,55 @@
+"""DataParallel + spawn/launch parity (reference:
+python/paddle/fluid/dygraph/parallel.py:419 DataParallel,
+python/paddle/distributed/spawn.py, launch/main.py:18).
+
+TPU-first: data parallelism is a mesh axis, not process replication. On a
+single controller there is nothing to wrap — ``DataParallel`` exists for API
+compat and simply scales the loss / passes through; the real DP path is
+``fleet.distributed_step`` (grad all-reduce fused by XLA over 'dp').
+Multi-host "launch" = one process per host with jax.distributed.initialize
+(env.py), not one per device.
+"""
+from __future__ import annotations
+
+from ..nn.layer.base import Layer
+from .env import ParallelEnv, get_rank, get_world_size, init_parallel_env
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25, last_comm_buffer_size=1, find_unused_parameters=False, group=None):
+        super().__init__()
+        self._layers = layers
+        self.find_unused_parameters = find_unused_parameters
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def scale_loss(self, loss):
+        return loss
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, *args, **kwargs):
+        return self._layers.set_state_dict(*args, **kwargs)
+
+    @property
+    def parameters(self):
+        return self._layers.parameters
+
+    def no_sync(self):
+        import contextlib
+
+        return contextlib.nullcontext()
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """Parity: paddle.distributed.spawn. Single-controller JAX drives all
+    local devices from one process, so spawn degenerates to a direct call."""
+    func(*args)
+
+
+def launch():
+    """Parity: python -m paddle.distributed.launch. On TPU pods, launch one
+    process per host externally; init happens in env.init_parallel_env."""
+    init_parallel_env()

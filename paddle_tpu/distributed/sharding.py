@@ -1,0 +1,99 @@
+"""ZeRO sharding policies (parity: the group_sharded stack —
+GroupShardedOptimizerStage2 fleet/meta_parallel/sharding/
+group_sharded_optimizer_stage2.py:48, GroupShardedStage3
+group_sharded_stage3.py:60, public API
+python/paddle/distributed/sharding/group_sharded.py).
+
+TPU-first: a "stage" is a PartitionSpec policy over the 'sdp' mesh axis:
+  stage 1 — optimizer state sharded; params/grads replicated
+  stage 2 — + grads effectively reduce-scattered (XLA picks the pattern
+             from the sharded opt-state output specs)
+  stage 3 — + params sharded; forward all-gathers weights on demand
+The reference's rank-sliced grad storage, param hooks and manual
+broadcast/allgather (group_sharded_stage3.py:399-425) all become these specs.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _extend_spec(spec: Optional[P], shape, axis_size: int, axis_name="sdp", min_size=16384) -> P:
+    """Add axis_name sharding on the largest dim not already sharded and
+    divisible by axis_size. Small params stay replicated."""
+    base = list(spec) if spec is not None else [None] * len(shape)
+    while len(base) < len(shape):
+        base.append(None)
+
+    def canon(b):
+        while b and b[-1] is None:
+            b.pop()
+        return P(*b)
+
+    if axis_size <= 1 or int(np.prod(shape)) < min_size:
+        return canon(base)
+    # pick largest eligible dim
+    cand = [
+        (shape[i], i)
+        for i in range(len(shape))
+        if base[i] is None and shape[i] % axis_size == 0
+    ]
+    if not cand:
+        return canon(base)
+    _, dim = max(cand)
+    base[dim] = axis_name
+    return canon(base)
+
+
+def build_state_specs(params: Dict[str, np.ndarray], mesh: Mesh, stage: int = 1, mp_specs: Optional[Dict[str, P]] = None, opt_state_keys=("m", "v", "u", "velocity", "moment", "mean_square", "mean_grad", "avg_sq_grad", "avg_sq_update")):
+    """Return (param_specs, opt_specs_fn) for a TrainStep state tree."""
+    sdp = mesh.shape.get("sdp", 1)
+    mp_specs = mp_specs or {}
+    param_specs = {}
+    opt_specs = {}
+    for name, arr in params.items():
+        base = mp_specs.get(name)
+        shape = tuple(arr.shape)
+        if stage >= 3:
+            spec = _extend_spec(base, shape, sdp)
+        else:
+            spec = P(*base) if base is not None else P()
+        param_specs[name] = spec
+        if stage >= 1:
+            opt_specs[name] = _extend_spec(base, shape, sdp)
+        else:
+            opt_specs[name] = spec
+    return param_specs, opt_specs
+
+
+def state_shardings(state, mesh: Mesh, stage: int = 1, mp_specs=None):
+    """Shardings pytree matching a TrainStep state dict."""
+    params = state["params"]
+    param_specs, opt_specs = build_state_specs(params, mesh, stage, mp_specs)
+
+    def ns(spec):
+        return NamedSharding(mesh, spec)
+
+    # opt state: dict of moment-name -> {param-name: array}
+    opt_shard = {}
+    for moment_name, tree in state["opt"].items():
+        opt_shard[moment_name] = {k: ns(opt_specs.get(k, P())) for k in tree}
+    return {
+        "params": {k: ns(s) for k, s in param_specs.items()},
+        "buffers": {k: ns(P()) for k in state["buffers"]},
+        "opt": opt_shard,
+        "step": ns(P()),
+        "rng": ns(P()),
+    }
+
+
+def group_sharded_parallel(model, optimizer, level="os_g", scaler=None, group=None, offload=False, sync_buffers=False, buffer_max_size=2**23, segment_size=2**20, sync_comm=False):
+    """API parity (python/paddle/distributed/sharding/group_sharded.py).
+    level: 'os' (stage1) | 'os_g' (stage2) | 'p_g_os' (stage3). Returns the
+    pair unchanged plus records the stage for fleet.distributed_step."""
+    stage = {"os": 1, "os_g": 2, "p_g_os": 3}[level]
+    model._sharding_stage = stage
+    optimizer._sharding_stage = stage
+    return model, optimizer, scaler

@@ -1,0 +1,97 @@
+"""Hybrid topology (parity: python/paddle/distributed/fleet/base/topology.py
+— CommunicateTopology:52 + HybridCommunicateGroup:134).
+
+TPU-first: the 4-D rank grid *is* a ``jax.sharding.Mesh`` with named axes in
+the reference's canonical order data→pipe→sharding→model (+ 'sep' for the
+green-field sequence axis). "Communication groups" are mesh axis names —
+XLA's partitioner emits the collectives; no NCCL comm construction
+(reference new_group → ProcessGroupNCCL) is needed.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+AXES = ("dp", "pp", "sdp", "mp", "sep")
+
+
+class CommunicateTopology:
+    def __init__(self, hybrid_group_names: Sequence[str] = AXES, dims: Sequence[int] = (1, 1, 1, 1, 1)):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(dims)
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, axis_name):
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    def world_size(self):
+        return int(np.prod(self._dims))
+
+
+class HybridCommunicateGroup:
+    """Builds the device mesh and exposes paddle-fleet style accessors."""
+
+    def __init__(self, dp_degree=1, mp_degree=1, pp_degree=1, sharding_degree=1, sep_degree=1, devices: Optional[List] = None):
+        devices = list(devices if devices is not None else jax.devices())
+        need = dp_degree * mp_degree * pp_degree * sharding_degree * sep_degree
+        if need > len(devices):
+            raise ValueError(f"hybrid degrees need {need} devices, have {len(devices)}")
+        devices = devices[:need]
+        self.dims = (dp_degree, pp_degree, sharding_degree, mp_degree, sep_degree)
+        grid = np.array(devices).reshape(self.dims)
+        self.mesh = Mesh(grid, AXES)
+        self.topo = CommunicateTopology(AXES, self.dims)
+
+    # paddle fleet accessors (fleet/base/topology.py:169-260)
+    def get_data_parallel_world_size(self):
+        return self.dims[0]
+
+    def get_pipe_parallel_world_size(self):
+        return self.dims[1]
+
+    def get_sharding_parallel_world_size(self):
+        return self.dims[2]
+
+    def get_model_parallel_world_size(self):
+        return self.dims[3]
+
+    def get_sep_parallel_world_size(self):
+        return self.dims[4]
+
+    def get_data_parallel_rank(self):
+        return 0  # single controller: per-device ranks are mesh coords
+
+    def get_model_parallel_group(self):
+        return "mp"
+
+    def get_data_parallel_group(self):
+        return "dp"
+
+    def get_pipe_parallel_group(self):
+        return "pp"
+
+    def get_sharding_parallel_group(self):
+        return "sdp"
+
+    def get_sep_parallel_group(self):
+        return "sep"
+
+    # -- sharding helpers --------------------------------------------------
+    def sharding(self, *spec) -> NamedSharding:
+        return NamedSharding(self.mesh, PartitionSpec(*spec))
+
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, PartitionSpec())
+
+    def batch_sharding(self) -> NamedSharding:
+        """Global-batch axis sharded over data-like axes (dp × sdp)."""
+        return NamedSharding(self.mesh, PartitionSpec(("dp", "sdp")))
+
+
+def build_mesh(dp=1, mp=1, pp=1, sdp=1, sep=1, devices=None) -> Mesh:
+    return HybridCommunicateGroup(dp, mp, pp, sdp, sep, devices).mesh

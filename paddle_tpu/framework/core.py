@@ -227,6 +227,10 @@ def unwrap(x):
 
 _FLOAT_KINDS = ("f", "V")  # V covers bfloat16 numpy view
 
+# installed by paddle_tpu.amp at import (avoids a circular import); called as
+# _amp_hook(op_name, vals) -> vals when an auto_cast scope is active
+_amp_hook = None
+
 
 def _is_float_array(v) -> bool:
     dt = np.dtype(v.dtype) if hasattr(v, "dtype") else None
@@ -244,6 +248,8 @@ def primitive(fn: Callable, *args, _name: str = "", **kwargs):
     Returns Tensor or tuple of Tensors mirroring fn's output.
     """
     vals = [unwrap(a) for a in args]
+    if _amp_hook is not None:
+        vals = _amp_hook(_name, vals)
     diff_idx = []
     if is_grad_enabled():
         for i, a in enumerate(args):

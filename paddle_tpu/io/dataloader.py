@@ -1,0 +1,107 @@
+"""DataLoader (parity: python/paddle/fluid/reader.py:275 + dataloader_iter.py).
+
+TPU-first: the loader produces host numpy batches on background threads and
+(optionally) prefetches the next batch to device while the current step runs —
+replacing the reference's multiprocess worker + shared-memory LoDTensor
+machinery (dataloader_iter.py:342) with a thread pool, since the heavy lifting
+(decode/augment) releases the GIL in numpy and device transfer is async under
+PJRT anyway.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from .dataset import BatchSampler, Dataset, IterableDataset
+
+
+def default_collate_fn(batch):
+    sample = batch[0]
+    if isinstance(sample, (list, tuple)):
+        return type(sample)(default_collate_fn([b[i] for b in batch]) for i in range(len(sample)))
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([b[k] for b in batch]) for k in sample}
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch)
+    if isinstance(sample, (int, np.integer)):
+        return np.asarray(batch, dtype=np.int64)
+    if isinstance(sample, (float, np.floating)):
+        return np.asarray(batch, dtype=np.float32)
+    # Tensor / jax array
+    if hasattr(sample, "numpy"):
+        return np.stack([np.asarray(b.numpy()) for b in batch])
+    return np.stack([np.asarray(b) for b in batch])
+
+
+class DataLoader:
+    def __init__(self, dataset, feed_list=None, places=None, return_list=True, batch_sampler=None, batch_size=1, shuffle=False, drop_last=False, collate_fn=None, num_workers=0, use_buffer_reader=True, prefetch_factor=2, use_shared_memory=True, timeout=0, worker_init_fn=None, persistent_workers=False):
+        self.dataset = dataset
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = num_workers
+        self.prefetch_factor = max(prefetch_factor, 1)
+        self._iterable_mode = isinstance(dataset, IterableDataset)
+        if self._iterable_mode:
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+        else:
+            self.batch_sampler = BatchSampler(dataset, shuffle=shuffle, batch_size=batch_size, drop_last=drop_last)
+
+    def __len__(self):
+        if self._iterable_mode:
+            raise TypeError("length of IterableDataset loader is unknown")
+        return len(self.batch_sampler)
+
+    def _fetch(self, indices):
+        return self.collate_fn([self.dataset[i] for i in indices])
+
+    def __iter__(self):
+        if self._iterable_mode:
+            yield from self._iter_iterable()
+            return
+        if self.num_workers == 0:
+            for indices in self.batch_sampler:
+                yield self._fetch(indices)
+            return
+        yield from self._iter_threaded()
+
+    def _iter_iterable(self):
+        batch = []
+        for sample in self.dataset:
+            batch.append(sample)
+            if len(batch) == self.batch_size:
+                yield self.collate_fn(batch)
+                batch = []
+        if batch and not self.drop_last:
+            yield self.collate_fn(batch)
+
+    def _iter_threaded(self):
+        with ThreadPoolExecutor(max_workers=self.num_workers) as pool:
+            pending = queue.Queue()
+            sampler_iter = iter(self.batch_sampler)
+            in_flight = 0
+            limit = self.num_workers * self.prefetch_factor
+
+            def submit_next():
+                nonlocal in_flight
+                try:
+                    indices = next(sampler_iter)
+                except StopIteration:
+                    return False
+                pending.put(pool.submit(self._fetch, indices))
+                in_flight += 1
+                return True
+
+            for _ in range(limit):
+                if not submit_next():
+                    break
+            while in_flight:
+                fut = pending.get()
+                in_flight -= 1
+                submit_next()
+                yield fut.result()

@@ -1,0 +1,256 @@
+"""paddle_tpu.jit — the static-graph execution path.
+
+Parity: the reference's whole static stack — ProgramDesc + InterpreterCore
+(paddle/fluid/framework/new_executor/interpretercore.cc:116),
+``@paddle.jit.to_static`` (dygraph_to_static/program_translator.py:239) and
+``paddle.jit.save`` — collapses to jax.jit tracing of the functional layer
+call. The "program" is the jaxpr; the "executor" is XLA; data-transfer
+insertion, stream analysis, GC and op scheduling are XLA's problem.
+"""
+from __future__ import annotations
+
+import contextlib
+import functools
+import os
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import random as _random
+from ..framework.autograd import no_grad
+from ..framework.core import Tensor, _wrap_value, unwrap
+from ..nn.functional_api import _wrap_tree, unwrap_tree
+
+
+def _pure_model_call(model, arrays, args, kwargs, training, rng):
+    """Run model under bound arrays; return (output, updated_buffer_arrays).
+
+    Buffer side effects (BatchNorm running stats) are captured as explicit
+    outputs — the jit-path equivalent of the reference's in-place buffer
+    mutation (paddle/phi/kernels/gpu/batch_norm_kernel.cu writes mean/var out).
+    """
+    modes = [(l, l.training) for l in model.sublayers(include_self=True)]
+    for l, _ in modes:
+        l.training = training
+    rng_ctx = _random.rng_scope(rng) if rng is not None else contextlib.nullcontext()
+    buf_names = [n for n, _ in model.named_buffers()]
+    try:
+        with no_grad(), model.bind(arrays), rng_ctx:
+            out = model(*_wrap_tree(list(args)), **kwargs)
+            new_buffers = {}
+            for n, b in model.named_buffers():
+                new_buffers[n] = b._value
+    finally:
+        for l, was in modes:
+            l.training = was
+    return unwrap_tree(out), new_buffers
+
+
+class TrainStep:
+    """One compiled training step: forward + backward + optimizer update.
+
+    ``loss_fn(output, *labels)`` runs on Tensors (any paddle_tpu loss).
+    Donates the state buffers so param memory stays flat (reference analog:
+    inplace/vars GC in interpretercore; here it's XLA buffer donation).
+    """
+
+    def __init__(self, model, optimizer, loss_fn, mesh=None, state_shardings=None, batch_shardings=None, remat=False, seed=0):
+        self.model = model
+        self.optimizer = optimizer
+        self.loss_fn = loss_fn
+        self.mesh = mesh
+        params = model.param_arrays()
+        buffers = model.buffer_arrays()
+        self.state = {
+            "params": params,
+            "buffers": buffers,
+            "opt": optimizer.core.init(params),
+            "step": jnp.zeros((), jnp.int32),
+            "rng": jax.random.key(seed),
+        }
+        self._build(remat)
+        if mesh is not None and state_shardings is not None:
+            self.state = jax.device_put(self.state, state_shardings)
+            self._jit = jax.jit(self._step, donate_argnums=0, in_shardings=(state_shardings, batch_shardings), out_shardings=(state_shardings, None))
+        else:
+            self._jit = jax.jit(self._step, donate_argnums=0)
+
+    def _build(self, remat):
+        model, optimizer, loss_fn = self.model, self.optimizer, self.loss_fn
+        core = optimizer.core
+        clip = optimizer._grad_clip
+        wd = optimizer._weight_decay
+
+        def loss_of(params, buffers, inputs, labels, rng):
+            def call(p):
+                out, new_buffers = _pure_model_call(model, {**p, **buffers}, inputs, {}, True, rng)
+                with no_grad():
+                    loss_t = loss_fn(*_wrap_tree([out]), *_wrap_tree(list(labels)))
+                return unwrap(loss_t), (out, new_buffers)
+
+            if remat:
+                # rematerialize the forward in backward (paddle recompute /
+                # fleet/utils/recompute.py:209 parity via jax.checkpoint)
+                call = jax.checkpoint(call)
+            return call(params)
+
+        def _step(state, batch):
+            inputs, labels = batch
+            rng = jax.random.fold_in(state["rng"], state["step"])
+            (loss, (out, new_buffers)), grads = jax.value_and_grad(loss_of, has_aux=True)(
+                state["params"], state["buffers"], inputs, labels, rng
+            )
+            if wd:
+                grads = jax.tree_util.tree_map(lambda g, p: g + wd * p, grads, state["params"])
+            if clip is not None:
+                grads = clip.apply_tree(grads)
+            lr = optimizer.lr_at(state["step"])
+            new_params, new_opt = core.update(grads, state["opt"], state["params"], lr, state["step"])
+            new_state = {
+                "params": new_params,
+                "buffers": new_buffers,
+                "opt": new_opt,
+                "step": state["step"] + 1,
+                "rng": state["rng"],
+            }
+            return new_state, {"loss": loss, "lr": lr}
+
+        self._step = _step
+
+    def __call__(self, inputs, labels):
+        inputs = tuple(unwrap(x) if isinstance(x, Tensor) else jnp.asarray(x) for x in (inputs if isinstance(inputs, (list, tuple)) else [inputs]))
+        labels = tuple(unwrap(y) if isinstance(y, Tensor) else jnp.asarray(y) for y in (labels if isinstance(labels, (list, tuple)) else [labels]))
+        self.state, metrics = self._jit(self.state, (inputs, labels))
+        return {k: _wrap_value(v) for k, v in metrics.items()}
+
+    # -- interop -----------------------------------------------------------
+    def sync_to_model(self):
+        """Write compiled-state params/buffers back into the eager model."""
+        for name, p in self.model.named_parameters():
+            p._value = self.state["params"][name]
+        for name, b in self.model.named_buffers():
+            b._value = self.state["buffers"][name]
+
+    def state_dict(self):
+        return self.state
+
+    def set_state(self, state):
+        self.state = state
+
+    def compile(self, sample_inputs, sample_labels):
+        """AOT-compile and return the cost/compile stats (parity: first-run
+        Convert+compile in interpretercore)."""
+        inputs = tuple(jnp.asarray(unwrap(x)) for x in (sample_inputs if isinstance(sample_inputs, (list, tuple)) else [sample_inputs]))
+        labels = tuple(jnp.asarray(unwrap(y)) for y in (sample_labels if isinstance(sample_labels, (list, tuple)) else [sample_labels]))
+        lowered = self._jit.lower(self.state, (inputs, labels))
+        compiled = lowered.compile()
+        return compiled
+
+
+class EvalStep:
+    """Compiled forward-only step."""
+
+    def __init__(self, model, mesh=None):
+        self.model = model
+
+        def _fwd(params, buffers, inputs):
+            out, _ = _pure_model_call(model, {**params, **buffers}, inputs, {}, False, None)
+            return out
+
+        self._jit = jax.jit(_fwd)
+
+    def __call__(self, *inputs):
+        arrays = tuple(unwrap(x) if isinstance(x, Tensor) else jnp.asarray(x) for x in inputs)
+        out = self._jit(self.model.param_arrays(), self.model.buffer_arrays(), arrays)
+        return _wrap_tree(out)
+
+
+def to_static(function=None, input_spec=None, full_graph=True, **kwargs):
+    """Decorator compiling a Tensor-level function/Layer method with jax.jit.
+
+    Parity: @paddle.jit.to_static — but no AST transpile: python control flow
+    must already be trace-friendly (use lax.cond/scan via paddle_tpu ops),
+    which is the XLA contract the reference's transpiler worked around.
+    """
+
+    def decorate(fn):
+        from ..nn.layer.base import Layer
+
+        if isinstance(fn, Layer):
+            model = fn
+
+            @functools.partial(jax.jit, static_argnums=(3,))
+            def _fwd(params, buffers, args, training, rng):
+                out, new_buffers = _pure_model_call(model, {**params, **buffers}, args, {}, training, rng)
+                return out, new_buffers
+
+            @functools.wraps(model.forward)
+            def wrapper(*args):
+                arrays = tuple(unwrap(a) if isinstance(a, Tensor) else jnp.asarray(a) for a in args)
+                rng = _random.split_key() if model.training else None
+                out, new_buffers = _fwd(model.param_arrays(), model.buffer_arrays(), arrays, model.training, rng)
+                # propagate buffer side effects (BatchNorm running stats)
+                for name, b in model.named_buffers():
+                    b._value = new_buffers[name]
+                return _wrap_tree(out)
+
+            wrapper.__wrapped_layer__ = model
+            return wrapper
+
+        @functools.partial(jax.jit)
+        def _pure(args):
+            with no_grad():
+                out = fn(*_wrap_tree(list(args)))
+            return unwrap_tree(out)
+
+        @functools.wraps(fn)
+        def wrapper(*args):
+            arrays = tuple(unwrap(a) if isinstance(a, Tensor) else jnp.asarray(a) for a in args)
+            return _wrap_tree(_pure(arrays))
+
+        return wrapper
+
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+def save(layer, path, input_spec=None, **configs):
+    """paddle.jit.save parity: serialized params + StableHLO module.
+
+    The reference serializes a pruned ProgramDesc + params
+    (python/paddle/fluid/dygraph/jit.py). Here: ``<path>.pdparams`` state
+    dict + ``<path>.stablehlo.mlir`` exported module when input_spec given.
+    """
+    from ..framework.io import save as _save
+
+    model = getattr(layer, "__wrapped_layer__", layer)
+    _save(model.state_dict(), path + ".pdparams")
+    if input_spec:
+        shapes = [jax.ShapeDtypeStruct(tuple(s.shape), jnp.dtype(s.dtype if isinstance(s.dtype, str) else "float32")) for s in input_spec]
+
+        def _fwd(params, buffers, args):
+            out, _ = _pure_model_call(model, {**params, **buffers}, args, {}, False, None)
+            return out
+
+        lowered = jax.jit(_fwd).lower(model.param_arrays(), model.buffer_arrays(), tuple(shapes))
+        with open(path + ".stablehlo.mlir", "w") as f:
+            f.write(lowered.as_text(dialect="stablehlo"))
+    return path
+
+
+def load(path, **configs):
+    from ..framework.io import load as _load
+
+    return _load(path + ".pdparams")
+
+
+class InputSpec:
+    """Parity: paddle.static.InputSpec."""
+
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = shape
+        self.dtype = dtype
+        self.name = name

@@ -1,0 +1,15 @@
+"""paddle_tpu.optimizer (parity: python/paddle/optimizer)."""
+from . import functional  # noqa: F401
+from . import lr  # noqa: F401
+from .optimizer import (  # noqa: F401
+    SGD,
+    Adadelta,
+    Adagrad,
+    Adam,
+    Adamax,
+    AdamW,
+    Lamb,
+    Momentum,
+    Optimizer,
+    RMSProp,
+)

@@ -1,0 +1,197 @@
+"""Functional optimizer cores: ``init(params) -> state``,
+``update(grads, state, params, lr) -> (new_params, new_state)``.
+
+Parity: the per-param optimizer kernels in
+paddle/fluid/operators/optimizers/*.cc (sgd/momentum/adam/adamw/lamb/...).
+TPU-first: one pytree-wide update compiled into the train step — XLA fuses
+the whole update into a handful of elementwise kernels; no per-param op
+dispatch (reference `_append_optimize_op`,
+python/paddle/optimizer/optimizer.py:559).
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+tmap = jax.tree_util.tree_map
+
+
+def _zeros_like_tree(params):
+    return tmap(jnp.zeros_like, params)
+
+
+class SGDCore:
+    def init(self, params):
+        return {}
+
+    def update(self, grads, state, params, lr, step):
+        new_params = tmap(lambda p, g: p - lr * g.astype(p.dtype), params, grads)
+        return new_params, state
+
+
+class MomentumCore:
+    def __init__(self, momentum=0.9, use_nesterov=False):
+        self.mu = momentum
+        self.nesterov = use_nesterov
+
+    def init(self, params):
+        return {"velocity": _zeros_like_tree(params)}
+
+    def update(self, grads, state, params, lr, step):
+        vel = tmap(lambda v, g: self.mu * v + g, state["velocity"], grads)
+        if self.nesterov:
+            new_params = tmap(lambda p, g, v: p - lr * (g + self.mu * v), params, grads, vel)
+        else:
+            new_params = tmap(lambda p, v: p - lr * v, params, vel)
+        return new_params, {"velocity": vel}
+
+
+class AdamCore:
+    def __init__(self, beta1=0.9, beta2=0.999, epsilon=1e-8):
+        self.b1, self.b2, self.eps = beta1, beta2, epsilon
+
+    def init(self, params):
+        return {"m": _zeros_like_tree(params), "v": _zeros_like_tree(params)}
+
+    def _moments(self, grads, state):
+        m = tmap(lambda m, g: self.b1 * m + (1 - self.b1) * g.astype(m.dtype), state["m"], grads)
+        v = tmap(lambda v, g: self.b2 * v + (1 - self.b2) * jnp.square(g.astype(v.dtype)), state["v"], grads)
+        return m, v
+
+    def update(self, grads, state, params, lr, step):
+        m, v = self._moments(grads, state)
+        t = step + 1
+        bc1 = 1 - self.b1**t
+        bc2 = 1 - self.b2**t
+        new_params = tmap(
+            lambda p, mm, vv: p - (lr * (mm / bc1) / (jnp.sqrt(vv / bc2) + self.eps)).astype(p.dtype),
+            params, m, v,
+        )
+        return new_params, {"m": m, "v": v}
+
+
+class AdamWCore(AdamCore):
+    """Decoupled weight decay (reference: operators/optimizers/adamw_op). The
+    ``apply_decay_fn`` predicate mirrors paddle's apply_decay_param_fun."""
+
+    def __init__(self, beta1=0.9, beta2=0.999, epsilon=1e-8, weight_decay=0.01, decay_mask=None):
+        super().__init__(beta1, beta2, epsilon)
+        self.wd = weight_decay
+        self.decay_mask = decay_mask  # pytree of bools matching params, or None
+
+    def update(self, grads, state, params, lr, step):
+        m, v = self._moments(grads, state)
+        t = step + 1
+        bc1 = 1 - self.b1**t
+        bc2 = 1 - self.b2**t
+
+        def upd(p, mm, vv, decay=1.0):
+            p2 = p * (1.0 - lr * self.wd * decay)
+            return p2 - (lr * (mm / bc1) / (jnp.sqrt(vv / bc2) + self.eps)).astype(p.dtype)
+
+        if self.decay_mask is not None:
+            new_params = tmap(
+                lambda p, mm, vv, msk: upd(p, mm, vv, jnp.asarray(msk, p.dtype)),
+                params, m, v, self.decay_mask,
+            )
+        else:
+            new_params = tmap(upd, params, m, v)
+        return new_params, {"m": m, "v": v}
+
+
+class LambCore(AdamCore):
+    """Layer-wise adaptive rates (reference: operators/optimizers/lamb_op.cc,
+    incubate DistributedFusedLamb)."""
+
+    def __init__(self, beta1=0.9, beta2=0.999, epsilon=1e-6, lamb_weight_decay=0.01):
+        super().__init__(beta1, beta2, epsilon)
+        self.wd = lamb_weight_decay
+
+    def update(self, grads, state, params, lr, step):
+        m, v = self._moments(grads, state)
+        t = step + 1
+        bc1 = 1 - self.b1**t
+        bc2 = 1 - self.b2**t
+
+        def upd(p, mm, vv):
+            r = (mm / bc1) / (jnp.sqrt(vv / bc2) + self.eps) + self.wd * p
+            w_norm = jnp.linalg.norm(p.astype(jnp.float32))
+            r_norm = jnp.linalg.norm(r.astype(jnp.float32))
+            trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+            return p - (lr * trust * r).astype(p.dtype)
+
+        new_params = tmap(upd, params, m, v)
+        return new_params, {"m": m, "v": v}
+
+
+class AdagradCore:
+    def __init__(self, epsilon=1e-6, initial_accumulator_value=0.0):
+        self.eps = epsilon
+        self.init_acc = initial_accumulator_value
+
+    def init(self, params):
+        return {"moment": tmap(lambda p: jnp.full_like(p, self.init_acc), params)}
+
+    def update(self, grads, state, params, lr, step):
+        mom = tmap(lambda a, g: a + jnp.square(g), state["moment"], grads)
+        new_params = tmap(lambda p, g, a: p - lr * g / (jnp.sqrt(a) + self.eps), params, grads, mom)
+        return new_params, {"moment": mom}
+
+
+class RMSPropCore:
+    def __init__(self, rho=0.95, epsilon=1e-6, momentum=0.0, centered=False):
+        self.rho, self.eps, self.mu, self.centered = rho, epsilon, momentum, centered
+
+    def init(self, params):
+        s = {"mean_square": _zeros_like_tree(params), "moment": _zeros_like_tree(params)}
+        if self.centered:
+            s["mean_grad"] = _zeros_like_tree(params)
+        return s
+
+    def update(self, grads, state, params, lr, step):
+        ms = tmap(lambda s, g: self.rho * s + (1 - self.rho) * jnp.square(g), state["mean_square"], grads)
+        if self.centered:
+            mg = tmap(lambda s, g: self.rho * s + (1 - self.rho) * g, state["mean_grad"], grads)
+            denom = tmap(lambda s, g: jnp.sqrt(s - jnp.square(g) + self.eps), ms, mg)
+        else:
+            mg = None
+            denom = tmap(lambda s: jnp.sqrt(s + self.eps), ms)
+        mom = tmap(lambda v, g, d: self.mu * v + lr * g / d, state["moment"], grads, denom)
+        new_params = tmap(lambda p, v: p - v, params, mom)
+        new_state = {"mean_square": ms, "moment": mom}
+        if self.centered:
+            new_state["mean_grad"] = mg
+        return new_params, new_state
+
+
+class AdadeltaCore:
+    def __init__(self, rho=0.95, epsilon=1e-6):
+        self.rho, self.eps = rho, epsilon
+
+    def init(self, params):
+        return {"avg_sq_grad": _zeros_like_tree(params), "avg_sq_update": _zeros_like_tree(params)}
+
+    def update(self, grads, state, params, lr, step):
+        asg = tmap(lambda a, g: self.rho * a + (1 - self.rho) * jnp.square(g), state["avg_sq_grad"], grads)
+        upd = tmap(lambda g, a, u: g * jnp.sqrt(u + self.eps) / jnp.sqrt(a + self.eps), grads, asg, state["avg_sq_update"])
+        asu = tmap(lambda u, d: self.rho * u + (1 - self.rho) * jnp.square(d), state["avg_sq_update"], upd)
+        new_params = tmap(lambda p, d: p - lr * d, params, upd)
+        return new_params, {"avg_sq_grad": asg, "avg_sq_update": asu}
+
+
+class AdamaxCore:
+    def __init__(self, beta1=0.9, beta2=0.999, epsilon=1e-8):
+        self.b1, self.b2, self.eps = beta1, beta2, epsilon
+
+    def init(self, params):
+        return {"m": _zeros_like_tree(params), "u": _zeros_like_tree(params)}
+
+    def update(self, grads, state, params, lr, step):
+        t = step + 1
+        m = tmap(lambda m, g: self.b1 * m + (1 - self.b1) * g, state["m"], grads)
+        u = tmap(lambda u, g: jnp.maximum(self.b2 * u, jnp.abs(g)), state["u"], grads)
+        bc1 = 1 - self.b1**t
+        new_params = tmap(lambda p, mm, uu: p - lr / bc1 * mm / (uu + self.eps), params, m, u)
+        return new_params, {"m": m, "u": u}

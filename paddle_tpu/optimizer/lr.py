@@ -1,0 +1,275 @@
+"""LR schedulers (parity: python/paddle/optimizer/lr.py).
+
+Dual-form like everything else: stateful ``get_lr()/step()`` for eager, and
+``lr_at(step)`` — a pure function of the step counter — consumed inside the
+compiled train step (no host round-trip per step).
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+
+class LRScheduler:
+    def __init__(self, learning_rate=0.1, last_epoch=-1, verbose=False):
+        self.base_lr = learning_rate
+        self.last_epoch = last_epoch
+        self.last_lr = learning_rate
+        self.verbose = verbose
+        self.step()
+
+    def get_lr(self):
+        raise NotImplementedError
+
+    def lr_at(self, step):
+        """Pure function of step (traced-friendly). Default: host fallback."""
+        raise NotImplementedError
+
+    def step(self, epoch=None):
+        self.last_epoch = epoch if epoch is not None else self.last_epoch + 1
+        self.last_lr = self.get_lr()
+        if self.verbose:
+            print(f"Epoch {self.last_epoch}: lr set to {self.last_lr}")
+
+    def __call__(self):
+        return self.last_lr
+
+    def state_dict(self):
+        return {"last_epoch": self.last_epoch, "last_lr": self.last_lr}
+
+    def set_state_dict(self, state):
+        self.last_epoch = state["last_epoch"]
+        self.last_lr = state["last_lr"]
+
+
+class NoamDecay(LRScheduler):
+    def __init__(self, d_model, warmup_steps, learning_rate=1.0, last_epoch=-1, verbose=False):
+        self.d_model, self.warmup_steps = d_model, warmup_steps
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def get_lr(self):
+        t = max(self.last_epoch, 1)
+        return self.base_lr * self.d_model**-0.5 * min(t**-0.5, t * self.warmup_steps**-1.5)
+
+    def lr_at(self, step):
+        t = jnp.maximum(step, 1).astype(jnp.float32)
+        return self.base_lr * self.d_model**-0.5 * jnp.minimum(t**-0.5, t * self.warmup_steps**-1.5)
+
+
+class PiecewiseDecay(LRScheduler):
+    def __init__(self, boundaries, values, last_epoch=-1, verbose=False):
+        self.boundaries, self.values = list(boundaries), list(values)
+        super().__init__(values[0], last_epoch, verbose)
+
+    def get_lr(self):
+        for b, v in zip(self.boundaries, self.values):
+            if self.last_epoch < b:
+                return v
+        return self.values[len(self.boundaries)]
+
+    def lr_at(self, step):
+        lr = jnp.asarray(self.values[len(self.boundaries)], jnp.float32)
+        for b, v in zip(reversed(self.boundaries), reversed(self.values[:-1])):
+            lr = jnp.where(step < b, v, lr)
+        return lr
+
+
+class NaturalExpDecay(LRScheduler):
+    def __init__(self, learning_rate, gamma, last_epoch=-1, verbose=False):
+        self.gamma = gamma
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def get_lr(self):
+        return self.base_lr * math.exp(-self.gamma * self.last_epoch)
+
+    def lr_at(self, step):
+        return self.base_lr * jnp.exp(-self.gamma * step.astype(jnp.float32))
+
+
+class InverseTimeDecay(LRScheduler):
+    def __init__(self, learning_rate, gamma, last_epoch=-1, verbose=False):
+        self.gamma = gamma
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def get_lr(self):
+        return self.base_lr / (1 + self.gamma * self.last_epoch)
+
+    def lr_at(self, step):
+        return self.base_lr / (1 + self.gamma * step.astype(jnp.float32))
+
+
+class PolynomialDecay(LRScheduler):
+    def __init__(self, learning_rate, decay_steps, end_lr=0.0001, power=1.0, cycle=False, last_epoch=-1, verbose=False):
+        self.decay_steps, self.end_lr, self.power, self.cycle = decay_steps, end_lr, power, cycle
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def get_lr(self):
+        t = self.last_epoch
+        if self.cycle:
+            div = max(1.0, math.ceil(t / self.decay_steps))
+            decay_steps = self.decay_steps * div
+        else:
+            decay_steps = self.decay_steps
+            t = min(t, decay_steps)
+        return (self.base_lr - self.end_lr) * (1 - t / decay_steps) ** self.power + self.end_lr
+
+    def lr_at(self, step):
+        t = step.astype(jnp.float32)
+        if self.cycle:
+            div = jnp.maximum(1.0, jnp.ceil(t / self.decay_steps))
+            ds = self.decay_steps * div
+        else:
+            ds = jnp.asarray(float(self.decay_steps))
+            t = jnp.minimum(t, ds)
+        return (self.base_lr - self.end_lr) * (1 - t / ds) ** self.power + self.end_lr
+
+
+class LinearWarmup(LRScheduler):
+    def __init__(self, learning_rate, warmup_steps, start_lr, end_lr, last_epoch=-1, verbose=False):
+        self.lr_sched = learning_rate if isinstance(learning_rate, LRScheduler) else None
+        self.after_lr = learning_rate if not isinstance(learning_rate, LRScheduler) else None
+        self.warmup_steps, self.start_lr, self.end_lr = warmup_steps, start_lr, end_lr
+        super().__init__(end_lr, last_epoch, verbose)
+
+    def get_lr(self):
+        t = self.last_epoch
+        if t < self.warmup_steps:
+            return (self.end_lr - self.start_lr) * t / self.warmup_steps + self.start_lr
+        if self.lr_sched is not None:
+            self.lr_sched.last_epoch = t - self.warmup_steps
+            return self.lr_sched.get_lr()
+        return self.after_lr
+
+    def lr_at(self, step):
+        t = step.astype(jnp.float32)
+        warm = (self.end_lr - self.start_lr) * t / self.warmup_steps + self.start_lr
+        if self.lr_sched is not None:
+            after = self.lr_sched.lr_at(step - self.warmup_steps)
+        else:
+            after = jnp.asarray(self.after_lr, jnp.float32)
+        return jnp.where(step < self.warmup_steps, warm, after)
+
+
+class ExponentialDecay(LRScheduler):
+    def __init__(self, learning_rate, gamma, last_epoch=-1, verbose=False):
+        self.gamma = gamma
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def get_lr(self):
+        return self.base_lr * self.gamma**self.last_epoch
+
+    def lr_at(self, step):
+        return self.base_lr * self.gamma ** step.astype(jnp.float32)
+
+
+class MultiStepDecay(LRScheduler):
+    def __init__(self, learning_rate, milestones, gamma=0.1, last_epoch=-1, verbose=False):
+        self.milestones, self.gamma = list(milestones), gamma
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def get_lr(self):
+        n = sum(1 for m in self.milestones if self.last_epoch >= m)
+        return self.base_lr * self.gamma**n
+
+    def lr_at(self, step):
+        n = sum(jnp.where(step >= m, 1, 0) for m in self.milestones)
+        return self.base_lr * self.gamma ** n.astype(jnp.float32)
+
+
+class StepDecay(LRScheduler):
+    def __init__(self, learning_rate, step_size, gamma=0.1, last_epoch=-1, verbose=False):
+        self.step_size, self.gamma = step_size, gamma
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def get_lr(self):
+        return self.base_lr * self.gamma ** (self.last_epoch // self.step_size)
+
+    def lr_at(self, step):
+        return self.base_lr * self.gamma ** (step // self.step_size).astype(jnp.float32)
+
+
+class LambdaDecay(LRScheduler):
+    def __init__(self, learning_rate, lr_lambda, last_epoch=-1, verbose=False):
+        self.lr_lambda = lr_lambda
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def get_lr(self):
+        return self.base_lr * self.lr_lambda(self.last_epoch)
+
+    def lr_at(self, step):
+        return self.base_lr * self.lr_lambda(step)
+
+
+class CosineAnnealingDecay(LRScheduler):
+    def __init__(self, learning_rate, T_max, eta_min=0, last_epoch=-1, verbose=False):
+        self.T_max, self.eta_min = T_max, eta_min
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def get_lr(self):
+        return self.eta_min + (self.base_lr - self.eta_min) * (1 + math.cos(math.pi * self.last_epoch / self.T_max)) / 2
+
+    def lr_at(self, step):
+        return self.eta_min + (self.base_lr - self.eta_min) * (1 + jnp.cos(jnp.pi * step.astype(jnp.float32) / self.T_max)) / 2
+
+
+class OneCycleLR(LRScheduler):
+    def __init__(self, max_learning_rate, total_steps, divide_factor=25.0, end_learning_rate=0.0001, phase_pct=0.3, anneal_strategy="cos", three_phase=False, last_epoch=-1, verbose=False):
+        self.max_lr = max_learning_rate
+        self.total_steps = total_steps
+        self.initial_lr = max_learning_rate / divide_factor
+        self.end_lr = end_learning_rate
+        self.up_steps = int(phase_pct * total_steps)
+        super().__init__(self.initial_lr, last_epoch, verbose)
+
+    def get_lr(self):
+        t = self.last_epoch
+        if t <= self.up_steps:
+            pct = t / max(self.up_steps, 1)
+            return self.initial_lr + (self.max_lr - self.initial_lr) * (1 - math.cos(math.pi * pct)) / 2
+        pct = (t - self.up_steps) / max(self.total_steps - self.up_steps, 1)
+        return self.end_lr + (self.max_lr - self.end_lr) * (1 + math.cos(math.pi * min(pct, 1.0))) / 2
+
+    def lr_at(self, step):
+        t = step.astype(jnp.float32)
+        up = self.initial_lr + (self.max_lr - self.initial_lr) * (1 - jnp.cos(jnp.pi * t / max(self.up_steps, 1))) / 2
+        pct = jnp.minimum((t - self.up_steps) / max(self.total_steps - self.up_steps, 1), 1.0)
+        down = self.end_lr + (self.max_lr - self.end_lr) * (1 + jnp.cos(jnp.pi * pct)) / 2
+        return jnp.where(step <= self.up_steps, up, down)
+
+
+class ReduceOnPlateau(LRScheduler):
+    """Host-driven (metric-dependent) — eager/fit loop only."""
+
+    def __init__(self, learning_rate, mode="min", factor=0.1, patience=10, threshold=1e-4, threshold_mode="rel", cooldown=0, min_lr=0, epsilon=1e-8, verbose=False):
+        self.mode, self.factor, self.patience = mode, factor, patience
+        self.threshold, self.threshold_mode = threshold, threshold_mode
+        self.cooldown, self.min_lr = cooldown, min_lr
+        self.best = None
+        self.num_bad = 0
+        self.cooldown_counter = 0
+        self.base_lr = learning_rate
+        self.last_lr = learning_rate
+        self.last_epoch = 0
+        self.verbose = verbose
+
+    def get_lr(self):
+        return self.last_lr
+
+    def step(self, metrics=None, epoch=None):
+        if metrics is None:
+            return
+        cur = float(metrics.item() if hasattr(metrics, "item") else metrics)
+        better = self.best is None or (cur < self.best - self.threshold if self.mode == "min" else cur > self.best + self.threshold)
+        if better:
+            self.best = cur
+            self.num_bad = 0
+        else:
+            self.num_bad += 1
+        if self.cooldown_counter > 0:
+            self.cooldown_counter -= 1
+            self.num_bad = 0
+        elif self.num_bad > self.patience:
+            self.last_lr = max(self.last_lr * self.factor, self.min_lr)
+            self.cooldown_counter = self.cooldown
+            self.num_bad = 0

@@ -1,0 +1,182 @@
+"""Optimizer classes (parity: python/paddle/optimizer/optimizer.py:91).
+
+Eager: ``opt.step()`` reads param.grad tensors, runs the functional core
+once over the whole param pytree, writes params in place. Jit: the same core
+is consumed by ``paddle_tpu.jit.TrainStep`` so forward+backward+update is a
+single XLA computation (the reference's minimize() emits per-param update
+ops, optimizer.py:1165 — here XLA fuses the lot).
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, _wrap_value
+from ..nn.clip import ClipGradBase
+from . import functional as Fopt
+from .lr import LRScheduler
+
+
+class Optimizer:
+    _core_cls = Fopt.SGDCore
+
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None, grad_clip=None, name=None, core=None, multi_precision=False):
+        self._lr = learning_rate
+        self._params: List[Tensor] = list(parameters) if parameters is not None else []
+        self._grad_clip: Optional[ClipGradBase] = grad_clip
+        self._weight_decay = weight_decay
+        self.core = core if core is not None else self._core_cls()
+        self._state = None
+        self._step_count = 0
+
+    # -- lr ---------------------------------------------------------------
+    def get_lr(self):
+        if isinstance(self._lr, LRScheduler):
+            return self._lr()
+        return self._lr
+
+    def set_lr(self, value):
+        self._lr = value
+
+    def lr_at(self, step):
+        """Traced LR for jit steps."""
+        if isinstance(self._lr, LRScheduler):
+            return self._lr.lr_at(step)
+        return jnp.asarray(self._lr, jnp.float32)
+
+    @property
+    def _learning_rate(self):
+        return self._lr
+
+    # -- eager path --------------------------------------------------------
+    def _ensure_state(self, params_tree):
+        if self._state is None:
+            self._state = self.core.init(params_tree)
+
+    def step(self):
+        params = [p for p in self._params if not p.stop_gradient]
+        grads = [p.grad for p in params]
+        if self._grad_clip is not None:
+            tree = {i: g._value for i, g in enumerate(grads) if g is not None}
+            clipped = self._grad_clip.apply_tree(tree)
+            for i, g in enumerate(grads):
+                if g is not None:
+                    g._value = clipped[i]
+        ptree = {i: p._value for i, p in enumerate(params) if grads[i] is not None}
+        gtree = {i: grads[i]._value for i in ptree}
+        self._pre_update(params, ptree)
+        if self._weight_decay and not isinstance(self, _DecoupledWD):
+            # L2 regularization: grad += wd * param (reference regularizer.py)
+            gtree = {i: g + self._weight_decay * ptree[i] for i, g in gtree.items()}
+        self._ensure_state({i: p._value for i, p in enumerate(params)})
+        new_params, new_state = self._apply(gtree, ptree)
+        for i, p in enumerate(params):
+            if i in new_params:
+                p._apply_update(new_params[i])
+        self._step_count += 1
+
+    def _pre_update(self, params, ptree):
+        """Subclass hook run after grad filtering, before the core update."""
+
+    def _apply(self, gtree, ptree):
+        lr = self.get_lr()
+        state_sub = {k: {i: v[i] for i in ptree} for k, v in self._state.items()} if self._state else {}
+        new_params, new_sub = self.core.update(gtree, state_sub, ptree, lr, self._step_count)
+        for k in new_sub:
+            self._state[k].update(new_sub[k])
+        return new_params, self._state
+
+    def clear_grad(self, set_to_zero=True):
+        for p in self._params:
+            p.grad = None
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, None
+
+    # -- state dict --------------------------------------------------------
+    def state_dict(self):
+        out = {"step": self._step_count}
+        if self._state:
+            for k, tree in self._state.items():
+                for i, v in tree.items():
+                    out[f"{k}.{i}"] = _wrap_value(v)
+        if isinstance(self._lr, LRScheduler):
+            out["LR_Scheduler"] = self._lr.state_dict()
+        return out
+
+    def set_state_dict(self, state):
+        self._step_count = int(state.get("step", 0))
+        if isinstance(self._lr, LRScheduler) and "LR_Scheduler" in state:
+            self._lr.set_state_dict(state["LR_Scheduler"])
+        groups: Dict[str, dict] = {}
+        for key, v in state.items():
+            if key in ("step", "LR_Scheduler"):
+                continue
+            k, i = key.rsplit(".", 1)
+            groups.setdefault(k, {})[int(i)] = v._value if isinstance(v, Tensor) else jnp.asarray(v)
+        if groups:
+            self._state = groups
+
+
+class _DecoupledWD:
+    pass
+
+
+class SGD(Optimizer):
+    _core_cls = Fopt.SGDCore
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None, use_nesterov=False, weight_decay=None, grad_clip=None, name=None, multi_precision=False):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, core=Fopt.MomentumCore(momentum, use_nesterov))
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, parameters=None, weight_decay=None, grad_clip=None, lazy_mode=False, name=None, multi_precision=False):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, core=Fopt.AdamCore(beta1, beta2, epsilon))
+
+
+class AdamW(Optimizer, _DecoupledWD):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, parameters=None, weight_decay=0.01, apply_decay_param_fun=None, grad_clip=None, lr_ratio=None, name=None, multi_precision=False):
+        self.apply_decay_param_fun = apply_decay_param_fun
+        super().__init__(learning_rate, parameters, None, grad_clip, core=Fopt.AdamWCore(beta1, beta2, epsilon, weight_decay))
+
+    def _pre_update(self, params, ptree):
+        # decay mask honoring apply_decay_param_fun (paddle parity) — keyed
+        # exactly like the update tree (grads-present params only)
+        if self.apply_decay_param_fun is not None:
+            self.core.decay_mask = {
+                i: 1.0 if self.apply_decay_param_fun(params[i].name or str(i)) else 0.0 for i in ptree
+            }
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None, exclude_from_weight_decay_fn=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, core=Fopt.LambCore(beta1, beta2, epsilon, lamb_weight_decay))
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None, weight_decay=None, grad_clip=None, name=None, initial_accumulator_value=0.0):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, core=Fopt.AdagradCore(epsilon, initial_accumulator_value))
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0, centered=False, parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, core=Fopt.RMSPropCore(rho, epsilon, momentum, centered))
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95, parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, core=Fopt.AdadeltaCore(rho, epsilon))
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, core=Fopt.AdamaxCore(beta1, beta2, epsilon))

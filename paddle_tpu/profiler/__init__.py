@@ -1,0 +1,139 @@
+"""Profiler (parity: python/paddle/profiler/profiler.py:271 + C++
+platform/profiler).
+
+TPU-first: wraps ``jax.profiler`` — device traces come from XLA/xplane
+(the CUPTI analog), host annotations from ``RecordEvent`` →
+``jax.profiler.TraceAnnotation``. Output is a TensorBoard/perfetto trace dir
+(chrome-trace parity: chrometracing_logger.cc).
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+from enum import Enum
+from typing import Optional
+
+import jax
+
+
+class ProfilerTarget(Enum):
+    CPU = 0
+    GPU = 1
+    TPU = 2
+
+
+class ProfilerState(Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class RecordEvent:
+    """Host-side annotation (parity: platform/profiler/event_tracing.h
+    RecordEvent) that also shows up in the device trace."""
+
+    def __init__(self, name: str, event_type=None):
+        self.name = name
+        self._ann = None
+        self.begin_ns = None
+        self.end_ns = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+    def begin(self):
+        self.begin_ns = time.perf_counter_ns()
+        self._ann = jax.profiler.TraceAnnotation(self.name)
+        self._ann.__enter__()
+
+    def end(self):
+        if self._ann is not None:
+            self._ann.__exit__(None, None, None)
+            self._ann = None
+        self.end_ns = time.perf_counter_ns()
+        _HOST_EVENTS[self.name].append((self.begin_ns, self.end_ns))
+
+
+_HOST_EVENTS = defaultdict(list)
+
+
+class Profiler:
+    def __init__(self, targets=None, scheduler=None, on_trace_ready=None, timer_only=False, record_shapes=False, profile_memory=False, with_flops=False):
+        self.timer_only = timer_only
+        self.log_dir = None
+        self._running = False
+
+    def start(self):
+        import tempfile
+
+        _HOST_EVENTS.clear()  # spans belong to one profiling session
+        if not self.timer_only:
+            self.log_dir = tempfile.mkdtemp(prefix="paddle_tpu_prof_")
+            jax.profiler.start_trace(self.log_dir)
+        self._running = True
+        self._t0 = time.perf_counter()
+        self._t1 = None
+
+    def stop(self):
+        if self._running and not self.timer_only:
+            jax.profiler.stop_trace()
+        self._running = False
+        self._t1 = time.perf_counter()
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    def step(self, num_samples=None):
+        pass
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False, time_unit="ms"):
+        end = self._t1 if self._t1 is not None else time.perf_counter()
+        lines = [f"wall time: {(end - self._t0) * 1000:.2f} ms"]
+        if self.log_dir:
+            lines.append(f"device trace: {self.log_dir} (open with TensorBoard/perfetto)")
+        for name, spans in _HOST_EVENTS.items():
+            total_ms = sum(e - b for b, e in spans) / 1e6
+            lines.append(f"{name}: calls={len(spans)} total={total_ms:.3f} ms")
+        out = "\n".join(lines)
+        print(out)
+        return out
+
+    def export(self, path, format="json"):
+        return self.log_dir
+
+
+@contextlib.contextmanager
+def profile(log_dir: Optional[str] = None):
+    """Simple context: jax.profiler.trace wrapper."""
+    import tempfile
+
+    d = log_dir or tempfile.mkdtemp(prefix="paddle_tpu_prof_")
+    with jax.profiler.trace(d):
+        yield d
+
+
+def export_chrome_tracing(dir_name: str, worker_name=None):
+    def handler(prof):
+        return dir_name
+
+    return handler
+
+
+def start_profiler(state="All", tracer_option="Default"):
+    jax.profiler.start_trace("/tmp/paddle_tpu_profile")
+
+
+def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
+    jax.profiler.stop_trace()

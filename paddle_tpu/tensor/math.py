@@ -13,8 +13,14 @@ import numpy as np
 from ._helpers import Tensor, ensure_tensor, op, to_jax_dtype, unwrap
 
 
+def _scalar_or_tensor(x):
+    # python scalars stay raw so JAX weak typing applies (bf16 + 1.0 -> bf16,
+    # matching paddle's scalar-operand promotion); everything else wraps
+    return x if isinstance(x, (bool, int, float)) else ensure_tensor(x)
+
+
 def _binary(fn, x, y, name=""):
-    return op(fn, ensure_tensor(x), ensure_tensor(y), _name=name)
+    return op(fn, _scalar_or_tensor(x), _scalar_or_tensor(y), _name=name)
 
 
 def _unary(fn, x, name=""):

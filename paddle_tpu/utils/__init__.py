@@ -1,0 +1,10 @@
+from . import unique_name  # noqa: F401
+
+
+def try_import(name):
+    import importlib
+
+    try:
+        return importlib.import_module(name)
+    except ImportError:
+        return None

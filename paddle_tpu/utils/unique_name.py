@@ -1,0 +1,17 @@
+"""Unique-name generator (parity: python/paddle/fluid/unique_name.py)."""
+from __future__ import annotations
+
+import itertools
+from collections import defaultdict
+
+_counters = defaultdict(itertools.count)
+
+
+def generate(key="tmp"):
+    return f"{key}_{next(_counters[key])}"
+
+
+def guard(new_generator=None):
+    import contextlib
+
+    return contextlib.nullcontext()

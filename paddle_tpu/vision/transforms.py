@@ -1,0 +1,79 @@
+"""Vision transforms (parity: python/paddle/vision/transforms) — numpy host
+pipeline (the device never sees per-sample python code)."""
+from __future__ import annotations
+
+import numpy as np
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, x):
+        for t in self.transforms:
+            x = t(x)
+        return x
+
+
+class Normalize:
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False):
+        self.mean = np.asarray(mean, np.float32).reshape(-1, 1, 1)
+        self.std = np.asarray(std, np.float32).reshape(-1, 1, 1)
+
+    def __call__(self, img):
+        return (np.asarray(img, np.float32) - self.mean) / self.std
+
+
+class ToTensor:
+    def __init__(self, data_format="CHW"):
+        pass
+
+    def __call__(self, img):
+        raw = np.asarray(img)
+        # scale decision keyed on the input dtype, not the values, so every
+        # sample in a uint8 dataset gets the same normalization
+        scale = 255.0 if raw.dtype == np.uint8 else 1.0
+        arr = raw.astype(np.float32)
+        if arr.ndim == 3 and arr.shape[-1] in (1, 3):
+            arr = arr.transpose(2, 0, 1)
+        return arr / scale
+
+
+class RandomHorizontalFlip:
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def __call__(self, img):
+        if np.random.rand() < self.prob:
+            return np.ascontiguousarray(img[..., ::-1])
+        return img
+
+
+class RandomCrop:
+    def __init__(self, size, padding=0):
+        self.size = size if isinstance(size, (list, tuple)) else (size, size)
+        self.padding = padding
+
+    def __call__(self, img):
+        c, h, w = img.shape
+        if self.padding:
+            img = np.pad(img, [(0, 0), (self.padding, self.padding), (self.padding, self.padding)])
+            h, w = img.shape[1:]
+        th, tw = self.size
+        i = np.random.randint(0, h - th + 1)
+        j = np.random.randint(0, w - tw + 1)
+        return img[:, i : i + th, j : j + tw]
+
+
+class Resize:
+    def __init__(self, size, interpolation="bilinear"):
+        self.size = size if isinstance(size, (list, tuple)) else (size, size)
+
+    def __call__(self, img):
+        # nearest resize on host (cheap); models needing quality resize do it
+        # on device via F.interpolate
+        c, h, w = img.shape
+        th, tw = self.size
+        yi = (np.arange(th) * h // th).clip(0, h - 1)
+        xi = (np.arange(tw) * w // tw).clip(0, w - 1)
+        return img[:, yi][:, :, xi]

@@ -1,0 +1,60 @@
+"""OpTest harness (parity: the reference's workhorse test base,
+python/paddle/fluid/tests/unittests/op_test.py:309 — check_output vs NumPy +
+check_grad vs numeric finite differences)."""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.framework.core import Tensor
+
+
+def check_output(op_fn, np_fn, inputs, atol=1e-5, rtol=1e-5, **kwargs):
+    """op_fn(*Tensors) vs np_fn(*ndarrays)."""
+    tensors = [paddle.to_tensor(x) for x in inputs]
+    got = op_fn(*tensors, **kwargs)
+    want = np_fn(*inputs, **kwargs)
+    if isinstance(got, (list, tuple)):
+        for g, w in zip(got, want):
+            np.testing.assert_allclose(np.asarray(g.numpy(), np.float64), np.asarray(w, np.float64), atol=atol, rtol=rtol)
+    else:
+        np.testing.assert_allclose(np.asarray(got.numpy(), np.float64), np.asarray(want, np.float64), atol=atol, rtol=rtol)
+
+
+def numeric_grad(fn, inputs, idx, delta=1e-3):
+    """Central finite differences of sum(fn(inputs)) w.r.t. inputs[idx]
+    (parity: op_test.py:126 get_numeric_gradient)."""
+    x = inputs[idx].astype(np.float64)
+    grad = np.zeros_like(x)
+    flat = x.reshape(-1)
+    gflat = grad.reshape(-1)
+
+    def eval_sum(xmod):
+        args = [a.copy() for a in inputs]
+        args[idx] = xmod.astype(inputs[idx].dtype)
+        tensors = [paddle.to_tensor(a) for a in args]
+        out = fn(*tensors)
+        return float(np.asarray(out.numpy(), np.float64).sum())
+
+    for i in range(flat.size):
+        orig = flat[i]
+        flat[i] = orig + delta
+        hi = eval_sum(x)
+        flat[i] = orig - delta
+        lo = eval_sum(x)
+        flat[i] = orig
+        gflat[i] = (hi - lo) / (2 * delta)
+    return grad
+
+
+def check_grad(fn, inputs, grad_idx=None, atol=5e-3, rtol=5e-3, delta=1e-3):
+    """Analytic (tape) gradients vs numeric finite differences."""
+    grad_idx = grad_idx if grad_idx is not None else list(range(len(inputs)))
+    tensors = [paddle.to_tensor(x, stop_gradient=False) for x in inputs]
+    out = fn(*tensors)
+    s = out.sum() if out.ndim > 0 else out
+    s.backward()
+    for idx in grad_idx:
+        analytic = np.asarray(tensors[idx].grad.numpy(), np.float64)
+        numeric = numeric_grad(fn, [np.asarray(i) for i in inputs], idx, delta)
+        np.testing.assert_allclose(analytic, numeric, atol=atol, rtol=rtol, err_msg=f"grad mismatch for input {idx}")

@@ -1,0 +1,119 @@
+"""Eager autograd engine tests (parity target: eager backward semantics,
+reference eager/backward.cc behaviors)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_simple_chain():
+    x = paddle.to_tensor([2.0, 3.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0, 6.0])
+
+
+def test_fanout_accumulation():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = x * 2
+    z = y + y * 3  # y used twice
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [8.0])
+
+
+def test_diamond_graph():
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    a = x * 3
+    b = x * 4
+    c = a * b  # dc/dx = 3*(4x) + 4*(3x) = 24x = 48
+    c.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 48.0)
+
+
+def test_stop_gradient_blocks():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = paddle.to_tensor([2.0])  # stop_gradient=True
+    z = (x * y).sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+    assert y.grad is None
+
+
+def test_detach():
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = x * 2
+    d = y.detach()
+    z = (d * x).sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [6.0])  # only through the direct path
+
+
+def test_no_grad_scope():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 5
+    assert y.stop_gradient and y._node is None
+
+
+def test_backward_twice_raises():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    with pytest.raises(RuntimeError):
+        y.backward()
+
+
+def test_retain_graph():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = (x * 3).sum()
+    y.backward(retain_graph=True)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [6.0])
+
+
+def test_freed_subgraph_raises():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 2
+    a = (y * 2).sum()
+    b = (y * 3).sum()
+    a.backward()
+    with pytest.raises(RuntimeError):
+        b.backward()
+
+
+def test_grad_api():
+    x = paddle.to_tensor(3.0, stop_gradient=False)
+    y = paddle.to_tensor(4.0, stop_gradient=False)
+    gx, gy = paddle.grad(x * x * y, [x, y])
+    np.testing.assert_allclose(gx.numpy(), 24.0)
+    np.testing.assert_allclose(gy.numpy(), 9.0)
+
+
+def test_grad_unused_raises():
+    x = paddle.to_tensor(1.0, stop_gradient=False)
+    z = paddle.to_tensor(1.0, stop_gradient=False)
+    with pytest.raises(ValueError):
+        paddle.grad(x * 2, z)
+    (g,) = paddle.grad(x * 2, z, allow_unused=True)
+    assert g is None
+
+
+def test_non_scalar_backward_with_grad_tensor():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * x
+    y.backward(paddle.to_tensor([1.0, 0.5]))
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
+
+
+def test_multi_output_partial_use():
+    x = paddle.to_tensor(np.arange(6, dtype="float32").reshape(2, 3), stop_gradient=False)
+    a, b = paddle.split(x, 2, axis=0)
+    (a * 2).sum().backward()  # b unused
+    np.testing.assert_allclose(x.grad.numpy(), [[2, 2, 2], [0, 0, 0]])
+
+
+def test_int_outputs_dont_break():
+    x = paddle.to_tensor([3.0, 1.0, 2.0], stop_gradient=False)
+    vals, idx = paddle.topk(x, 2)
+    vals.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [1.0, 0.0, 1.0])

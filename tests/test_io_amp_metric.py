@@ -1,0 +1,178 @@
+"""io / amp / metric / hapi / profiler tests."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.io import BatchSampler, DataLoader, Dataset, DistributedBatchSampler, TensorDataset
+
+
+class _SquaresDataset(Dataset):
+    def __init__(self, n=20):
+        self.n = n
+
+    def __getitem__(self, i):
+        return np.float32([i]), np.int64(i % 3)
+
+    def __len__(self):
+        return self.n
+
+
+class TestDataLoader:
+    def test_basic_batching(self):
+        dl = DataLoader(_SquaresDataset(10), batch_size=4)
+        batches = list(dl)
+        assert len(batches) == 3
+        x, y = batches[0]
+        assert x.shape == (4, 1) and y.dtype == np.int64
+
+    def test_drop_last(self):
+        dl = DataLoader(_SquaresDataset(10), batch_size=4, drop_last=True)
+        assert len(list(dl)) == 2
+
+    def test_shuffle_covers_all(self):
+        dl = DataLoader(_SquaresDataset(16), batch_size=4, shuffle=True)
+        seen = sorted(int(v) for x, _ in dl for v in x.ravel())
+        assert seen == list(range(16))
+
+    def test_threaded_workers_match(self):
+        ds = _SquaresDataset(20)
+        seq = [x.sum() for x, _ in DataLoader(ds, batch_size=5)]
+        thr = [x.sum() for x, _ in DataLoader(ds, batch_size=5, num_workers=2)]
+        np.testing.assert_allclose(sorted(seq), sorted(thr))
+
+    def test_distributed_batch_sampler_partitions(self):
+        ds = _SquaresDataset(16)
+        idx0 = [i for b in DistributedBatchSampler(ds, 2, num_replicas=2, rank=0) for i in b]
+        idx1 = [i for b in DistributedBatchSampler(ds, 2, num_replicas=2, rank=1) for i in b]
+        assert sorted(idx0 + idx1) == list(range(16))
+        assert not set(idx0) & set(idx1)
+
+    def test_tensor_dataset(self):
+        xs = paddle.to_tensor(np.arange(6, dtype="float32").reshape(3, 2))
+        ys = paddle.to_tensor(np.arange(3, dtype="int64"))
+        ds = TensorDataset([xs, ys])
+        x, y = ds[1]
+        np.testing.assert_allclose(x.numpy(), [2, 3])
+
+
+class TestSaveLoad:
+    def test_nested_state(self):
+        d = tempfile.mkdtemp()
+        obj = {"a": paddle.to_tensor([1.0, 2.0]), "b": {"c": 3, "d": [paddle.ones([2, 2])]}}
+        paddle.save(obj, os.path.join(d, "obj.pd"))
+        loaded = paddle.load(os.path.join(d, "obj.pd"))
+        np.testing.assert_allclose(loaded["a"].numpy(), [1.0, 2.0])
+        np.testing.assert_allclose(loaded["b"]["d"][0].numpy(), 1.0)
+        assert loaded["b"]["c"] == 3
+
+
+class TestAMP:
+    def test_auto_cast_casts_matmul(self):
+        a = paddle.randn([4, 4])
+        with paddle.amp.auto_cast(dtype="bfloat16"):
+            out = paddle.matmul(a, a)
+        assert out.dtype == "bfloat16"
+        out2 = paddle.matmul(a, a)
+        assert out2.dtype == "float32"
+
+    def test_black_list_stays_fp32(self):
+        a = paddle.randn([4, 4]).astype("bfloat16")
+        with paddle.amp.auto_cast(dtype="bfloat16"):
+            out = paddle.sum(a)
+        assert out.dtype == "float32"
+
+    def test_grad_scaler_fp16_flow(self):
+        net = nn.Linear(4, 2)
+        opt = paddle.optimizer.SGD(learning_rate=0.01, parameters=net.parameters())
+        scaler = paddle.amp.GradScaler(init_loss_scaling=128.0)
+        x = paddle.randn([4, 4])
+        loss = net(x).sum()
+        scaled = scaler.scale(loss)
+        scaled.backward()
+        scaler.step(opt)
+        assert np.isfinite(net.weight.numpy()).all()
+
+    def test_grad_scaler_skips_on_inf(self):
+        net = nn.Linear(2, 2)
+        w0 = net.weight.numpy().copy()
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+        scaler = paddle.amp.GradScaler(init_loss_scaling=4.0)
+        loss = (net(paddle.to_tensor([[1e30, 1e30]])) * 1e30).sum()
+        scaler.scale(loss).backward()
+        scaler.step(opt)
+        np.testing.assert_allclose(net.weight.numpy(), w0)  # step skipped
+        assert scaler.get_loss_scaling() <= 4.0
+
+
+class TestMetric:
+    def test_accuracy(self):
+        from paddle_tpu.metric import Accuracy, accuracy
+
+        m = Accuracy()
+        pred = np.array([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]], "float32")
+        label = np.array([1, 0, 0])
+        correct, _ = m.compute(pred, label)
+        m.update(correct)
+        np.testing.assert_allclose(m.accumulate(), 2 / 3)
+        np.testing.assert_allclose(float(accuracy(pred, label).item()), 2 / 3, rtol=1e-6)
+
+    def test_auc_perfect(self):
+        from paddle_tpu.metric import Auc
+
+        auc = Auc()
+        preds = np.array([0.1, 0.2, 0.8, 0.9])
+        labels = np.array([0, 0, 1, 1])
+        auc.update(preds, labels)
+        assert auc.accumulate() > 0.99
+
+    def test_precision_recall(self):
+        from paddle_tpu.metric import Precision, Recall
+
+        p, r = Precision(), Recall()
+        preds = np.array([0.9, 0.9, 0.1, 0.1])
+        labels = np.array([1, 0, 1, 0])
+        p.update(preds, labels)
+        r.update(preds, labels)
+        assert p.accumulate() == 0.5 and r.accumulate() == 0.5
+
+
+class TestHapi:
+    def test_model_fit(self):
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(4, 16), nn.Tanh(), nn.Linear(16, 3))
+        model = paddle.Model(net)
+        model.prepare(
+            optimizer=paddle.optimizer.Adam(learning_rate=1e-2, parameters=net.parameters()),
+            loss=nn.CrossEntropyLoss(),
+        )
+        X = np.random.randn(64, 4).astype("float32")
+        Y = (X[:, 0] > 0).astype("int64")
+        ds = [(X[i : i + 16], Y[i : i + 16]) for i in range(0, 64, 16)]
+        hist = model.fit(ds, epochs=6, verbose=0)
+        assert hist[-1] < hist[0]
+
+
+class TestProfiler:
+    def test_record_event_and_summary(self):
+        from paddle_tpu.profiler import Profiler, RecordEvent
+
+        prof = Profiler(timer_only=True)
+        prof.start()
+        with RecordEvent("my_step"):
+            paddle.matmul(paddle.ones([64, 64]), paddle.ones([64, 64])).numpy()
+        prof.stop()
+        out = prof.summary()
+        assert "my_step" in out
+
+
+class TestFlags:
+    def test_set_get(self):
+        paddle.set_flags({"FLAGS_check_nan_inf": True})
+        assert paddle.get_flags("FLAGS_check_nan_inf")["FLAGS_check_nan_inf"] is True
+        paddle.set_flags({"FLAGS_check_nan_inf": False})
+        with pytest.raises(KeyError):
+            paddle.set_flags({"FLAGS_nonexistent": 1})

@@ -1,0 +1,264 @@
+"""nn layer tests (parity: the API/dygraph unittest style)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from op_test import check_grad
+
+
+def _rand(*shape):
+    return np.random.randn(*shape).astype("float32")
+
+
+class TestLinear:
+    def test_forward(self):
+        lin = nn.Linear(4, 3)
+        x = _rand(2, 4)
+        out = lin(paddle.to_tensor(x))
+        want = x @ lin.weight.numpy() + lin.bias.numpy()
+        np.testing.assert_allclose(out.numpy(), want, rtol=1e-5)
+
+    def test_no_bias(self):
+        lin = nn.Linear(4, 3, bias_attr=False)
+        assert lin.bias is None
+
+    def test_grad_check(self):
+        w, b = _rand(3, 2), _rand(2)
+        check_grad(lambda x, wt, bt: F.linear(x, wt, bt), [_rand(4, 3), w, b])
+
+
+class TestConv:
+    def test_conv2d_shapes(self):
+        conv = nn.Conv2D(3, 8, 3, stride=2, padding=1)
+        out = conv(paddle.to_tensor(_rand(2, 3, 16, 16)))
+        assert out.shape == [2, 8, 8, 8]
+
+    def test_conv2d_vs_manual(self):
+        # 1x1 conv == matmul over channels
+        conv = nn.Conv2D(3, 5, 1, bias_attr=False)
+        x = _rand(2, 3, 4, 4)
+        out = conv(paddle.to_tensor(x))
+        w = conv.weight.numpy().reshape(5, 3)
+        want = np.einsum("nchw,oc->nohw", x, w)
+        np.testing.assert_allclose(out.numpy(), want, atol=1e-5)
+
+    def test_conv_grad(self):
+        w = _rand(2, 3, 3, 3)
+        check_grad(lambda x, wt: F.conv2d(x, wt, padding=1), [_rand(1, 3, 5, 5), w], atol=1e-2, rtol=1e-2)
+
+    def test_conv_transpose_shape(self):
+        deconv = nn.Conv2DTranspose(4, 2, 3, stride=2, padding=1, output_padding=1)
+        out = deconv(paddle.to_tensor(_rand(1, 4, 8, 8)))
+        assert out.shape == [1, 2, 16, 16]
+
+    def test_groups(self):
+        conv = nn.Conv2D(4, 8, 3, groups=2, padding=1)
+        assert conv(paddle.to_tensor(_rand(1, 4, 6, 6))).shape == [1, 8, 6, 6]
+
+
+class TestNorm:
+    def test_layernorm_stats(self):
+        ln = nn.LayerNorm(8)
+        out = ln(paddle.to_tensor(_rand(4, 8))).numpy()
+        np.testing.assert_allclose(out.mean(-1), 0, atol=1e-5)
+        np.testing.assert_allclose(out.std(-1), 1, atol=1e-2)
+
+    def test_batchnorm_train_eval(self):
+        bn = nn.BatchNorm2D(3)
+        x = _rand(4, 3, 5, 5) * 2 + 1
+        bn.train()
+        out = bn(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(out.mean((0, 2, 3)), 0, atol=1e-4)
+        # running stats moved toward batch stats
+        assert not np.allclose(bn._mean.numpy(), 0)
+        bn.eval()
+        out2 = bn(paddle.to_tensor(x))
+        assert out2.shape == [4, 3, 5, 5]
+
+    def test_groupnorm(self):
+        gn = nn.GroupNorm(2, 4)
+        out = gn(paddle.to_tensor(_rand(2, 4, 3, 3)))
+        assert out.shape == [2, 4, 3, 3]
+
+    def test_rmsnorm(self):
+        rn = nn.RMSNorm(8)
+        out = rn(paddle.to_tensor(_rand(2, 8))).numpy()
+        assert np.isfinite(out).all()
+
+
+class TestActivationsPooling:
+    def test_activations(self):
+        x = paddle.to_tensor(_rand(3, 4))
+        for layer in [nn.ReLU(), nn.GELU(), nn.Sigmoid(), nn.Tanh(), nn.LeakyReLU(), nn.Silu(), nn.Mish(), nn.Softmax()]:
+            out = layer(x)
+            assert out.shape == [3, 4]
+        np.testing.assert_allclose(nn.ReLU()(x).numpy(), np.maximum(x.numpy(), 0))
+
+    def test_softmax_sums_to_one(self):
+        out = F.softmax(paddle.to_tensor(_rand(2, 5))).numpy()
+        np.testing.assert_allclose(out.sum(-1), 1.0, rtol=1e-5)
+
+    def test_pools(self):
+        x = paddle.to_tensor(_rand(1, 2, 8, 8))
+        assert nn.MaxPool2D(2, 2)(x).shape == [1, 2, 4, 4]
+        assert nn.AvgPool2D(2, 2)(x).shape == [1, 2, 4, 4]
+        assert nn.AdaptiveAvgPool2D(1)(x).shape == [1, 2, 1, 1]
+        np.testing.assert_allclose(
+            nn.AdaptiveAvgPool2D(1)(x).numpy().ravel(), x.numpy().mean((2, 3)).ravel(), rtol=1e-5
+        )
+
+    def test_maxpool_matches_numpy(self):
+        x = _rand(1, 1, 4, 4)
+        out = F.max_pool2d(paddle.to_tensor(x), 2, 2).numpy()
+        want = x.reshape(1, 1, 2, 2, 2, 2).max((3, 5))
+        np.testing.assert_allclose(out, want)
+
+
+class TestLosses:
+    def test_cross_entropy_matches_manual(self):
+        logits = _rand(4, 5)
+        labels = np.array([0, 2, 1, 4])
+        loss = F.cross_entropy(paddle.to_tensor(logits), paddle.to_tensor(labels)).item()
+        p = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+        want = -np.log(p[np.arange(4), labels]).mean()
+        np.testing.assert_allclose(loss, want, rtol=1e-5)
+
+    def test_cross_entropy_ignore_index(self):
+        logits = _rand(4, 5)
+        labels = np.array([0, -100, 1, -100])
+        loss = F.cross_entropy(paddle.to_tensor(logits), paddle.to_tensor(labels)).item()
+        p = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+        want = -np.log(p[[0, 2], [0, 1]]).mean()
+        np.testing.assert_allclose(loss, want, rtol=1e-5)
+
+    def test_soft_label_and_smoothing(self):
+        logits = _rand(3, 4)
+        soft = np.abs(_rand(3, 4))
+        soft = soft / soft.sum(-1, keepdims=True)
+        out = F.cross_entropy(paddle.to_tensor(logits), paddle.to_tensor(soft), soft_label=True)
+        assert np.isfinite(out.item())
+        out2 = F.cross_entropy(paddle.to_tensor(logits), paddle.to_tensor(np.array([0, 1, 2])), label_smoothing=0.1)
+        assert np.isfinite(out2.item())
+
+    def test_mse_bce(self):
+        a, b = _rand(3, 4), _rand(3, 4)
+        np.testing.assert_allclose(F.mse_loss(paddle.to_tensor(a), paddle.to_tensor(b)).item(), ((a - b) ** 2).mean(), rtol=1e-5)
+        logits, y = _rand(4), (np.random.rand(4) > 0.5).astype("float32")
+        got = F.binary_cross_entropy_with_logits(paddle.to_tensor(logits), paddle.to_tensor(y)).item()
+        p = 1 / (1 + np.exp(-logits))
+        want = -(y * np.log(p) + (1 - y) * np.log(1 - p)).mean()
+        np.testing.assert_allclose(got, want, rtol=1e-4)
+
+    def test_nll_4d(self):
+        logp = _rand(2, 3, 4, 4)
+        lab = np.random.randint(0, 3, (2, 4, 4))
+        out = F.nll_loss(paddle.to_tensor(logp), paddle.to_tensor(lab))
+        assert np.isfinite(out.item())
+
+
+class TestTransformer:
+    def test_mha_shapes_and_grad(self):
+        mha = nn.MultiHeadAttention(16, 4)
+        x = paddle.to_tensor(_rand(2, 6, 16), stop_gradient=False)
+        out = mha(x)
+        assert out.shape == [2, 6, 16]
+        out.sum().backward()
+        assert mha.q_proj.weight.grad is not None
+
+    def test_encoder_decoder(self):
+        model = nn.Transformer(d_model=16, nhead=4, num_encoder_layers=2, num_decoder_layers=2, dim_feedforward=32)
+        src = paddle.to_tensor(_rand(2, 5, 16))
+        tgt = paddle.to_tensor(_rand(2, 3, 16))
+        out = model(src, tgt)
+        assert out.shape == [2, 3, 16]
+
+    def test_causal_mask_blocks_future(self):
+        mha = nn.MultiHeadAttention(8, 2)
+        mha.eval()
+        x = _rand(1, 4, 8)
+        mask = nn.Transformer.generate_square_subsequent_mask(4)
+        out1 = mha(paddle.to_tensor(x), attn_mask=mask).numpy()
+        x2 = x.copy()
+        x2[0, -1] = 999.0  # future token change must not affect t=0
+        out2 = mha(paddle.to_tensor(x2), attn_mask=mask).numpy()
+        np.testing.assert_allclose(out1[0, 0], out2[0, 0], atol=1e-5)
+
+
+class TestRNN:
+    def test_lstm_gru_shapes(self):
+        out, (h, c) = nn.LSTM(4, 8, num_layers=2)(paddle.to_tensor(_rand(3, 5, 4)))
+        assert out.shape == [3, 5, 8] and h.shape == [2, 3, 8]
+        out, h = nn.GRU(4, 8)(paddle.to_tensor(_rand(3, 5, 4)))
+        assert out.shape == [3, 5, 8]
+
+    def test_bidirectional(self):
+        out, h = nn.SimpleRNN(4, 8, direction="bidirect")(paddle.to_tensor(_rand(2, 5, 4)))
+        assert out.shape == [2, 5, 16]
+
+    def test_lstm_grad(self):
+        lstm = nn.LSTM(4, 8)
+        x = paddle.to_tensor(_rand(2, 5, 4), stop_gradient=False)
+        out, _ = lstm(x)
+        out.sum().backward()
+        assert x.grad is not None and np.isfinite(x.grad.numpy()).all()
+
+
+class TestLayerMechanics:
+    def test_state_dict_roundtrip(self):
+        net = nn.Sequential(nn.Linear(4, 8), nn.BatchNorm1D(8), nn.Linear(8, 2))
+        sd = net.state_dict()
+        net2 = nn.Sequential(nn.Linear(4, 8), nn.BatchNorm1D(8), nn.Linear(8, 2))
+        missing, unexpected = net2.set_state_dict(sd)
+        assert not missing and not unexpected
+        x = _rand(3, 4)
+        net.eval(), net2.eval()
+        np.testing.assert_allclose(net(paddle.to_tensor(x)).numpy(), net2(paddle.to_tensor(x)).numpy(), rtol=1e-6)
+
+    def test_named_parameters(self):
+        net = nn.Sequential(nn.Linear(2, 3), nn.Linear(3, 4))
+        names = dict(net.named_parameters())
+        assert "0.weight" in names and "1.bias" in names
+
+    def test_hooks(self):
+        lin = nn.Linear(2, 2)
+        calls = []
+        h = lin.register_forward_post_hook(lambda layer, inp, out: calls.append(1))
+        lin(paddle.to_tensor(_rand(1, 2)))
+        assert calls == [1]
+        h.remove()
+        lin(paddle.to_tensor(_rand(1, 2)))
+        assert calls == [1]
+
+    def test_train_eval_propagates(self):
+        net = nn.Sequential(nn.Linear(2, 2), nn.Dropout(0.5))
+        net.eval()
+        assert not net[1].training
+        net.train()
+        assert net[1].training
+
+    def test_parameter_shadowing(self):
+        # regression: self.bias = None then Parameter must resolve to the param
+        lin = nn.Linear(3, 3)
+        assert lin.bias is not None
+        assert "bias" in dict(lin.named_parameters())
+
+
+class TestEmbedDropout:
+    def test_embedding_padding_idx(self):
+        emb = nn.Embedding(10, 4, padding_idx=0)
+        out = emb(paddle.to_tensor(np.array([0, 3])))
+        np.testing.assert_allclose(out.numpy()[0], 0.0)
+        assert not np.allclose(out.numpy()[1], 0.0)
+
+    def test_dropout_modes(self):
+        d = nn.Dropout(0.5)
+        x = paddle.ones([1000])
+        d.eval()
+        np.testing.assert_allclose(d(x).numpy(), 1.0)
+        d.train()
+        out = d(x).numpy()
+        assert abs((out == 0).mean() - 0.5) < 0.1
+        # upscale keeps expectation
+        assert abs(out.mean() - 1.0) < 0.15

@@ -1,0 +1,136 @@
+"""Optimizer + LR scheduler + clip tests."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.optimizer import SGD, Adam, AdamW, Lamb, Momentum, RMSProp, lr as lr_mod
+
+
+def _train(opt_factory, steps=60):
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(4, 16), nn.Tanh(), nn.Linear(16, 1))
+    opt = opt_factory(net.parameters())
+    X = np.random.RandomState(0).randn(64, 4).astype("float32")
+    Y = X[:, :1] * 1.5 - X[:, 1:2]
+    first = last = None
+    for _ in range(steps):
+        loss = nn.MSELoss()(net(paddle.to_tensor(X)), paddle.to_tensor(Y))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        if first is None:
+            first = loss.item()
+        last = loss.item()
+    return first, last
+
+
+@pytest.mark.parametrize(
+    "factory",
+    [
+        lambda p: SGD(learning_rate=0.1, parameters=p),
+        lambda p: Momentum(learning_rate=0.05, parameters=p),
+        lambda p: Adam(learning_rate=0.01, parameters=p),
+        lambda p: AdamW(learning_rate=0.01, parameters=p),
+        lambda p: Lamb(learning_rate=0.01, parameters=p),
+        lambda p: RMSProp(learning_rate=0.005, parameters=p),
+    ],
+)
+def test_optimizers_converge(factory):
+    first, last = _train(factory)
+    assert last < first * 0.5, f"{first} -> {last}"
+
+
+def test_sgd_exact_update():
+    p = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    opt = SGD(learning_rate=0.1, parameters=[p])
+    (p * p).sum().backward()
+    opt.step()
+    np.testing.assert_allclose(p.numpy(), [1.0 - 0.1 * 2.0, 2.0 - 0.1 * 4.0], rtol=1e-6)
+
+
+def test_adam_bias_correction_first_step():
+    p = paddle.to_tensor([1.0], stop_gradient=False)
+    opt = Adam(learning_rate=0.1, parameters=[p])
+    (p * 3.0).sum().backward()  # grad = 3
+    opt.step()
+    # first step of adam ≈ -lr * sign(g) regardless of magnitude
+    np.testing.assert_allclose(p.numpy(), [1.0 - 0.1], rtol=1e-4)
+
+
+def test_adamw_decoupled_decay():
+    p = paddle.to_tensor([10.0], stop_gradient=False)
+    opt = AdamW(learning_rate=0.1, weight_decay=0.5, parameters=[p])
+    (p * 0.0).sum().backward()  # zero grad: only decay acts
+    opt.step()
+    np.testing.assert_allclose(p.numpy(), [10.0 * (1 - 0.1 * 0.5)], rtol=1e-5)
+
+
+def test_grad_clip_global_norm():
+    from paddle_tpu.nn import ClipGradByGlobalNorm
+
+    p = paddle.to_tensor([3.0, 4.0], stop_gradient=False)
+    opt = SGD(learning_rate=1.0, parameters=[p], grad_clip=ClipGradByGlobalNorm(1.0))
+    (p * p).sum().backward()  # grad [6, 8], norm 10 -> scaled to [0.6, 0.8]
+    opt.step()
+    np.testing.assert_allclose(p.numpy(), [3.0 - 0.6, 4.0 - 0.8], rtol=1e-5)
+
+
+def test_optimizer_state_dict_roundtrip():
+    p = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    opt = Adam(learning_rate=0.01, parameters=[p])
+    for _ in range(3):
+        (p * p).sum().backward()
+        opt.step()
+        opt.clear_grad()
+    sd = opt.state_dict()
+    opt2 = Adam(learning_rate=0.01, parameters=[p])
+    opt2.set_state_dict(sd)
+    assert opt2._step_count == 3
+    np.testing.assert_allclose(opt2._state["m"][0], opt._state["m"][0])
+
+
+class TestLRSchedulers:
+    def test_step_decay(self):
+        sch = lr_mod.StepDecay(learning_rate=1.0, step_size=2, gamma=0.5)
+        lrs = [sch()]
+        for _ in range(4):
+            sch.step()
+            lrs.append(sch())
+        assert lrs[0] == 1.0 and lrs[2] == 0.5 and lrs[4] == 0.25
+
+    def test_warmup(self):
+        sch = lr_mod.LinearWarmup(learning_rate=1.0, warmup_steps=10, start_lr=0.0, end_lr=1.0)
+        vals = []
+        for _ in range(12):
+            vals.append(sch())
+            sch.step()
+        assert vals[0] == 0.0 and abs(vals[5] - 0.5) < 1e-6 and vals[11] == 1.0
+
+    def test_cosine(self):
+        sch = lr_mod.CosineAnnealingDecay(learning_rate=1.0, T_max=10)
+        sch.step(epoch=10)
+        assert abs(sch() - 0.0) < 1e-6
+
+    def test_lr_at_traced_matches_host(self):
+        import jax.numpy as jnp
+
+        for sch in [
+            lr_mod.StepDecay(learning_rate=1.0, step_size=3, gamma=0.1),
+            lr_mod.CosineAnnealingDecay(learning_rate=0.5, T_max=20),
+            lr_mod.PolynomialDecay(learning_rate=1.0, decay_steps=10),
+            lr_mod.LinearWarmup(learning_rate=0.8, warmup_steps=5, start_lr=0.0, end_lr=0.8),
+        ]:
+            for t in [0, 2, 5, 9, 15]:
+                sch.last_epoch = t
+                host = sch.get_lr()
+                traced = float(sch.lr_at(jnp.asarray(t)))
+                np.testing.assert_allclose(traced, host, rtol=1e-5, err_msg=f"{type(sch).__name__} @ {t}")
+
+    def test_scheduler_in_optimizer(self):
+        p = paddle.to_tensor([1.0], stop_gradient=False)
+        sch = lr_mod.StepDecay(learning_rate=0.1, step_size=1, gamma=0.5)
+        opt = SGD(learning_rate=sch, parameters=[p])
+        assert opt.get_lr() == 0.1
+        sch.step()
+        assert opt.get_lr() == 0.05

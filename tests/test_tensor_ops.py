@@ -1,0 +1,230 @@
+"""Tensor op correctness + gradients (OpTest parity)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import check_grad, check_output
+
+
+def _rand(*shape):
+    return np.random.randn(*shape).astype("float32")
+
+
+class TestElementwise:
+    def test_binary_ops(self):
+        a, b = _rand(3, 4), _rand(3, 4) + 2.0
+        for op, ref in [
+            (paddle.add, np.add),
+            (paddle.subtract, np.subtract),
+            (paddle.multiply, np.multiply),
+            (paddle.divide, np.divide),
+            (paddle.maximum, np.maximum),
+            (paddle.minimum, np.minimum),
+            (paddle.atan2, np.arctan2),
+        ]:
+            check_output(op, ref, [a, b])
+
+    def test_binary_broadcast(self):
+        check_output(paddle.add, np.add, [_rand(3, 4), _rand(4)])
+        check_output(paddle.multiply, np.multiply, [_rand(2, 1, 4), _rand(3, 1)])
+
+    def test_unary_ops(self):
+        x = np.abs(_rand(3, 4)) + 0.5
+        for op, ref in [
+            (paddle.sqrt, np.sqrt),
+            (paddle.exp, np.exp),
+            (paddle.log, np.log),
+            (paddle.abs, np.abs),
+            (paddle.tanh, np.tanh),
+            (paddle.floor, np.floor),
+            (paddle.ceil, np.ceil),
+            (paddle.square, np.square),
+            (paddle.reciprocal, np.reciprocal),
+        ]:
+            check_output(op, ref, [x])
+
+    def test_binary_grads(self):
+        a, b = _rand(3, 4), np.abs(_rand(3, 4)) + 1.0
+        check_grad(paddle.multiply, [a, b])
+        check_grad(paddle.divide, [a, b])
+        check_grad(lambda x, y: paddle.pow(paddle.abs(x) + 1.0, y), [a, b])
+
+    def test_unary_grads(self):
+        x = np.abs(_rand(4, 3)) + 0.5
+        check_grad(paddle.sqrt, [x])
+        check_grad(paddle.log, [x])
+        check_grad(paddle.tanh, [x])
+        check_grad(paddle.sigmoid, [x])
+        check_grad(paddle.erf, [x])
+
+
+class TestReduce:
+    def test_reductions(self):
+        x = _rand(3, 4, 5)
+        check_output(paddle.sum, lambda v: np.sum(v), [x])
+        check_output(lambda t: paddle.sum(t, axis=1), lambda v: v.sum(1), [x])
+        check_output(lambda t: paddle.mean(t, axis=[0, 2], keepdim=True), lambda v: v.mean((0, 2), keepdims=True), [x])
+        check_output(lambda t: paddle.max(t, axis=-1), lambda v: v.max(-1), [x])
+        check_output(lambda t: paddle.prod(t, axis=0), lambda v: v.prod(0), [x])
+        check_output(lambda t: paddle.logsumexp(t, axis=1), lambda v: np.log(np.exp(v).sum(1)), [x])
+
+    def test_reduce_grads(self):
+        x = _rand(3, 4)
+        check_grad(lambda t: paddle.sum(t, axis=0), [x])
+        check_grad(lambda t: paddle.mean(t), [x])
+        check_grad(lambda t: paddle.max(t, axis=1), [x])
+
+    def test_cumsum(self):
+        x = _rand(3, 4)
+        check_output(lambda t: paddle.cumsum(t, axis=1), lambda v: np.cumsum(v, 1), [x])
+        check_grad(lambda t: paddle.cumsum(t, axis=0), [x])
+
+
+class TestMatmul:
+    def test_matmul(self):
+        check_output(paddle.matmul, np.matmul, [_rand(3, 4), _rand(4, 5)])
+        check_output(paddle.matmul, np.matmul, [_rand(2, 3, 4), _rand(2, 4, 5)])
+        check_output(
+            lambda a, b: paddle.matmul(a, b, transpose_y=True),
+            lambda a, b: a @ b.T,
+            [_rand(3, 4), _rand(5, 4)],
+        )
+
+    def test_matmul_grad(self):
+        check_grad(paddle.matmul, [_rand(3, 4), _rand(4, 5)])
+
+    def test_einsum(self):
+        a, b = _rand(3, 4), _rand(4, 5)
+        check_output(lambda x, y: paddle.einsum("ij,jk->ik", x, y), lambda x, y: x @ y, [a, b])
+
+    def test_addmm_bmm(self):
+        check_output(paddle.bmm, np.matmul, [_rand(2, 3, 4), _rand(2, 4, 5)])
+        check_output(
+            lambda i, a, b: paddle.addmm(i, a, b, beta=0.5, alpha=2.0),
+            lambda i, a, b: 0.5 * i + 2.0 * (a @ b),
+            [_rand(3, 5), _rand(3, 4), _rand(4, 5)],
+        )
+
+
+class TestManipulation:
+    def test_reshape_transpose(self):
+        x = _rand(2, 3, 4)
+        check_output(lambda t: paddle.reshape(t, [6, 4]), lambda v: v.reshape(6, 4), [x])
+        check_output(lambda t: paddle.transpose(t, [2, 0, 1]), lambda v: v.transpose(2, 0, 1), [x])
+        check_output(lambda t: paddle.flatten(t, 1), lambda v: v.reshape(2, 12), [x])
+        check_grad(lambda t: paddle.transpose(t, [1, 0, 2]), [x])
+
+    def test_concat_split_stack(self):
+        a, b = _rand(2, 3), _rand(2, 3)
+        check_output(lambda x, y: paddle.concat([x, y], axis=0), lambda x, y: np.concatenate([x, y], 0), [a, b])
+        check_output(lambda x, y: paddle.stack([x, y], axis=1), lambda x, y: np.stack([x, y], 1), [a, b])
+        x = _rand(6, 4)
+        outs = paddle.split(paddle.to_tensor(x), 3, axis=0)
+        np.testing.assert_allclose(outs[1].numpy(), x[2:4])
+        outs = paddle.split(paddle.to_tensor(x), [2, -1], axis=0)
+        assert outs[1].shape == [4, 4]
+
+    def test_split_indivisible_raises(self):
+        with pytest.raises(ValueError):
+            paddle.split(paddle.ones([5]), 2)
+
+    def test_gather_scatter(self):
+        x = _rand(5, 3)
+        idx = np.array([0, 2, 4])
+        check_output(lambda t: paddle.gather(t, paddle.to_tensor(idx)), lambda v: v[idx], [x])
+        check_grad(lambda t: paddle.gather(t, paddle.to_tensor(idx)), [x])
+        upd = _rand(2, 3)
+        got = paddle.scatter(paddle.to_tensor(x), paddle.to_tensor(np.array([1, 3])), paddle.to_tensor(upd))
+        want = x.copy()
+        want[[1, 3]] = upd
+        np.testing.assert_allclose(got.numpy(), want)
+
+    def test_where_tile_expand(self):
+        x, y = _rand(3, 4), _rand(3, 4)
+        cond = x > 0
+        got = paddle.where(paddle.to_tensor(cond), paddle.to_tensor(x), paddle.to_tensor(y))
+        np.testing.assert_allclose(got.numpy(), np.where(cond, x, y))
+        check_output(lambda t: paddle.tile(t, [2, 1]), lambda v: np.tile(v, (2, 1)), [x])
+        check_output(lambda t: paddle.expand(t, [2, 3, 4]), lambda v: np.broadcast_to(v, (2, 3, 4)), [x])
+
+    def test_pad_order(self):
+        # paddle pads the LAST dim first: [left,right,top,bottom]
+        x = _rand(1, 1, 2, 3)
+        out = paddle.nn.functional.pad(paddle.to_tensor(x), [1, 1, 0, 0])
+        assert out.shape == [1, 1, 2, 5]
+        out = paddle.nn.functional.pad(paddle.to_tensor(x), [0, 0, 2, 1])
+        assert out.shape == [1, 1, 5, 3]
+
+
+class TestSearchSort:
+    def test_argmax_topk_sort(self):
+        x = _rand(4, 6)
+        np.testing.assert_array_equal(paddle.argmax(paddle.to_tensor(x), axis=1).numpy(), x.argmax(1))
+        vals, idx = paddle.topk(paddle.to_tensor(x), 3, axis=1)
+        np.testing.assert_allclose(vals.numpy(), np.sort(x, 1)[:, ::-1][:, :3], rtol=1e-6)
+        s = paddle.sort(paddle.to_tensor(x), axis=1, descending=True)
+        np.testing.assert_allclose(s.numpy(), np.sort(x, 1)[:, ::-1], rtol=1e-6)
+
+    def test_cummax_returns_indices(self):
+        v, i = paddle.cummax(paddle.to_tensor(np.array([1.0, 3.0, 2.0, 5.0])), axis=0)
+        np.testing.assert_allclose(v.numpy(), [1, 3, 3, 5])
+        np.testing.assert_array_equal(i.numpy(), [0, 1, 1, 3])
+
+    def test_nonzero_searchsorted(self):
+        x = np.array([0.0, 1.0, 0.0, 2.0], "float32")
+        nz = paddle.nonzero(paddle.to_tensor(x))
+        np.testing.assert_array_equal(nz.numpy().ravel(), [1, 3])
+        s = np.array([1.0, 3.0, 5.0], "float32")
+        got = paddle.searchsorted(paddle.to_tensor(s), paddle.to_tensor(np.array([2.0, 5.0], "float32")))
+        np.testing.assert_array_equal(got.numpy(), [1, 2])
+
+
+class TestLinalg:
+    def test_norm_det_inv(self):
+        x = _rand(4, 4) + np.eye(4, dtype="float32") * 3
+        check_output(paddle.linalg.det, np.linalg.det, [x], atol=1e-4)
+        check_output(paddle.linalg.inv, np.linalg.inv, [x], atol=1e-4)
+        check_output(lambda t: paddle.linalg.norm(t), lambda v: np.linalg.norm(v), [x], atol=1e-5)
+
+    def test_cholesky_solve_svd(self):
+        a = _rand(4, 4)
+        spd = a @ a.T + 4 * np.eye(4, dtype="float32")
+        l = paddle.linalg.cholesky(paddle.to_tensor(spd))
+        np.testing.assert_allclose(l.numpy() @ l.numpy().T, spd, atol=1e-4)
+        b = _rand(4, 2)
+        sol = paddle.linalg.solve(paddle.to_tensor(spd), paddle.to_tensor(b))
+        np.testing.assert_allclose(sol.numpy(), np.linalg.solve(spd, b), atol=1e-4)
+        u, s, vt = paddle.linalg.svd(paddle.to_tensor(a))
+        np.testing.assert_allclose((u.numpy() * s.numpy()) @ vt.numpy(), a, atol=1e-4)
+
+
+class TestLogicStat:
+    def test_comparisons(self):
+        a, b = _rand(3, 4), _rand(3, 4)
+        np.testing.assert_array_equal((paddle.to_tensor(a) > paddle.to_tensor(b)).numpy(), a > b)
+        assert bool(paddle.allclose(paddle.to_tensor(a), paddle.to_tensor(a.copy())))
+
+    def test_stats(self):
+        x = _rand(4, 5)
+        check_output(lambda t: paddle.std(t, axis=1), lambda v: v.std(1, ddof=1), [x])
+        check_output(lambda t: paddle.var(t, unbiased=False), lambda v: v.var(), [x])
+        check_output(lambda t: paddle.median(t, axis=0), lambda v: np.median(v, 0), [x])
+
+
+class TestDunders:
+    def test_arith_dunders(self):
+        a = paddle.to_tensor([1.0, 2.0])
+        np.testing.assert_allclose((2 * a + 1 - a / 2).numpy(), [2.5, 4.0])
+        np.testing.assert_allclose((a**2).numpy(), [1.0, 4.0])
+        np.testing.assert_allclose((-a).numpy(), [-1.0, -2.0])
+
+    def test_indexing(self):
+        x = paddle.to_tensor(np.arange(12, dtype="float32").reshape(3, 4))
+        np.testing.assert_allclose(x[1].numpy(), [4, 5, 6, 7])
+        np.testing.assert_allclose(x[:, 1:3].numpy(), np.arange(12).reshape(3, 4)[:, 1:3])
+        np.testing.assert_allclose(x[x > 6].numpy(), [7, 8, 9, 10, 11])
+
+    def test_setitem(self):
+        x = paddle.zeros([3, 3])
+        x[1, :] = 5.0
+        np.testing.assert_allclose(x.numpy()[1], [5, 5, 5])
