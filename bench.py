@@ -1,0 +1,80 @@
+"""Flagship benchmark: GPT pretraining tokens/sec/chip on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+The reference publishes no in-tree numbers (BASELINE.md), so vs_baseline is
+measured against this repo's own recorded prior (bench_baseline.json, written
+on first run) — a regression gate in the spirit of tools/ci_op_benchmark.sh.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.models.gpt import GPTConfig, GPTForPretraining, GPTPretrainingCriterion
+
+    d0 = jax.devices()[0]
+    # the axon tunnel reports platform 'axon' with device_kind 'TPU v5 lite'
+    on_tpu = d0.platform in ("tpu", "axon") or "TPU" in getattr(d0, "device_kind", "")
+    # sized to fit+stress one chip; tiny fallback for CPU smoke runs
+    if on_tpu:
+        cfg = GPTConfig(vocab_size=50304, hidden_size=1024, num_layers=16, num_heads=16, max_seq_len=1024)
+        batch, seq, iters = 8, 1024, 20
+    else:
+        cfg = GPTConfig.tiny()
+        batch, seq, iters = 8, 64, 5
+
+    paddle.seed(0)
+    model = GPTForPretraining(cfg)
+    crit = GPTPretrainingCriterion()
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4, parameters=model.parameters())
+    step = TrainStep(model, opt, crit)
+
+    ids = np.random.default_rng(0).integers(0, cfg.vocab_size, (batch, seq)).astype("int32")
+    t = paddle.to_tensor(ids)
+
+    # warmup (compile) + 3 steps; float() is a host transfer = hard sync
+    # (block_until_ready on a dict does not wait under the axon tunnel)
+    for _ in range(3):
+        out = step(t, t)
+    float(out["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = step(t, t)
+    float(out["loss"])  # last loss depends on the whole state chain
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * iters / dt
+    config_key = f"{d0.device_kind or d0.platform}/h{cfg.hidden_size}L{cfg.num_layers}b{batch}s{seq}"
+    base_path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "bench_baseline.json")
+    vs = 1.0
+    if os.path.exists(base_path):
+        try:
+            prior = json.load(open(base_path))
+            # only compare like-for-like (same device kind + model config)
+            if prior.get("config") == config_key and prior.get("value"):
+                vs = tokens_per_sec / prior["value"]
+        except Exception:
+            pass
+    else:
+        json.dump({"metric": "gpt_pretrain_throughput", "value": tokens_per_sec, "unit": "tokens/sec/chip", "config": config_key}, open(base_path, "w"))
+
+    print(json.dumps({
+        "metric": "gpt_pretrain_throughput",
+        "value": round(tokens_per_sec, 2),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(vs, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -23,8 +23,23 @@ from ..nn.layer.base import Layer
 from ..tensor._helpers import ensure_tensor, op
 
 
-def _constraint(x_val, spec):
-    """Apply a sharding constraint if a fleet mesh is active."""
+def _act_spec(mesh, ndim, last):
+    """Activation PartitionSpec: batch over the data axes (dp×sdp), seq over
+    'sep' when sequence parallelism is on, feature dim per ``last``. Keeping
+    batch sharded here is what lets GSPMD compose TP with DP without
+    rematerializing activations."""
+    dims = [None] * ndim
+    if ndim >= 2:
+        dims[0] = ("dp", "sdp")
+    if ndim >= 3 and mesh.shape.get("sep", 1) > 1:
+        dims[1] = "sep"
+    dims[-1] = last
+    return P(*dims)
+
+
+def _constraint(x_val, last):
+    """Constrain an activation's sharding if a fleet mesh is active.
+    ``last`` is the spec entry for the trailing (feature) dim."""
     from .fleet import fleet
 
     if fleet._hcg is None:
@@ -32,6 +47,7 @@ def _constraint(x_val, spec):
     mesh = fleet._hcg.mesh
     if mesh.shape.get("mp", 1) == 1:
         return x_val
+    spec = _act_spec(mesh, x_val.ndim, last)
     try:
         return jax.lax.with_sharding_constraint(x_val, NamedSharding(mesh, spec))
     except ValueError:
@@ -56,8 +72,7 @@ class ColumnParallelLinear(Layer):
 
     def forward(self, x):
         out = F.linear(x, self.weight, self.bias)
-        spec = P(*([None] * (out.ndim - 1)), None if self.gather_output else "mp")
-        out._value = _constraint(out._value, spec)
+        out._value = _constraint(out._value, None if self.gather_output else "mp")
         return out
 
 
@@ -78,9 +93,9 @@ class RowParallelLinear(Layer):
     def forward(self, x):
         if self.input_is_parallel:
             x = ensure_tensor(x)
-            x._value = _constraint(x._value, P(*([None] * (x.ndim - 1)), "mp"))
+            x._value = _constraint(x._value, "mp")
         out = F.linear(x, self.weight, self.bias)
-        out._value = _constraint(out._value, P(*([None] * out.ndim)))
+        out._value = _constraint(out._value, None)
         return out
 
 
@@ -108,7 +123,7 @@ class ParallelCrossEntropy(Layer):
 
     def forward(self, input, label):
         input = ensure_tensor(input)
-        input._value = _constraint(input._value, P(*([None] * (input.ndim - 1)), "mp"))
+        input._value = _constraint(input._value, "mp")
         return F.cross_entropy(input, label, reduction="none", ignore_index=self.ignore_index)
 
 

@@ -1,1 +1,7 @@
 from .lenet import LeNet  # noqa: F401
+from .gpt import (  # noqa: F401
+    GPTConfig,
+    GPTModel,
+    GPTForPretraining,
+    GPTPretrainingCriterion,
+)

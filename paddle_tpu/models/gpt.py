@@ -1,0 +1,178 @@
+"""GPT decoder-only language model — the flagship pretraining model.
+
+Parity: the reference ships GPT as its auto-parallel/fleet workhorse
+(python/paddle/fluid/tests/unittests/auto_parallel_gpt_model.py;
+ppfleetx-style GPT built from paddle.nn.TransformerDecoder + the TP layers in
+fleet/meta_parallel/parallel_layers/mp_layers.py:30,95,171,251).
+
+TPU-first: every parallelism is a sharding annotation, not a wrapper —
+  * vocab over 'mp' (VocabParallelEmbedding),
+  * attention heads + ffn hidden over 'mp' (Column/RowParallelLinear),
+  * batch over 'dp'×'sdp' (fleet.distributed_step input sharding),
+  * sequence over 'sep' for long context (ring/Ulysses attention in
+    distributed/ring_attention.py can replace the core here),
+  * layers stackable over 'pp' via distributed/pipeline.spmd_pipeline.
+The attention core dispatches to the Pallas flash kernel on TPU
+(ops/flash_attention.py), replacing fused_attention_op.cu /
+fused_multi_transformer_op.cu.
+"""
+from __future__ import annotations
+
+from .. import nn
+from ..distributed.mp_layers import (
+    ColumnParallelLinear,
+    ParallelCrossEntropy,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..tensor import manipulation as M
+
+
+class GPTConfig:
+    """Hyperparameters. ``gpt3_1p3b()`` is the BASELINE.json config #4 model."""
+
+    def __init__(
+        self,
+        vocab_size=50304,
+        hidden_size=768,
+        num_layers=12,
+        num_heads=12,
+        ffn_hidden_size=None,
+        max_seq_len=1024,
+        dropout=0.0,
+        attn_dropout=0.0,
+        initializer_range=0.02,
+        use_flash=True,
+    ):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.ffn_hidden_size = ffn_hidden_size or 4 * hidden_size
+        self.max_seq_len = max_seq_len
+        self.dropout = dropout
+        self.attn_dropout = attn_dropout
+        self.initializer_range = initializer_range
+        self.use_flash = use_flash
+
+    @staticmethod
+    def gpt3_1p3b(**kw):
+        cfg = dict(vocab_size=50304, hidden_size=2048, num_layers=24, num_heads=16, max_seq_len=2048)
+        cfg.update(kw)
+        return GPTConfig(**cfg)
+
+    @staticmethod
+    def tiny(**kw):
+        cfg = dict(vocab_size=512, hidden_size=64, num_layers=2, num_heads=4, max_seq_len=128)
+        cfg.update(kw)
+        return GPTConfig(**cfg)
+
+
+class GPTAttention(nn.Layer):
+    """Causal self-attention, heads sharded over 'mp' via column/row linears."""
+
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.num_heads = cfg.num_heads
+        self.head_dim = cfg.hidden_size // cfg.num_heads
+        assert self.head_dim * cfg.num_heads == cfg.hidden_size
+        init = I.Normal(0.0, cfg.initializer_range)
+        self.qkv_proj = ColumnParallelLinear(cfg.hidden_size, 3 * cfg.hidden_size, weight_attr=init, gather_output=False)
+        self.out_proj = RowParallelLinear(cfg.hidden_size, cfg.hidden_size, weight_attr=init, input_is_parallel=True)
+        self.attn_dropout = cfg.attn_dropout
+
+    def forward(self, x):
+        b, s = x.shape[0], x.shape[1]
+        qkv = self.qkv_proj(x)
+        qkv = M.reshape(qkv, [b, s, 3, self.num_heads, self.head_dim])
+        q, k, v = (M.squeeze(t, 2) for t in M.split(qkv, 3, axis=2))
+        out = F.scaled_dot_product_attention(q, k, v, is_causal=True, dropout_p=self.attn_dropout, training=self.training)
+        out = M.reshape(out, [b, s, self.num_heads * self.head_dim])
+        return self.out_proj(out)
+
+
+class GPTBlock(nn.Layer):
+    """Pre-LN decoder block (attn + gelu MLP), mp-sharded."""
+
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        init = I.Normal(0.0, cfg.initializer_range)
+        self.norm1 = nn.LayerNorm(cfg.hidden_size)
+        self.attn = GPTAttention(cfg)
+        self.norm2 = nn.LayerNorm(cfg.hidden_size)
+        self.ffn1 = ColumnParallelLinear(cfg.hidden_size, cfg.ffn_hidden_size, weight_attr=init, gather_output=False)
+        self.ffn2 = RowParallelLinear(cfg.ffn_hidden_size, cfg.hidden_size, weight_attr=init, input_is_parallel=True)
+        self.dropout = nn.Dropout(cfg.dropout)
+
+    def forward(self, x):
+        x = x + self.dropout(self.attn(self.norm1(x)))
+        x = x + self.dropout(self.ffn2(F.gelu(self.ffn1(self.norm2(x)), approximate=True)))
+        return x
+
+
+class GPTEmbeddings(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.word_embeddings = VocabParallelEmbedding(cfg.vocab_size, cfg.hidden_size)
+        self.position_embeddings = nn.Embedding(cfg.max_seq_len, cfg.hidden_size, weight_attr=I.Normal(0.0, cfg.initializer_range))
+        self.dropout = nn.Dropout(cfg.dropout)
+
+    def forward(self, input_ids, position_ids=None):
+        if position_ids is None:
+            from ..tensor.creation import arange
+
+            position_ids = arange(0, input_ids.shape[1], dtype="int32")
+        return self.dropout(self.word_embeddings(input_ids) + self.position_embeddings(position_ids))
+
+
+class GPTModel(nn.Layer):
+    """Embedding + N decoder blocks + final LN → hidden states."""
+
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.embeddings = GPTEmbeddings(cfg)
+        self.layers = nn.LayerList([GPTBlock(cfg) for _ in range(cfg.num_layers)])
+        self.final_norm = nn.LayerNorm(cfg.hidden_size)
+
+    def forward(self, input_ids, position_ids=None):
+        h = self.embeddings(input_ids, position_ids)
+        for blk in self.layers:
+            h = blk(h)
+        return self.final_norm(h)
+
+
+class GPTForPretraining(nn.Layer):
+    """LM head tied to the (vocab-sharded) word embedding — logits over 'mp'."""
+
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.gpt = GPTModel(cfg)
+
+    def forward(self, input_ids, position_ids=None):
+        h = self.gpt(input_ids, position_ids)
+        from ..tensor.linalg import matmul
+
+        # tied head: h @ wte^T; vocab axis stays mp-sharded for the
+        # vocab-parallel loss (c_softmax_with_cross_entropy parity)
+        return matmul(h, self.gpt.embeddings.word_embeddings.weight, transpose_y=True)
+
+
+class GPTPretrainingCriterion(nn.Layer):
+    """Next-token cross entropy with optional loss mask, mean over tokens."""
+
+    def __init__(self):
+        super().__init__()
+        self.parallel_ce = ParallelCrossEntropy()
+
+    def forward(self, logits, labels, loss_mask=None):
+        from ..tensor.math import mean, multiply, sum as t_sum
+        from ..tensor.manipulation import reshape
+
+        per_tok = self.parallel_ce(logits, labels)
+        if loss_mask is not None:
+            m = reshape(loss_mask, per_tok.shape)
+            return t_sum(multiply(per_tok, m)) / t_sum(m)
+        return mean(per_tok)
