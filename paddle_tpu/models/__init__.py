@@ -1,4 +1,10 @@
 from .lenet import LeNet  # noqa: F401
+from .bert import (  # noqa: F401
+    BertConfig,
+    BertModel,
+    BertForPretraining,
+    BertPretrainingCriterion,
+)
 from .gpt import (  # noqa: F401
     GPTConfig,
     GPTModel,

@@ -1,0 +1,176 @@
+"""BERT encoder family — BASELINE.json config #3 (BERT-base MLM, DP over ICI).
+
+Parity: the reference exercises BERT through its transformer API
+(python/paddle/nn/layer/transformer.py) and fleet DP; ERNIE-style models are
+the same encoder with different pretraining data. TP sharding via the same
+mp-annotated layers as GPT (distributed/mp_layers.py).
+"""
+from __future__ import annotations
+
+from .. import nn
+from ..distributed.mp_layers import (
+    ColumnParallelLinear,
+    ParallelCrossEntropy,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..tensor import manipulation as M
+
+
+class BertConfig:
+    def __init__(
+        self,
+        vocab_size=30522,
+        hidden_size=768,
+        num_layers=12,
+        num_heads=12,
+        ffn_hidden_size=None,
+        max_seq_len=512,
+        type_vocab_size=2,
+        dropout=0.0,
+        initializer_range=0.02,
+    ):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.ffn_hidden_size = ffn_hidden_size or 4 * hidden_size
+        self.max_seq_len = max_seq_len
+        self.type_vocab_size = type_vocab_size
+        self.dropout = dropout
+        self.initializer_range = initializer_range
+
+    @staticmethod
+    def base(**kw):
+        return BertConfig(**kw)
+
+    @staticmethod
+    def large(**kw):
+        cfg = dict(hidden_size=1024, num_layers=24, num_heads=16)
+        cfg.update(kw)
+        return BertConfig(**cfg)
+
+    @staticmethod
+    def tiny(**kw):
+        cfg = dict(vocab_size=512, hidden_size=64, num_layers=2, num_heads=4, max_seq_len=128)
+        cfg.update(kw)
+        return BertConfig(**cfg)
+
+
+class BertSelfAttention(nn.Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.num_heads = cfg.num_heads
+        self.head_dim = cfg.hidden_size // cfg.num_heads
+        init = I.Normal(0.0, cfg.initializer_range)
+        self.qkv_proj = ColumnParallelLinear(cfg.hidden_size, 3 * cfg.hidden_size, weight_attr=init, gather_output=False)
+        self.out_proj = RowParallelLinear(cfg.hidden_size, cfg.hidden_size, weight_attr=init, input_is_parallel=True)
+
+    def forward(self, x, attn_mask=None):
+        b, s = x.shape[0], x.shape[1]
+        qkv = M.reshape(self.qkv_proj(x), [b, s, 3, self.num_heads, self.head_dim])
+        q, k, v = (M.squeeze(t, 2) for t in M.split(qkv, 3, axis=2))
+        out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask)
+        return self.out_proj(M.reshape(out, [b, s, self.num_heads * self.head_dim]))
+
+
+class BertLayer(nn.Layer):
+    """Post-LN encoder block (original BERT ordering)."""
+
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        init = I.Normal(0.0, cfg.initializer_range)
+        self.attn = BertSelfAttention(cfg)
+        self.norm1 = nn.LayerNorm(cfg.hidden_size)
+        self.ffn1 = ColumnParallelLinear(cfg.hidden_size, cfg.ffn_hidden_size, weight_attr=init, gather_output=False)
+        self.ffn2 = RowParallelLinear(cfg.ffn_hidden_size, cfg.hidden_size, weight_attr=init, input_is_parallel=True)
+        self.norm2 = nn.LayerNorm(cfg.hidden_size)
+        self.dropout = nn.Dropout(cfg.dropout)
+
+    def forward(self, x, attn_mask=None):
+        x = self.norm1(x + self.dropout(self.attn(x, attn_mask)))
+        x = self.norm2(x + self.dropout(self.ffn2(F.gelu(self.ffn1(x), approximate=True))))
+        return x
+
+
+class BertEmbeddings(nn.Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        init = I.Normal(0.0, cfg.initializer_range)
+        self.word_embeddings = VocabParallelEmbedding(cfg.vocab_size, cfg.hidden_size)
+        self.position_embeddings = nn.Embedding(cfg.max_seq_len, cfg.hidden_size, weight_attr=init)
+        self.token_type_embeddings = nn.Embedding(cfg.type_vocab_size, cfg.hidden_size, weight_attr=init)
+        self.norm = nn.LayerNorm(cfg.hidden_size)
+        self.dropout = nn.Dropout(cfg.dropout)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        from ..tensor.creation import arange, zeros_like
+
+        if position_ids is None:
+            position_ids = arange(0, input_ids.shape[1], dtype="int32")
+        if token_type_ids is None:
+            token_type_ids = zeros_like(input_ids)
+        h = self.word_embeddings(input_ids) + self.position_embeddings(position_ids) + self.token_type_embeddings(token_type_ids)
+        return self.dropout(self.norm(h))
+
+
+class BertModel(nn.Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.embeddings = BertEmbeddings(cfg)
+        self.layers = nn.LayerList([BertLayer(cfg) for _ in range(cfg.num_layers)])
+        self.pooler = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None, attn_mask=None):
+        h = self.embeddings(input_ids, token_type_ids, position_ids)
+        for layer in self.layers:
+            h = layer(h, attn_mask)
+        from ..tensor.math import tanh
+
+        pooled = tanh(self.pooler(h[:, 0]))
+        return h, pooled
+
+
+class BertForPretraining(nn.Layer):
+    """MLM head (tied decoder) + NSP head."""
+
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.bert = BertModel(cfg)
+        self.transform = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+        self.transform_norm = nn.LayerNorm(cfg.hidden_size)
+        self.nsp = nn.Linear(cfg.hidden_size, 2)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None, attn_mask=None):
+        h, pooled = self.bert(input_ids, token_type_ids, position_ids, attn_mask)
+        from ..tensor.linalg import matmul
+
+        h = self.transform_norm(F.gelu(self.transform(h), approximate=True))
+        mlm_logits = matmul(h, self.bert.embeddings.word_embeddings.weight, transpose_y=True)
+        nsp_logits = self.nsp(pooled)
+        return mlm_logits, nsp_logits
+
+
+class BertPretrainingCriterion(nn.Layer):
+    """Masked-LM CE (ignore_index=-100 for unmasked) + NSP CE."""
+
+    def __init__(self):
+        super().__init__()
+        self.mlm_ce = ParallelCrossEntropy(ignore_index=-100)
+
+    def forward(self, mlm_logits, nsp_logits, mlm_labels, nsp_labels=None):
+        from ..tensor.math import mean, sum as t_sum
+        from ..tensor.logic import not_equal
+        from ..tensor.manipulation import reshape
+
+        per_tok = self.mlm_ce(mlm_logits, mlm_labels)
+        mask = not_equal(mlm_labels, -100).astype("float32")
+        mask = reshape(mask, per_tok.shape)
+        denom = t_sum(mask) + 1e-6
+        loss = t_sum(per_tok * mask) / denom
+        if nsp_labels is not None:
+            loss = loss + mean(F.cross_entropy(nsp_logits, nsp_labels, reduction="none"))
+        return loss

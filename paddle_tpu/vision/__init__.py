@@ -1,11 +1,5 @@
-"""paddle_tpu.vision (parity: python/paddle/vision) — models live in
-paddle_tpu.models; datasets here are synthetic/local-file based (no network
-in the build environment)."""
+"""paddle_tpu.vision (parity: python/paddle/vision) — datasets are
+synthetic/local-file based (no network in the build environment)."""
 from . import datasets  # noqa: F401
+from . import models  # noqa: F401
 from . import transforms  # noqa: F401
-
-
-def models():
-    from .. import models as m
-
-    return m

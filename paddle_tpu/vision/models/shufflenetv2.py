@@ -1,0 +1,107 @@
+"""ShuffleNetV2 (parity: python/paddle/vision/models/shufflenetv2.py)."""
+from __future__ import annotations
+
+from ... import nn
+from ...tensor.manipulation import concat, flatten, reshape, transpose
+
+_CFGS = {
+    "0.5": ([4, 8, 4], [24, 48, 96, 192, 1024]),
+    "1.0": ([4, 8, 4], [24, 116, 232, 464, 1024]),
+    "1.5": ([4, 8, 4], [24, 176, 352, 704, 1024]),
+    "2.0": ([4, 8, 4], [24, 244, 488, 976, 2048]),
+}
+
+
+def channel_shuffle(x, groups):
+    b, c, h, w = x.shape
+    x = reshape(x, [b, groups, c // groups, h, w])
+    x = transpose(x, [0, 2, 1, 3, 4])
+    return reshape(x, [b, c, h, w])
+
+
+def _dw_bn(in_c, out_c, kernel, stride):
+    return nn.Sequential(
+        nn.Conv2D(in_c, in_c, kernel, stride=stride, padding=kernel // 2, groups=in_c, bias_attr=False),
+        nn.BatchNorm2D(in_c),
+        nn.Conv2D(in_c, out_c, 1, bias_attr=False),
+        nn.BatchNorm2D(out_c),
+        nn.ReLU(),
+    )
+
+
+def _pw_bn_relu(in_c, out_c):
+    return nn.Sequential(nn.Conv2D(in_c, out_c, 1, bias_attr=False), nn.BatchNorm2D(out_c), nn.ReLU())
+
+
+class ShuffleUnit(nn.Layer):
+    def __init__(self, in_c, out_c, stride):
+        super().__init__()
+        self.stride = stride
+        branch_c = out_c // 2
+        if stride == 1:
+            self.branch2 = nn.Sequential(_pw_bn_relu(in_c // 2, branch_c), _dw_bn(branch_c, branch_c, 3, 1))
+        else:
+            self.branch1 = _dw_bn(in_c, in_c, 3, stride)
+            self.branch2 = nn.Sequential(_pw_bn_relu(in_c, branch_c), _dw_bn(branch_c, branch_c, 3, stride))
+
+    def forward(self, x):
+        if self.stride == 1:
+            c = x.shape[1] // 2
+            x1, x2 = x[:, :c], x[:, c:]
+            out = concat([x1, self.branch2(x2)], axis=1)
+        else:
+            out = concat([self.branch1(x), self.branch2(x)], axis=1)
+        return channel_shuffle(out, 2)
+
+
+class ShuffleNetV2(nn.Layer):
+    def __init__(self, scale="1.0", num_classes=1000, with_pool=True):
+        super().__init__()
+        stages, chans = _CFGS[str(scale)]
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.conv1 = nn.Sequential(nn.Conv2D(3, chans[0], 3, stride=2, padding=1, bias_attr=False), nn.BatchNorm2D(chans[0]), nn.ReLU())
+        self.maxpool = nn.MaxPool2D(3, stride=2, padding=1)
+        blocks = []
+        in_c = chans[0]
+        for i, reps in enumerate(stages):
+            out_c = chans[i + 1]
+            blocks.append(ShuffleUnit(in_c, out_c, 2))
+            for _ in range(reps - 1):
+                blocks.append(ShuffleUnit(out_c, out_c, 1))
+            in_c = out_c
+        self.features = nn.Sequential(*blocks)
+        self.conv_last = _pw_bn_relu(in_c, chans[-1])
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(chans[-1], num_classes)
+
+    def forward(self, x):
+        x = self.maxpool(self.conv1(x))
+        x = self.conv_last(self.features(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(flatten(x, 1))
+        return x
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kwargs):
+    kwargs.pop("pretrained", None)
+    return ShuffleNetV2("0.5", **kwargs)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kwargs):
+    kwargs.pop("pretrained", None)
+    return ShuffleNetV2("1.0", **kwargs)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kwargs):
+    kwargs.pop("pretrained", None)
+    return ShuffleNetV2("1.5", **kwargs)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kwargs):
+    kwargs.pop("pretrained", None)
+    return ShuffleNetV2("2.0", **kwargs)
