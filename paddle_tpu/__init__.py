@@ -39,6 +39,8 @@ from . import amp  # noqa: F401,E402
 from . import io  # noqa: F401,E402
 from . import jit  # noqa: F401,E402
 from . import distributed  # noqa: F401,E402
+from . import autograd  # noqa: F401,E402
+from . import distribution  # noqa: F401,E402
 from . import metric  # noqa: F401,E402
 from . import profiler  # noqa: F401,E402
 from . import vision  # noqa: F401,E402

@@ -1,0 +1,16 @@
+"""User-facing autograd: PyLayer custom functions + functional transforms.
+
+Paddle parity: ``paddle.autograd.PyLayer`` (reference:
+python/paddle/autograd/py_layer.py) and the functional jacobian/hessian API
+(python/paddle/autograd/functional.py). TPU-first design: PyLayer's custom
+backward is just another vjp closure on the eager tape; the functional API
+delegates to jax.jacrev/jacfwd/jvp/vjp instead of building double-backward
+graphs by hand.
+"""
+from __future__ import annotations
+
+from ..framework import backward  # noqa: F401 — paddle.autograd.backward
+from .py_layer import PyLayer, PyLayerContext  # noqa: F401
+from .functional import hessian, jacobian, jvp, vjp  # noqa: F401
+
+__all__ = ["backward", "PyLayer", "PyLayerContext", "hessian", "jacobian", "jvp", "vjp"]

@@ -1,0 +1,156 @@
+"""PyLayer: user-defined forward/backward pairs on the eager tape.
+
+Paddle parity: ``paddle.autograd.PyLayer`` (reference:
+python/paddle/autograd/py_layer.py — CPyLayer.apply / PyLayerContext
+save_for_backward). TPU-first design: ``apply`` runs the user's forward with
+the tape paused, then records ONE TapeNode whose vjp closure invokes the
+user's ``backward``. The custom backward composes with ``jax.grad`` too: ops
+built from :func:`paddle_tpu.framework.core.primitive` inside ``backward``
+run eagerly, which is exactly the reference's semantics (backward of a
+PyLayer is not itself differentiable unless written so).
+"""
+from __future__ import annotations
+
+from typing import Any, List
+
+from ..framework import no_grad
+from ..framework.autograd import TapeNode, is_grad_enabled
+from ..framework.core import Tensor, _is_float_array, _wrap_value
+
+
+class PyLayerContext:
+    """Context handed to forward/backward; carries saved tensors + user attrs.
+
+    Parity: PyLayerContext (py_layer.py): ``save_for_backward`` /
+    ``saved_tensor``; arbitrary attributes may be stashed on the ctx.
+    """
+
+    def __init__(self):
+        self._saved: List[Tensor] = []
+        self._materialize_grads = True
+
+    def save_for_backward(self, *tensors):
+        self._saved = list(tensors)
+
+    def saved_tensor(self):
+        return list(self._saved)
+
+    def mark_not_inplace(self, *args):  # reference API; no-op (we never alias)
+        pass
+
+    def mark_non_differentiable(self, *args):
+        self._non_diff = [id(a) for a in args]
+
+    def set_materialize_grads(self, value: bool):
+        self._materialize_grads = bool(value)
+
+
+class PyLayerMeta(type):
+    pass
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    """Base for custom autograd functions.
+
+    Usage parity with the reference::
+
+        class Cube(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * x * x
+            @staticmethod
+            def backward(ctx, dy):
+                (x,) = ctx.saved_tensor()
+                return 3 * x * x * dy
+
+        y = Cube.apply(x)
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError("PyLayer subclasses must implement forward")
+
+    @staticmethod
+    def backward(ctx, *args):
+        raise NotImplementedError("PyLayer subclasses must implement backward")
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        # inputs that participate in grad flow: positional first, then kwargs
+        # in insertion order (reference packs kwarg tensors into the graph too)
+        all_inputs = list(args) + list(kwargs.values())
+        diff_inputs = [
+            a
+            for a in all_inputs
+            if isinstance(a, Tensor) and not a.stop_gradient and _is_float_array(a._value)
+        ] if is_grad_enabled() else []
+        tensor_inputs = [a for a in all_inputs if isinstance(a, Tensor)]
+
+        with no_grad():
+            out = cls.forward(ctx, *args, **kwargs)
+
+        multi = isinstance(out, (tuple, list))
+        outs = tuple(out) if multi else (out,)
+        for o in outs:
+            if not isinstance(o, Tensor):
+                raise TypeError(f"PyLayer.forward must return Tensor(s), got {type(o)}")
+
+        if not diff_inputs:
+            return out
+
+        non_diff = set(getattr(ctx, "_non_diff", ()))
+        out_shapes = [(tuple(o._value.shape), o._value.dtype) for o in outs]
+
+        def vjp_fn(cots):
+            import jax.numpy as jnp
+
+            cot_list = list(cots) if isinstance(cots, (tuple, list)) else [cots]
+            if not ctx._materialize_grads:
+                grad_out = [
+                    None if c is None else _wrap_value(c if hasattr(c, "dtype") and _is_float_array(c) else jnp.asarray(c))
+                    for c in cot_list
+                ]
+            else:
+                grad_out = [
+                    _wrap_value(
+                        jnp.zeros(s, d) if c is None else (c if hasattr(c, "dtype") and _is_float_array(c) else jnp.asarray(c))
+                    )
+                    for c, (s, d) in zip(cot_list, out_shapes)
+                ]
+            with no_grad():
+                gin = cls.backward(ctx, *grad_out)
+            gin = list(gin) if isinstance(gin, (tuple, list)) else [gin]
+            # reference semantics: backward returns one grad per *tensor* input
+            if len(gin) != len(tensor_inputs):
+                if len(gin) == len(diff_inputs):
+                    by_input = dict(zip((id(t) for t in diff_inputs), gin))
+                    gin = [by_input.get(id(t)) for t in tensor_inputs]
+                else:
+                    raise ValueError(
+                        f"PyLayer.backward returned {len(gin)} grads for "
+                        f"{len(tensor_inputs)} tensor inputs"
+                    )
+            by_id = dict(zip((id(t) for t in tensor_inputs), gin))
+            result = []
+            for t in diff_inputs:
+                g = by_id.get(id(t))
+                if g is None:
+                    result.append(jnp.zeros(t._value.shape, t._value.dtype))
+                else:
+                    result.append(g._value if isinstance(g, Tensor) else jnp.asarray(g))
+            return tuple(result)
+
+        vjp_fn._no_materialize_cots = True  # engine passes None for unused outputs
+        node = TapeNode(vjp_fn, diff_inputs, len(outs), out_shapes, name=cls.__name__)
+        wrapped = tuple(
+            _wrap_value(
+                o._value,
+                stop_gradient=not _is_float_array(o._value) or id(o) in non_diff,
+                node=node if _is_float_array(o._value) and id(o) not in non_diff else None,
+                out_idx=i,
+            )
+            for i, o in enumerate(outs)
+        )
+        return wrapped if multi else wrapped[0]

@@ -1,0 +1,50 @@
+"""paddle.distribution parity (ref python/paddle/distribution/__init__.py)."""
+from .beta import Beta, Dirichlet  # noqa: F401
+from .categorical import Categorical, Multinomial  # noqa: F401
+from .distribution import Distribution, ExponentialFamily  # noqa: F401
+from .kl import kl_divergence, register_kl  # noqa: F401
+from .normal import Normal, Uniform  # noqa: F401
+from .transform import (  # noqa: F401
+    AbsTransform,
+    AffineTransform,
+    ChainTransform,
+    ExpTransform,
+    IndependentTransform,
+    PowerTransform,
+    ReshapeTransform,
+    SigmoidTransform,
+    SoftmaxTransform,
+    StackTransform,
+    StickBreakingTransform,
+    TanhTransform,
+    Transform,
+)
+from .transformed_distribution import Independent, TransformedDistribution  # noqa: F401
+
+__all__ = [
+    "Beta",
+    "Categorical",
+    "Dirichlet",
+    "Distribution",
+    "ExponentialFamily",
+    "Multinomial",
+    "Normal",
+    "Uniform",
+    "kl_divergence",
+    "register_kl",
+    "Independent",
+    "TransformedDistribution",
+    "Transform",
+    "AbsTransform",
+    "AffineTransform",
+    "ChainTransform",
+    "ExpTransform",
+    "IndependentTransform",
+    "PowerTransform",
+    "ReshapeTransform",
+    "SigmoidTransform",
+    "SoftmaxTransform",
+    "StackTransform",
+    "StickBreakingTransform",
+    "TanhTransform",
+]

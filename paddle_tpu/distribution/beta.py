@@ -1,0 +1,131 @@
+"""Beta, Dirichlet (ref python/paddle/distribution/{beta,dirichlet}.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import random as jrandom
+from jax.scipy.special import betaln, digamma, gammaln
+
+from ..framework.core import _wrap_value
+from ..framework.random import split_key
+from .distribution import ExponentialFamily, _arr
+
+
+class Dirichlet(ExponentialFamily):
+    """Dirichlet(concentration) — ref dirichlet.py:22."""
+
+    def __init__(self, concentration):
+        from .distribution import _param
+
+        self._concentration = _param(concentration)
+        self.concentration = _arr(concentration, jnp.float32)
+        super().__init__(
+            batch_shape=self.concentration.shape[:-1],
+            event_shape=self.concentration.shape[-1:],
+        )
+
+    @property
+    def mean(self):
+        from ..framework.core import primitive
+
+        return primitive(
+            lambda a: a / jnp.sum(a, -1, keepdims=True), self._concentration, _name="dirichlet_mean"
+        )
+
+    @property
+    def variance(self):
+        from ..framework.core import primitive
+
+        def impl(a):
+            a0 = jnp.sum(a, -1, keepdims=True)
+            m = a / a0
+            return m * (1 - m) / (a0 + 1)
+
+        return primitive(impl, self._concentration, _name="dirichlet_variance")
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape + self.event_shape
+        g = jrandom.gamma(split_key(), jnp.broadcast_to(self.concentration, shape))
+        return _wrap_value(g / jnp.sum(g, -1, keepdims=True))
+
+    def log_prob(self, value):
+        from ..framework.core import primitive
+        from .distribution import _param
+
+        def impl(a, v):
+            return (
+                jnp.sum((a - 1) * jnp.log(v), -1)
+                + gammaln(jnp.sum(a, -1))
+                - jnp.sum(gammaln(a), -1)
+            )
+
+        return primitive(impl, self._concentration, _param(value), _name="dirichlet_log_prob")
+
+    def entropy(self):
+        from ..framework.core import primitive
+
+        k = self.concentration.shape[-1]
+
+        def impl(a):
+            a0 = jnp.sum(a, -1)
+            lnB = jnp.sum(gammaln(a), -1) - gammaln(a0)
+            return lnB + (a0 - k) * digamma(a0) - jnp.sum((a - 1) * digamma(a), -1)
+
+        return primitive(impl, self._concentration, _name="dirichlet_entropy")
+
+
+class Beta(ExponentialFamily):
+    """Beta(alpha, beta) — ref beta.py:22; implemented over Dirichlet like the reference."""
+
+    def __init__(self, alpha, beta):
+        from .distribution import _param
+
+        self._alpha = _param(alpha)
+        self._beta = _param(beta)
+        self.alpha = _arr(alpha, jnp.float32)
+        self.beta = _arr(beta, jnp.float32)
+        self.alpha, self.beta = jnp.broadcast_arrays(self.alpha, self.beta)
+        self._dirichlet = Dirichlet(jnp.stack([self.alpha, self.beta], -1))
+        super().__init__(batch_shape=self.alpha.shape)
+
+    @property
+    def mean(self):
+        from ..framework.core import primitive
+
+        return primitive(lambda a, b: a / (a + b), self._alpha, self._beta, _name="beta_mean")
+
+    @property
+    def variance(self):
+        from ..framework.core import primitive
+
+        def impl(a, b):
+            s = a + b
+            return a * b / (s**2 * (s + 1))
+
+        return primitive(impl, self._alpha, self._beta, _name="beta_variance")
+
+    def sample(self, shape=()):
+        from ..framework.core import unwrap
+
+        return _wrap_value(unwrap(self._dirichlet.sample(shape))[..., 0])
+
+    def log_prob(self, value):
+        from ..framework.core import primitive
+        from .distribution import _param
+
+        def impl(a, b, v):
+            return (a - 1) * jnp.log(v) + (b - 1) * jnp.log1p(-v) - betaln(a, b)
+
+        return primitive(impl, self._alpha, self._beta, _param(value), _name="beta_log_prob")
+
+    def entropy(self):
+        from ..framework.core import primitive
+
+        def impl(a, b):
+            return (
+                betaln(a, b)
+                - (a - 1) * digamma(a)
+                - (b - 1) * digamma(b)
+                + (a + b - 2) * digamma(a + b)
+            )
+
+        return primitive(impl, self._alpha, self._beta, _name="beta_entropy")

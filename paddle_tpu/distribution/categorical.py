@@ -1,0 +1,120 @@
+"""Categorical, Multinomial (ref python/paddle/distribution/{categorical,multinomial}.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import random as jrandom
+
+from ..framework.core import _wrap_value, unwrap
+from ..framework.random import split_key
+from .distribution import Distribution, _arr
+
+
+class Categorical(Distribution):
+    """Categorical over unnormalized ``logits`` (the reference takes logits
+    meaning unnormalized probabilities — ref categorical.py:30)."""
+
+    def __init__(self, logits, name=None):
+        from .distribution import _param
+
+        # reference semantics: `logits` are non-negative relative weights;
+        # single source of truth — views below derive from it on demand
+        self._logits = _param(logits)
+        super().__init__(batch_shape=tuple(_arr(self._logits).shape[:-1]))
+
+    @property
+    def logits(self):
+        return _arr(self._logits, jnp.float32)
+
+    @property
+    def _log_p(self):
+        w = self.logits
+        return jnp.log(w / jnp.sum(w, -1, keepdims=True))
+
+    @property
+    def probs_all(self):
+        return jnp.exp(self._log_p)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        idx = jrandom.categorical(split_key(), self._log_p, shape=shape)
+        return _wrap_value(idx.astype(jnp.int64))
+
+    @staticmethod
+    def _gather(table, v):
+        t = jnp.broadcast_to(table, v.shape + table.shape[-1:])
+        return jnp.take_along_axis(t, v[..., None], -1)[..., 0]
+
+    def log_prob(self, value):
+        from ..framework.core import primitive
+
+        v = _arr(value).astype(jnp.int32)
+
+        def impl(w):
+            log_p = jnp.log(w / jnp.sum(w, -1, keepdims=True))
+            return self._gather(log_p, v)
+
+        return primitive(impl, self._logits, _name="categorical_log_prob")
+
+    def probs(self, value):
+        from ..framework.core import primitive
+
+        v = _arr(value).astype(jnp.int32)
+
+        def impl(w):
+            p = w / jnp.sum(w, -1, keepdims=True)
+            return self._gather(p, v)
+
+        return primitive(impl, self._logits, _name="categorical_probs")
+
+    def entropy(self):
+        from ..framework.core import primitive
+
+        def impl(w):
+            log_p = jnp.log(w / jnp.sum(w, -1, keepdims=True))
+            return -jnp.sum(jnp.exp(log_p) * log_p, -1)
+
+        return primitive(impl, self._logits, _name="categorical_entropy")
+
+
+class Multinomial(Distribution):
+    """Multinomial(total_count, probs) — ref multinomial.py:25."""
+
+    def __init__(self, total_count: int, probs):
+        self.total_count = int(total_count)
+        self.probs = _arr(probs, jnp.float32)
+        self.probs = self.probs / jnp.sum(self.probs, -1, keepdims=True)
+        super().__init__(batch_shape=self.probs.shape[:-1], event_shape=self.probs.shape[-1:])
+
+    @property
+    def mean(self):
+        return _wrap_value(self.total_count * self.probs)
+
+    @property
+    def variance(self):
+        return _wrap_value(self.total_count * self.probs * (1 - self.probs))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        logits = jnp.log(self.probs)
+        draws = jrandom.categorical(
+            split_key(), logits, shape=(self.total_count,) + shape
+        )
+        k = self.probs.shape[-1]
+        one_hot = jnp.sum(jnp.eye(k, dtype=self.probs.dtype)[draws], axis=0)
+        return _wrap_value(one_hot)
+
+    def log_prob(self, value):
+        v = _arr(value, self.probs.dtype)
+        from jax.scipy.special import gammaln
+
+        logits = jnp.log(self.probs)
+        return _wrap_value(
+            gammaln(jnp.asarray(self.total_count + 1.0))
+            - jnp.sum(gammaln(v + 1.0), -1)
+            + jnp.sum(v * logits, -1)
+        )
+
+    def entropy(self):
+        # no closed form; Monte-Carlo-free bound not in reference either —
+        # match reference by computing over support only for small counts
+        raise NotImplementedError("Multinomial entropy has no closed form")

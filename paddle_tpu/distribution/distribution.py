@@ -1,0 +1,95 @@
+"""Distribution base classes.
+
+Paddle parity: python/paddle/distribution/distribution.py (Distribution base)
+and exponential_family.py. TPU-first design: distributions are pure-functional
+over jax.numpy; sampling draws explicit PRNG keys from the framework RNG
+(traced-safe under jit via rng_scope), entropy/log_prob are jittable.
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, _wrap_value, unwrap
+
+
+def _arr(x, dtype=None):
+    v = unwrap(x) if isinstance(x, Tensor) else jnp.asarray(x)
+    if dtype is not None and v.dtype != dtype:
+        v = v.astype(dtype)
+    return v
+
+
+def _param(x, dtype=jnp.float32):
+    """Keep Tensors (tape-connected); lift raw values to constant Tensors."""
+    if isinstance(x, Tensor):
+        return x
+    arr = jnp.asarray(x, dtype) if isinstance(x, (int, float)) else jnp.asarray(x)
+    t = Tensor.__new__(Tensor)
+    t._init(arr, stop_gradient=True)
+    return t
+
+
+class Distribution:
+    """Base of all distributions (ref distribution.py:40)."""
+
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return _wrap_value(jnp.exp(unwrap(self.log_prob(value))))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        from .kl import kl_divergence
+
+        return kl_divergence(self, other)
+
+    def _extend_shape(self, sample_shape: Sequence[int]):
+        return tuple(sample_shape) + self._batch_shape + self._event_shape
+
+
+class ExponentialFamily(Distribution):
+    """Exponential-family base enabling Bregman-divergence KL
+    (ref exponential_family.py; KL via jax.grad replaces the reference's
+    double-backward over natural parameters)."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        raise NotImplementedError

@@ -1,0 +1,133 @@
+"""Normal, Uniform distributions (ref python/paddle/distribution/{normal,uniform}.py).
+
+All math routes through :func:`paddle_tpu.framework.core.primitive` so that
+log_prob / rsample / entropy are differentiable w.r.t. Tensor parameters on
+the eager tape (the reference's distributions differentiate through dygraph
+ops the same way).
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+from jax import random as jrandom
+
+from ..framework.core import Tensor, _wrap_value, primitive, unwrap
+from ..framework.random import split_key
+from .distribution import Distribution, ExponentialFamily, _param
+
+
+class Normal(ExponentialFamily):
+    """N(loc, scale) — ref normal.py:32."""
+
+    def __init__(self, loc, scale, name=None):
+        self._loc = _param(loc)
+        self._scale = _param(scale)
+        shape = jnp.broadcast_shapes(unwrap(self._loc).shape, unwrap(self._scale).shape)
+        super().__init__(batch_shape=shape)
+
+    # raw-array views used by closed-form KL formulas
+    @property
+    def loc(self):
+        return jnp.broadcast_to(unwrap(self._loc), self.batch_shape)
+
+    @property
+    def scale(self):
+        return jnp.broadcast_to(unwrap(self._scale), self.batch_shape)
+
+    @property
+    def mean(self):
+        return primitive(lambda l, s: jnp.broadcast_to(l, jnp.broadcast_shapes(l.shape, s.shape)), self._loc, self._scale, _name="normal_mean")
+
+    @property
+    def variance(self):
+        return primitive(lambda l, s: jnp.broadcast_to(s**2, jnp.broadcast_shapes(l.shape, s.shape)), self._loc, self._scale, _name="normal_variance")
+
+    @property
+    def stddev(self):
+        return primitive(lambda l, s: jnp.broadcast_to(s, jnp.broadcast_shapes(l.shape, s.shape)), self._loc, self._scale, _name="normal_stddev")
+
+    def sample(self, shape=(), seed=0):
+        with_noise = self.rsample(shape)
+        return with_noise.detach()
+
+    def rsample(self, shape=()):
+        out_shape = tuple(shape) + self.batch_shape
+        eps = jrandom.normal(split_key(), out_shape, jnp.result_type(unwrap(self._loc).dtype, jnp.float32))
+        return primitive(lambda l, s: l + s * eps, self._loc, self._scale, _name="normal_rsample")
+
+    def log_prob(self, value):
+        value = _param(value)
+
+        def impl(l, s, v):
+            return -((v - l) ** 2) / (2 * s**2) - jnp.log(s) - 0.5 * math.log(2 * math.pi)
+
+        return primitive(impl, self._loc, self._scale, value, _name="normal_log_prob")
+
+    def entropy(self):
+        def impl(l, s):
+            shape = jnp.broadcast_shapes(l.shape, s.shape)
+            return jnp.broadcast_to(0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(s), shape)
+
+        return primitive(impl, self._loc, self._scale, _name="normal_entropy")
+
+    def probs(self, value):
+        return self.prob(value)
+
+    @property
+    def _natural_parameters(self):
+        loc, scale = self.loc, self.scale
+        return (loc / scale**2, -0.5 / scale**2)
+
+    def _log_normalizer(self, x, y):
+        return -0.25 * x**2 / y + 0.5 * jnp.log(-math.pi / y)
+
+    @property
+    def _mean_carrier_measure(self):
+        return 0.0
+
+
+class Uniform(Distribution):
+    """U[low, high) — ref uniform.py:34."""
+
+    def __init__(self, low, high, name=None):
+        self._low = _param(low)
+        self._high = _param(high)
+        shape = jnp.broadcast_shapes(unwrap(self._low).shape, unwrap(self._high).shape)
+        super().__init__(batch_shape=shape)
+
+    @property
+    def low(self):
+        return jnp.broadcast_to(unwrap(self._low), self.batch_shape)
+
+    @property
+    def high(self):
+        return jnp.broadcast_to(unwrap(self._high), self.batch_shape)
+
+    @property
+    def mean(self):
+        return primitive(lambda a, b: (a + b) / 2, self._low, self._high, _name="uniform_mean")
+
+    @property
+    def variance(self):
+        return primitive(lambda a, b: (b - a) ** 2 / 12, self._low, self._high, _name="uniform_variance")
+
+    def sample(self, shape=(), seed=0):
+        return self.rsample(shape).detach()
+
+    def rsample(self, shape=()):
+        out_shape = tuple(shape) + self.batch_shape
+        u = jrandom.uniform(split_key(), out_shape, jnp.result_type(unwrap(self._low).dtype, jnp.float32))
+        return primitive(lambda a, b: a + (b - a) * u, self._low, self._high, _name="uniform_rsample")
+
+    def log_prob(self, value):
+        value = _param(value)
+
+        def impl(a, b, v):
+            inside = (v >= a) & (v < b)
+            return jnp.where(inside, -jnp.log(b - a), -jnp.inf)
+
+        return primitive(impl, self._low, self._high, value, _name="uniform_log_prob")
+
+    def entropy(self):
+        return primitive(lambda a, b: jnp.log(b - a), self._low, self._high, _name="uniform_entropy")

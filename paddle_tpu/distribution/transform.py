@@ -1,0 +1,347 @@
+"""Bijective transforms (ref python/paddle/distribution/transform.py).
+
+TPU-first: forward/inverse/log-det are pure jnp functions; where the
+reference hand-derives log-det Jacobians we keep the same closed forms
+(they're already elementwise/cheap) rather than calling jax.jacfwd.
+"""
+from __future__ import annotations
+
+import math
+from typing import Sequence
+
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, _wrap_value, unwrap
+from .distribution import _arr
+
+__all__ = [
+    "Transform",
+    "AbsTransform",
+    "AffineTransform",
+    "ChainTransform",
+    "ExpTransform",
+    "IndependentTransform",
+    "PowerTransform",
+    "ReshapeTransform",
+    "SigmoidTransform",
+    "SoftmaxTransform",
+    "StackTransform",
+    "StickBreakingTransform",
+    "TanhTransform",
+]
+
+
+def _sum_rightmost(x, n):
+    return jnp.sum(x, axis=tuple(range(-n, 0))) if n > 0 else x
+
+
+class Transform:
+    """Base transform (ref transform.py:50)."""
+
+    _event_dim = 0
+
+    @classmethod
+    def _is_injective(cls):
+        return True
+
+    def __call__(self, x):
+        from .transformed_distribution import TransformedDistribution
+        from .distribution import Distribution
+
+        if isinstance(x, Distribution):
+            return TransformedDistribution(x, [self])
+        if isinstance(x, Transform):
+            return ChainTransform([self, x])
+        return self.forward(x)
+
+    def forward(self, x):
+        from ..framework.core import primitive
+        from .distribution import _param
+
+        return primitive(self._forward, _param(x), _name=f"{type(self).__name__}.forward")
+
+    def inverse(self, y):
+        from ..framework.core import primitive
+        from .distribution import _param
+
+        return primitive(self._inverse, _param(y), _name=f"{type(self).__name__}.inverse")
+
+    def forward_log_det_jacobian(self, x):
+        from ..framework.core import primitive
+        from .distribution import _param
+
+        return primitive(
+            self._forward_log_det_jacobian, _param(x), _name=f"{type(self).__name__}.fldj"
+        )
+
+    def inverse_log_det_jacobian(self, y):
+        from ..framework.core import primitive
+        from .distribution import _param
+
+        return primitive(
+            lambda v: -self._forward_log_det_jacobian(self._inverse(v)),
+            _param(y),
+            _name=f"{type(self).__name__}.ildj",
+        )
+
+    def forward_shape(self, shape):
+        return tuple(shape)
+
+    def inverse_shape(self, shape):
+        return tuple(shape)
+
+    def _forward(self, x):
+        raise NotImplementedError
+
+    def _inverse(self, y):
+        raise NotImplementedError
+
+    def _forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+
+class AbsTransform(Transform):
+    @classmethod
+    def _is_injective(cls):
+        return False
+
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return y  # principal branch, matching reference's (-y, y) simplification
+
+    def inverse_log_det_jacobian(self, y):
+        return _wrap_value(jnp.zeros_like(_arr(y)))
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+
+    def _forward(self, x):
+        return self.loc + self.scale * x
+
+    def _inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale)), x.shape)
+
+
+class ExpTransform(Transform):
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _forward_log_det_jacobian(self, x):
+        return x
+
+
+class PowerTransform(Transform):
+    def __init__(self, power):
+        self.power = _arr(power)
+
+    def _forward(self, x):
+        return jnp.power(x, self.power)
+
+    def _inverse(self, y):
+        return jnp.power(y, 1.0 / self.power)
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.log(jnp.abs(self.power * jnp.power(x, self.power - 1)))
+
+
+class SigmoidTransform(Transform):
+    def _forward(self, x):
+        return 1 / (1 + jnp.exp(-x))
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _forward_log_det_jacobian(self, x):
+        from jax.nn import softplus
+
+        return -softplus(-x) - softplus(x)
+
+
+class TanhTransform(Transform):
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(y)
+
+    def _forward_log_det_jacobian(self, x):
+        from jax.nn import softplus
+
+        return 2.0 * (math.log(2.0) - x - softplus(-2.0 * x))
+
+
+class SoftmaxTransform(Transform):
+    _event_dim = 1
+
+    @classmethod
+    def _is_injective(cls):
+        return False
+
+    def _forward(self, x):
+        z = jnp.exp(x - jnp.max(x, -1, keepdims=True))
+        return z / jnp.sum(z, -1, keepdims=True)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms: Sequence[Transform]):
+        self.transforms = list(transforms)
+        self._event_dim = max((t._event_dim for t in self.transforms), default=0)
+
+    def _is_injective(self):
+        return all(t._is_injective() for t in self.transforms)
+
+    def _forward(self, x):
+        for t in self.transforms:
+            x = t._forward(x)
+        return x
+
+    def _inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t._inverse(y)
+        return y
+
+    def _forward_log_det_jacobian(self, x):
+        value = 0.0
+        event_dim = self._event_dim
+        for t in self.transforms:
+            value = value + _sum_rightmost(
+                t._forward_log_det_jacobian(x), event_dim - t._event_dim
+            )
+            x = t._forward(x)
+        return value
+
+    def forward_shape(self, shape):
+        for t in self.transforms:
+            shape = t.forward_shape(shape)
+        return tuple(shape)
+
+    def inverse_shape(self, shape):
+        for t in reversed(self.transforms):
+            shape = t.inverse_shape(shape)
+        return tuple(shape)
+
+
+class IndependentTransform(Transform):
+    def __init__(self, base: Transform, reinterpreted_batch_rank: int):
+        self.base = base
+        self.reinterpreted_batch_rank = int(reinterpreted_batch_rank)
+        self._event_dim = base._event_dim + self.reinterpreted_batch_rank
+
+    def _is_injective(self):
+        return self.base._is_injective()
+
+    def _forward(self, x):
+        return self.base._forward(x)
+
+    def _inverse(self, y):
+        return self.base._inverse(y)
+
+    def _forward_log_det_jacobian(self, x):
+        return _sum_rightmost(
+            self.base._forward_log_det_jacobian(x), self.reinterpreted_batch_rank
+        )
+
+
+class ReshapeTransform(Transform):
+    def __init__(self, in_event_shape, out_event_shape):
+        self.in_event_shape = tuple(in_event_shape)
+        self.out_event_shape = tuple(out_event_shape)
+        self._event_dim = len(self.in_event_shape)
+
+    def _forward(self, x):
+        batch = x.shape[: x.ndim - len(self.in_event_shape)]
+        return jnp.reshape(x, batch + self.out_event_shape)
+
+    def _inverse(self, y):
+        batch = y.shape[: y.ndim - len(self.out_event_shape)]
+        return jnp.reshape(y, batch + self.in_event_shape)
+
+    def _forward_log_det_jacobian(self, x):
+        batch = x.shape[: x.ndim - len(self.in_event_shape)]
+        return jnp.zeros(batch, x.dtype)
+
+    def forward_shape(self, shape):
+        n = len(self.in_event_shape)
+        return tuple(shape[:-n]) + self.out_event_shape if n else tuple(shape) + self.out_event_shape
+
+    def inverse_shape(self, shape):
+        n = len(self.out_event_shape)
+        return tuple(shape[:-n]) + self.in_event_shape if n else tuple(shape) + self.in_event_shape
+
+
+class StackTransform(Transform):
+    """Apply a list of transforms along slices of ``axis`` (ref transform.py)."""
+
+    def __init__(self, transforms: Sequence[Transform], axis: int = 0):
+        self.transforms = list(transforms)
+        self.axis = int(axis)
+
+    def _map(self, fn_name, x):
+        slices = jnp.moveaxis(x, self.axis, 0)
+        outs = [getattr(t, fn_name)(s) for t, s in zip(self.transforms, slices)]
+        return jnp.moveaxis(jnp.stack(outs, 0), 0, self.axis)
+
+    def _forward(self, x):
+        return self._map("_forward", x)
+
+    def _inverse(self, y):
+        return self._map("_inverse", y)
+
+    def _forward_log_det_jacobian(self, x):
+        return self._map("_forward_log_det_jacobian", x)
+
+
+class StickBreakingTransform(Transform):
+    """Unconstrained R^k -> simplex of dim k+1 (ref transform.py)."""
+
+    _event_dim = 1
+
+    def _forward(self, x):
+        from jax.nn import sigmoid
+
+        k = x.shape[-1]
+        offset = jnp.arange(k, 0, -1, dtype=x.dtype)
+        z = sigmoid(x - jnp.log(offset))
+        zcum = jnp.cumprod(1 - z, -1)
+        head = z * jnp.concatenate([jnp.ones_like(z[..., :1]), zcum[..., :-1]], -1)
+        tail = zcum[..., -1:]
+        return jnp.concatenate([head, tail], -1)
+
+    def _inverse(self, y):
+        ycum = jnp.cumsum(y[..., :-1], -1)
+        rem = 1 - jnp.concatenate([jnp.zeros_like(ycum[..., :1]), ycum[..., :-1]], -1)
+        z = y[..., :-1] / rem
+        k = z.shape[-1]
+        offset = jnp.arange(k, 0, -1, dtype=y.dtype)
+        return jnp.log(z) - jnp.log1p(-z) + jnp.log(offset)
+
+    def _forward_log_det_jacobian(self, x):
+        from jax.nn import log_sigmoid
+
+        k = x.shape[-1]
+        offset = jnp.arange(k, 0, -1, dtype=x.dtype)
+        t = x - jnp.log(offset)
+        y = self._forward(x)
+        ycum = jnp.cumsum(y[..., :-1], -1)
+        rem = 1 - jnp.concatenate([jnp.zeros_like(ycum[..., :1]), ycum[..., :-1]], -1)
+        return jnp.sum(jnp.log(rem) + log_sigmoid(t) + log_sigmoid(-t), -1)
+
+    def forward_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] + 1,)
+
+    def inverse_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] - 1,)

@@ -1,0 +1,90 @@
+"""Independent + TransformedDistribution
+(ref python/paddle/distribution/{independent,transformed_distribution}.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework.core import _wrap_value, unwrap
+from .distribution import Distribution, _arr
+from .transform import ChainTransform, Transform, _sum_rightmost
+
+
+class Independent(Distribution):
+    """Reinterpret rightmost batch dims as event dims (ref independent.py:22)."""
+
+    def __init__(self, base: Distribution, reinterpreted_batch_rank: int):
+        if reinterpreted_batch_rank > len(base.batch_shape):
+            raise ValueError("reinterpreted_batch_rank exceeds base batch rank")
+        self._base = base
+        self._rank = int(reinterpreted_batch_rank)
+        shape = base.batch_shape + base.event_shape
+        n_batch = len(base.batch_shape) - self._rank
+        super().__init__(batch_shape=shape[:n_batch], event_shape=shape[n_batch:])
+
+    @property
+    def mean(self):
+        return self._base.mean
+
+    @property
+    def variance(self):
+        return self._base.variance
+
+    def sample(self, shape=()):
+        return self._base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self._base.rsample(shape)
+
+    def log_prob(self, value):
+        from ..framework.core import primitive
+
+        lp = self._base.log_prob(value)
+        return primitive(lambda a: _sum_rightmost(a, self._rank), lp, _name="independent_log_prob")
+
+    def entropy(self):
+        from ..framework.core import primitive
+
+        ent = self._base.entropy()
+        return primitive(lambda a: _sum_rightmost(a, self._rank), ent, _name="independent_entropy")
+
+
+class TransformedDistribution(Distribution):
+    """Pushforward of ``base`` through ``transforms`` (ref transformed_distribution.py:22)."""
+
+    def __init__(self, base: Distribution, transforms):
+        self._base = base
+        self._transforms = [transforms] if isinstance(transforms, Transform) else list(transforms)
+        chain = ChainTransform(self._transforms)
+        shape = base.batch_shape + base.event_shape
+        out_shape = chain.forward_shape(shape)
+        event_rank = max(chain._event_dim, len(base.event_shape))
+        cut = len(out_shape) - event_rank
+        super().__init__(batch_shape=out_shape[:cut], event_shape=out_shape[cut:])
+        self._chain = chain
+
+    def sample(self, shape=()):
+        return self.rsample(shape).detach()
+
+    def rsample(self, shape=()):
+        from ..framework.core import primitive
+
+        x = self._base.rsample(shape)
+        return primitive(self._chain._forward, x, _name="transformed_rsample")
+
+    def log_prob(self, value):
+        from ..framework.core import primitive
+        from .distribution import _param
+
+        event_rank = max(self._chain._event_dim, len(self._base.event_shape))
+
+        # every stage is a tape op, so grads flow to the value AND the base
+        # distribution's parameters (normalizing-flow training path)
+        y = _param(value)
+        x = self._chain.inverse(y)
+        lp_base = self._base.log_prob(x)
+        ldj = self._chain.forward_log_det_jacobian(x)
+        k_lp = event_rank - len(self._base.event_shape)
+        k_ldj = event_rank - self._chain._event_dim
+        lp = primitive(lambda a: _sum_rightmost(a, k_lp), lp_base, _name="transformed_lp_sum")
+        ldj_s = primitive(lambda a: _sum_rightmost(a, k_ldj), ldj, _name="transformed_ldj_sum")
+        return primitive(lambda a, b: a - b, lp, ldj_s, _name="transformed_log_prob")

@@ -179,6 +179,11 @@ def _call_vjp(node, cots):
     import jax.numpy as jnp
     import numpy as np
 
+    # A vjp_fn may opt out of zero-materialization (PyLayer
+    # set_materialize_grads(False) parity): missing cotangents stay None.
+    if getattr(node.vjp_fn, "_no_materialize_cots", False):
+        out = node.vjp_fn(tuple(cots) if node.n_out > 1 else cots[0])
+        return out
     # Replace missing cotangents (outputs unused downstream) with zeros of the
     # shape/dtype recorded at trace time. Integer/bool outputs take float0
     # cotangents per JAX convention.

@@ -1,0 +1,211 @@
+"""paddle.distribution parity tests (ref python/paddle/distribution/)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distribution import (
+    AffineTransform,
+    Beta,
+    Categorical,
+    ChainTransform,
+    Dirichlet,
+    ExpTransform,
+    Independent,
+    Multinomial,
+    Normal,
+    SigmoidTransform,
+    StickBreakingTransform,
+    TanhTransform,
+    TransformedDistribution,
+    Uniform,
+    kl_divergence,
+)
+
+
+def test_normal_basic():
+    d = Normal(loc=0.0, scale=2.0)
+    np.testing.assert_allclose(d.mean.numpy(), 0.0)
+    np.testing.assert_allclose(d.variance.numpy(), 4.0)
+    # log_prob vs closed form
+    x = np.array([0.5, -1.0], "float32")
+    expect = -((x - 0) ** 2) / 8 - np.log(2.0) - 0.5 * np.log(2 * np.pi)
+    np.testing.assert_allclose(d.log_prob(paddle.to_tensor(x)).numpy(), expect, rtol=1e-5)
+    s = d.sample((5000,))
+    assert abs(float(np.mean(s.numpy()))) < 0.15
+    assert abs(float(np.std(s.numpy())) - 2.0) < 0.15
+
+
+def test_normal_entropy_kl():
+    p = Normal(0.0, 1.0)
+    q = Normal(1.0, 2.0)
+    # closed-form KL(N0||N1)
+    expect = np.log(2.0) + (1 + 1) / (2 * 4) - 0.5
+    np.testing.assert_allclose(kl_divergence(p, q).numpy(), expect, rtol=1e-5)
+    np.testing.assert_allclose(p.entropy().numpy(), 0.5 * np.log(2 * np.pi * np.e), rtol=1e-5)
+
+
+def test_uniform():
+    d = Uniform(1.0, 3.0)
+    np.testing.assert_allclose(d.mean.numpy(), 2.0)
+    np.testing.assert_allclose(d.entropy().numpy(), np.log(2.0), rtol=1e-6)
+    lp = d.log_prob(paddle.to_tensor(np.array([2.0, 5.0], "float32"))).numpy()
+    np.testing.assert_allclose(lp[0], -np.log(2.0), rtol=1e-6)
+    assert np.isinf(lp[1]) and lp[1] < 0
+    s = d.sample((1000,)).numpy()
+    assert s.min() >= 1.0 and s.max() < 3.0
+
+
+def test_categorical():
+    logits = np.array([1.0, 2.0, 3.0], "float32")  # unnormalized weights
+    d = Categorical(paddle.to_tensor(logits))
+    p = logits / logits.sum()
+    np.testing.assert_allclose(d.entropy().numpy(), -(p * np.log(p)).sum(), rtol=1e-5)
+    np.testing.assert_allclose(
+        d.log_prob(paddle.to_tensor(np.array([2], "int64"))).numpy(), np.log(p[2]), rtol=1e-5
+    )
+    s = d.sample((4000,)).numpy()
+    freq = np.bincount(s.ravel(), minlength=3) / s.size
+    np.testing.assert_allclose(freq, p, atol=0.05)
+
+
+def test_categorical_kl():
+    a = Categorical(paddle.to_tensor(np.array([1.0, 1.0], "float32")))
+    b = Categorical(paddle.to_tensor(np.array([1.0, 3.0], "float32")))
+    pa, pb = np.array([0.5, 0.5]), np.array([0.25, 0.75])
+    np.testing.assert_allclose(
+        kl_divergence(a, b).numpy(), (pa * np.log(pa / pb)).sum(), rtol=1e-5
+    )
+
+
+def test_beta_dirichlet():
+    b = Beta(2.0, 3.0)
+    np.testing.assert_allclose(b.mean.numpy(), 0.4, rtol=1e-6)
+    np.testing.assert_allclose(b.variance.numpy(), 2 * 3 / (25 * 6), rtol=1e-6)
+    # log_prob at x=0.5: Beta(2,3) pdf = x(1-x)^2 / B(2,3); B(2,3)=1/12
+    np.testing.assert_allclose(
+        b.log_prob(paddle.to_tensor(0.5)).numpy(), np.log(12 * 0.5 * 0.25), rtol=1e-5
+    )
+    d = Dirichlet(paddle.to_tensor(np.array([1.0, 2.0, 3.0], "float32")))
+    np.testing.assert_allclose(d.mean.numpy(), [1 / 6, 2 / 6, 3 / 6], rtol=1e-6)
+    s = d.sample((100,)).numpy()
+    np.testing.assert_allclose(s.sum(-1), np.ones(100), rtol=1e-5)
+    kl = kl_divergence(d, Dirichlet(paddle.to_tensor(np.array([3.0, 2.0, 1.0], "float32"))))
+    assert float(kl.numpy()) > 0
+
+
+def test_multinomial():
+    m = Multinomial(10, paddle.to_tensor(np.array([0.2, 0.3, 0.5], "float32")))
+    np.testing.assert_allclose(m.mean.numpy(), [2.0, 3.0, 5.0], rtol=1e-6)
+    s = m.sample().numpy()
+    assert s.sum() == 10
+    # log_prob of the mode-ish draw is finite
+    lp = m.log_prob(paddle.to_tensor(np.array([2.0, 3.0, 5.0], "float32"))).numpy()
+    assert np.isfinite(lp)
+
+
+def test_transforms_roundtrip():
+    x = np.linspace(-2, 2, 7).astype("float32")
+    for t in [AffineTransform(1.0, 3.0), ExpTransform(), SigmoidTransform(), TanhTransform()]:
+        y = t.forward(paddle.to_tensor(x))
+        x2 = t.inverse(y)
+        np.testing.assert_allclose(x2.numpy(), x, rtol=1e-4, atol=1e-5)
+        # fldj consistency: inverse_ldj(y) == -forward_ldj(x)
+        np.testing.assert_allclose(
+            t.inverse_log_det_jacobian(y).numpy(),
+            -t.forward_log_det_jacobian(paddle.to_tensor(x)).numpy(),
+            rtol=1e-4,
+            atol=1e-5,
+        )
+
+
+def test_chain_transform():
+    t = ChainTransform([AffineTransform(0.0, 2.0), ExpTransform()])
+    x = paddle.to_tensor(np.array([0.1, 0.5], "float32"))
+    np.testing.assert_allclose(t.forward(x).numpy(), np.exp(2 * x.numpy()), rtol=1e-5)
+    np.testing.assert_allclose(
+        t.forward_log_det_jacobian(x).numpy(), np.log(2.0) + 2 * x.numpy(), rtol=1e-5
+    )
+
+
+def test_stickbreaking():
+    t = StickBreakingTransform()
+    x = paddle.to_tensor(np.array([0.2, -0.5, 1.0], "float32"))
+    y = t.forward(x)
+    assert y.shape == [4]
+    np.testing.assert_allclose(y.numpy().sum(), 1.0, rtol=1e-5)
+    x2 = t.inverse(y)
+    np.testing.assert_allclose(x2.numpy(), x.numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_transformed_distribution_lognormal():
+    base = Normal(0.0, 1.0)
+    d = TransformedDistribution(base, [ExpTransform()])
+    x = np.array([0.5, 1.0, 2.0], "float32")
+    # lognormal pdf: N(log x)/x
+    expect = -0.5 * np.log(x) ** 2 - 0.5 * np.log(2 * np.pi) - np.log(x)
+    np.testing.assert_allclose(d.log_prob(paddle.to_tensor(x)).numpy(), expect, rtol=1e-5)
+    s = d.sample((2000,)).numpy()
+    assert (s > 0).all()
+
+
+def test_independent():
+    base = Normal(np.zeros(3, "float32"), np.ones(3, "float32"))
+    d = Independent(base, 1)
+    assert d.event_shape == (3,)
+    x = paddle.to_tensor(np.zeros(3, "float32"))
+    np.testing.assert_allclose(
+        d.log_prob(x).numpy(), 3 * (-0.5 * np.log(2 * np.pi)), rtol=1e-5
+    )
+
+
+def test_expfamily_kl_fallback():
+    """Bregman KL for a family without a specific registration = Normal works too."""
+    from paddle_tpu.distribution.kl import _kl_expfamily_expfamily
+
+    p, q = Normal(0.0, 1.0), Normal(1.0, 2.0)
+    got = _kl_expfamily_expfamily(p, q).numpy()
+    expect = np.log(2.0) + (1 + 1) / (2 * 4) - 0.5
+    np.testing.assert_allclose(got, expect, rtol=1e-4)
+
+
+def test_param_grads_flow():
+    """Distribution params connected to the eager tape receive grads."""
+    loc = paddle.to_tensor(np.array([0.5], "float32"), stop_gradient=False)
+    d = Normal(loc, 1.0)
+    d.log_prob(paddle.to_tensor(np.array([1.0], "float32"))).backward()
+    np.testing.assert_allclose(loc.grad.numpy(), [0.5], rtol=1e-5)  # (v-loc)/var
+
+    a = paddle.to_tensor(np.array(2.0, "float32"), stop_gradient=False)
+    kl = kl_divergence(Beta(a, 3.0), Beta(1.0, 1.0))
+    kl.backward()
+    assert a.grad is not None and np.isfinite(a.grad.numpy())
+
+
+def test_transformed_log_prob_base_param_grads():
+    loc = paddle.to_tensor(np.array(0.0, "float32"), stop_gradient=False)
+    d = TransformedDistribution(Normal(loc, 1.0), [ExpTransform()])
+    d.log_prob(paddle.to_tensor(np.array([1.0], "float32"))).backward()
+    assert loc.grad is not None
+    # d/dloc [-(log x - loc)^2/2] at x=1 -> (0 - loc) = 0... use x=e
+    loc2 = paddle.to_tensor(np.array(0.0, "float32"), stop_gradient=False)
+    d2 = TransformedDistribution(Normal(loc2, 1.0), [ExpTransform()])
+    d2.log_prob(paddle.to_tensor(np.array([np.e], "float32"))).backward()
+    np.testing.assert_allclose(loc2.grad.numpy(), 1.0, rtol=1e-5)
+
+
+def test_expfamily_kl_batched_elementwise():
+    from paddle_tpu.distribution.kl import _kl_expfamily_expfamily
+
+    p = Normal(np.zeros(3, "float32"), np.ones(3, "float32"))
+    q = Normal(np.ones(3, "float32"), 2 * np.ones(3, "float32"))
+    got = _kl_expfamily_expfamily(p, q)
+    assert got.shape == [3]
+    expect = np.log(2.0) + 2 / 8 - 0.5
+    np.testing.assert_allclose(got.numpy(), np.full(3, expect), rtol=1e-4)
+
+
+def test_rsample_reparameterized_grads():
+    loc = paddle.to_tensor(np.array(1.0, "float32"), stop_gradient=False)
+    s = Normal(loc, 1.0).rsample((8,))
+    paddle.mean(s).backward()
+    np.testing.assert_allclose(loc.grad.numpy(), 1.0, rtol=1e-5)

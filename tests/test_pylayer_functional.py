@@ -1,0 +1,161 @@
+"""PyLayer custom autograd + functional jacobian/hessian/jvp/vjp.
+
+Parity targets: python/paddle/autograd/py_layer.py, functional.py.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.autograd import PyLayer, hessian, jacobian, jvp, vjp
+
+
+class Cube(PyLayer):
+    @staticmethod
+    def forward(ctx, x):
+        ctx.save_for_backward(x)
+        return x * x * x
+
+    @staticmethod
+    def backward(ctx, dy):
+        (x,) = ctx.saved_tensor()
+        return 3 * x * x * dy
+
+
+class ScaledAdd(PyLayer):
+    """Two diff inputs + one non-tensor attr."""
+
+    @staticmethod
+    def forward(ctx, x, y, alpha=2.0):
+        ctx.alpha = alpha
+        return x + alpha * y
+
+    @staticmethod
+    def backward(ctx, dy):
+        return dy, ctx.alpha * dy
+
+
+class TwoOut(PyLayer):
+    @staticmethod
+    def forward(ctx, x):
+        ctx.save_for_backward(x)
+        return x * 2, x * x
+
+    @staticmethod
+    def backward(ctx, d1, d2):
+        (x,) = ctx.saved_tensor()
+        return 2 * d1 + 2 * x * d2
+
+
+def test_pylayer_cube_grad():
+    x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], "float32"), stop_gradient=False)
+    y = Cube.apply(x)
+    loss = paddle.sum(y)
+    loss.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 3 * np.array([1, 4, 9], "float32"), rtol=1e-6)
+
+
+def test_pylayer_two_inputs():
+    x = paddle.to_tensor(np.array([1.0, 2.0], "float32"), stop_gradient=False)
+    y = paddle.to_tensor(np.array([3.0, 4.0], "float32"), stop_gradient=False)
+    out = ScaledAdd.apply(x, y, alpha=5.0)
+    paddle.sum(out).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [1.0, 1.0])
+    np.testing.assert_allclose(y.grad.numpy(), [5.0, 5.0])
+
+
+def test_pylayer_multi_output():
+    x = paddle.to_tensor(np.array([2.0, 3.0], "float32"), stop_gradient=False)
+    a, b = TwoOut.apply(x)
+    (paddle.sum(a) + paddle.sum(b)).backward()
+    np.testing.assert_allclose(x.grad.numpy(), 2 + 2 * np.array([2.0, 3.0]))
+
+
+def test_pylayer_composes_with_ops():
+    x = paddle.to_tensor(np.array([1.5], "float32"), stop_gradient=False)
+    y = Cube.apply(x * 2.0)  # chain: tape op -> pylayer
+    z = y * 4.0              # pylayer -> tape op
+    z.backward()
+    # d/dx 4*(2x)^3 = 96 x^2
+    np.testing.assert_allclose(x.grad.numpy(), 96 * 1.5**2, rtol=1e-5)
+
+
+def test_pylayer_stopgrad_input_passthrough():
+    x = paddle.to_tensor(np.array([1.0], "float32"))  # stop_gradient=True
+    y = Cube.apply(x)
+    assert y.stop_gradient
+
+
+def test_vjp_jvp():
+    x = paddle.to_tensor(np.array([1.0, 2.0], "float32"), stop_gradient=False)
+    f = lambda t: t * t
+    out, g = vjp(f, x, paddle.to_tensor(np.ones(2, "float32")))
+    np.testing.assert_allclose(out.numpy(), [1.0, 4.0])
+    np.testing.assert_allclose(g.numpy(), [2.0, 4.0])
+    out, tang = jvp(f, x, paddle.to_tensor(np.ones(2, "float32")))
+    np.testing.assert_allclose(tang.numpy(), [2.0, 4.0])
+
+
+def test_jacobian_single():
+    x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], "float32"), stop_gradient=False)
+    J = jacobian(lambda t: t * t, x)
+    np.testing.assert_allclose(J.numpy(), np.diag([2.0, 4.0, 6.0]), rtol=1e-6)
+
+
+def test_jacobian_multi_input():
+    x = paddle.to_tensor(np.array([1.0, 2.0], "float32"), stop_gradient=False)
+    y = paddle.to_tensor(np.array([3.0], "float32"), stop_gradient=False)
+    Jx, Jy = jacobian(lambda a, b: a * b, [x, y])
+    np.testing.assert_allclose(Jx.numpy(), np.diag([3.0, 3.0]), rtol=1e-6)
+    np.testing.assert_allclose(Jy.numpy(), [[1.0], [2.0]], rtol=1e-6)
+
+
+def test_hessian():
+    x = paddle.to_tensor(np.array([1.0, 2.0], "float32"), stop_gradient=False)
+    H = hessian(lambda t: paddle.sum(t * t * t), x)
+    np.testing.assert_allclose(H.numpy(), np.diag([6.0, 12.0]), rtol=1e-6)
+
+
+class KwargAdd(PyLayer):
+    @staticmethod
+    def forward(ctx, x, y=None):
+        return x + 3.0 * y
+
+    @staticmethod
+    def backward(ctx, dy):
+        return dy, 3.0 * dy
+
+
+def test_pylayer_kwarg_tensor_gets_grad():
+    x = paddle.to_tensor(np.array([1.0], "float32"), stop_gradient=False)
+    y = paddle.to_tensor(np.array([2.0], "float32"), stop_gradient=False)
+    out = KwargAdd.apply(x, y=y)
+    out.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [1.0])
+    np.testing.assert_allclose(y.grad.numpy(), [3.0])
+
+
+class NoMaterialize(PyLayer):
+    seen = []
+
+    @staticmethod
+    def forward(ctx, x):
+        ctx.set_materialize_grads(False)
+        return x * 2, x * 5
+
+    @staticmethod
+    def backward(ctx, d1, d2):
+        NoMaterialize.seen = [d1, d2]
+        g = 0.0
+        if d1 is not None:
+            g = g + 2 * d1
+        if d2 is not None:
+            g = g + 5 * d2
+        return g
+
+
+def test_pylayer_set_materialize_grads_false():
+    x = paddle.to_tensor(np.array([1.0], "float32"), stop_gradient=False)
+    a, b = NoMaterialize.apply(x)
+    a.backward()  # b unused downstream -> its cotangent must arrive as None
+    assert NoMaterialize.seen[1] is None
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
