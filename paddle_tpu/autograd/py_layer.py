@@ -81,12 +81,14 @@ class PyLayer(metaclass=PyLayerMeta):
         # inputs that participate in grad flow: positional first, then kwargs
         # in insertion order (reference packs kwarg tensors into the graph too)
         all_inputs = list(args) + list(kwargs.values())
-        diff_inputs = [
-            a
-            for a in all_inputs
+        diff_positions = [
+            i
+            for i, a in enumerate(all_inputs)
             if isinstance(a, Tensor) and not a.stop_gradient and _is_float_array(a._value)
         ] if is_grad_enabled() else []
-        tensor_inputs = [a for a in all_inputs if isinstance(a, Tensor)]
+        diff_inputs = [all_inputs[i] for i in diff_positions]
+        tensor_positions = [i for i, a in enumerate(all_inputs) if isinstance(a, Tensor)]
+        tensor_inputs = [all_inputs[i] for i in tensor_positions]
 
         with no_grad():
             out = cls.forward(ctx, *args, **kwargs)
@@ -122,20 +124,22 @@ class PyLayer(metaclass=PyLayerMeta):
             with no_grad():
                 gin = cls.backward(ctx, *grad_out)
             gin = list(gin) if isinstance(gin, (tuple, list)) else [gin]
-            # reference semantics: backward returns one grad per *tensor* input
-            if len(gin) != len(tensor_inputs):
-                if len(gin) == len(diff_inputs):
-                    by_input = dict(zip((id(t) for t in diff_inputs), gin))
-                    gin = [by_input.get(id(t)) for t in tensor_inputs]
-                else:
-                    raise ValueError(
-                        f"PyLayer.backward returned {len(gin)} grads for "
-                        f"{len(tensor_inputs)} tensor inputs"
-                    )
-            by_id = dict(zip((id(t) for t in tensor_inputs), gin))
+            # reference semantics: backward returns one grad per *tensor* input,
+            # positionally — the same tensor passed twice gets two distinct
+            # partials, which the engine then accumulates.
+            if len(gin) == len(tensor_inputs):
+                pos_to_gin = dict(zip(tensor_positions, gin))
+            elif len(gin) == len(diff_inputs):
+                pos_to_gin = dict(zip(diff_positions, gin))
+            else:
+                raise ValueError(
+                    f"PyLayer.backward returned {len(gin)} grads for "
+                    f"{len(tensor_inputs)} tensor inputs"
+                )
             result = []
-            for t in diff_inputs:
-                g = by_id.get(id(t))
+            for p in diff_positions:
+                t = all_inputs[p]
+                g = pos_to_gin.get(p)
                 if g is None:
                     result.append(jnp.zeros(t._value.shape, t._value.dtype))
                 else:

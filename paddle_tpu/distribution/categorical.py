@@ -37,7 +37,7 @@ class Categorical(Distribution):
     def sample(self, shape=()):
         shape = tuple(shape) + self.batch_shape
         idx = jrandom.categorical(split_key(), self._log_p, shape=shape)
-        return _wrap_value(idx.astype(jnp.int64))
+        return _wrap_value(idx)  # default index dtype (int32 without x64)
 
     @staticmethod
     def _gather(table, v):
@@ -108,10 +108,12 @@ class Multinomial(Distribution):
         from jax.scipy.special import gammaln
 
         logits = jnp.log(self.probs)
+        # mask 0 * log(0) = 0 * -inf for zero-count zero-probability categories
+        term = jnp.where((v == 0) & jnp.isinf(logits), 0.0, v * logits)
         return _wrap_value(
             gammaln(jnp.asarray(self.total_count + 1.0))
             - jnp.sum(gammaln(v + 1.0), -1)
-            + jnp.sum(v * logits, -1)
+            + jnp.sum(term, -1)
         )
 
     def entropy(self):

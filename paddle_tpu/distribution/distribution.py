@@ -64,7 +64,9 @@ class Distribution:
         raise NotImplementedError
 
     def prob(self, value):
-        return _wrap_value(jnp.exp(unwrap(self.log_prob(value))))
+        from ..tensor.math import exp
+
+        return exp(self.log_prob(value))  # tape-connected: grads flow to params
 
     def entropy(self):
         raise NotImplementedError

@@ -209,3 +209,23 @@ def test_rsample_reparameterized_grads():
     s = Normal(loc, 1.0).rsample((8,))
     paddle.mean(s).backward()
     np.testing.assert_allclose(loc.grad.numpy(), 1.0, rtol=1e-5)
+
+
+def test_prob_grads_flow():
+    """Distribution.prob must stay on the tape (not detach via raw exp)."""
+    loc = paddle.to_tensor(0.5, stop_gradient=False)
+    scale = paddle.to_tensor(1.0, stop_gradient=False)
+    d = Normal(loc, scale)
+    p = d.prob(paddle.to_tensor(0.3))
+    p.backward()
+    assert loc.grad is not None
+    # d/dloc pdf(x; loc) = pdf * (x - loc) / scale^2
+    pdf = float(np.exp(-0.5 * 0.2**2) / np.sqrt(2 * np.pi))
+    np.testing.assert_allclose(float(loc.grad), pdf * (-0.2), rtol=1e-5)
+
+
+def test_multinomial_zero_prob_category():
+    """Zero-probability category with zero count must not produce NaN."""
+    m = Multinomial(3, paddle.to_tensor([0.5, 0.5, 0.0]))
+    lp = float(m.log_prob(paddle.to_tensor([2.0, 1.0, 0.0])))
+    np.testing.assert_allclose(lp, np.log(3 * 0.125), rtol=1e-5)

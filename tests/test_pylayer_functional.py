@@ -159,3 +159,21 @@ def test_pylayer_set_materialize_grads_false():
     a.backward()  # b unused downstream -> its cotangent must arrive as None
     assert NoMaterialize.seen[1] is None
     np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+
+def test_pylayer_same_tensor_multiple_positions():
+    """Same tensor in two arg slots: partials must sum, not overwrite."""
+
+    class F(paddle.autograd.PyLayer):
+        @staticmethod
+        def forward(ctx, a, b):
+            return a + 2 * b
+
+        @staticmethod
+        def backward(ctx, dy):
+            return dy, 2 * dy
+
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = F.apply(x, x)
+    y.backward(paddle.ones_like(y))
+    np.testing.assert_allclose(x.grad.numpy(), [3.0, 3.0])
