@@ -1,0 +1,172 @@
+// Auto-growth best-fit host arena allocator.
+//
+// TPU-native counterpart of the reference's AutoGrowthBestFitAllocator
+// (paddle/fluid/memory/allocation/auto_growth_best_fit_allocator.h:30): carve
+// allocations from malloc'd chunks, best-fit from a size-ordered free map,
+// split on alloc, coalesce with neighbors on free. On TPU the device HBM is
+// managed by PJRT; this arena serves host staging buffers (data-feed batches,
+// checkpoint IO) where the reference used pinned-memory pools, and feeds the
+// pt_stat registry the way memory/stats.h feeds DEVICE_MEMORY_STAT_*.
+#include <cstdint>
+#include <cstdlib>
+#include <list>
+#include <map>
+#include <mutex>
+#include <new>
+#include <vector>
+
+extern "C" {
+void pt_stat_add(const char* name, int64_t delta);
+}
+
+namespace {
+
+constexpr uint64_t kAlign = 64;
+
+uint64_t AlignUp(uint64_t n) { return (n + kAlign - 1) & ~(kAlign - 1); }
+
+struct Chunk;
+
+struct Block {
+  uint8_t* ptr;
+  uint64_t size;
+  bool free;
+  Chunk* chunk;
+  Block* prev = nullptr;
+  Block* next = nullptr;
+  std::multimap<uint64_t, Block*>::iterator free_it;  // valid iff free
+};
+
+struct Chunk {
+  uint8_t* base;
+  uint64_t size;
+  Block* first;
+};
+
+struct Arena {
+  explicit Arena(uint64_t chunk_size) : chunk_size_(chunk_size) {}
+
+  ~Arena() {
+    for (auto& c : chunks_) {
+      Block* b = c.first;
+      while (b) {
+        Block* n = b->next;
+        delete b;
+        b = n;
+      }
+      std::free(c.base);
+    }
+    pt_stat_add("host_arena_reserved", -static_cast<int64_t>(reserved_));
+    pt_stat_add("host_arena_allocated", -static_cast<int64_t>(allocated_));
+  }
+
+  void* Alloc(uint64_t size) {
+    size = AlignUp(size ? size : 1);
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = free_.lower_bound(size);  // best fit: smallest block >= size
+    if (it == free_.end()) {
+      uint64_t chunk_size = std::max(size, chunk_size_);
+      auto* base = static_cast<uint8_t*>(std::malloc(chunk_size));
+      if (!base) throw std::bad_alloc();
+      chunks_.push_back({base, chunk_size, nullptr});
+      auto* blk = new Block{base, chunk_size, true, &chunks_.back()};
+      chunks_.back().first = blk;
+      blk->free_it = free_.emplace(chunk_size, blk);
+      reserved_ += chunk_size;
+      pt_stat_add("host_arena_reserved", static_cast<int64_t>(chunk_size));
+      it = blk->free_it;
+    }
+    Block* blk = it->second;
+    free_.erase(it);
+    blk->free = false;
+    if (blk->size >= size + kAlign) {  // split the tail back into the free map
+      auto* rest = new Block{blk->ptr + size, blk->size - size, true, blk->chunk,
+                             blk, blk->next};
+      if (blk->next) blk->next->prev = rest;
+      blk->next = rest;
+      blk->size = size;
+      rest->free_it = free_.emplace(rest->size, rest);
+    }
+    allocated_ += blk->size;
+    pt_stat_add("host_arena_allocated", static_cast<int64_t>(blk->size));
+    live_.emplace(blk->ptr, blk);
+    return blk->ptr;
+  }
+
+  // Returns false for pointers this arena doesn't own.
+  bool Free(void* p) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = live_.find(static_cast<uint8_t*>(p));
+    if (it == live_.end()) return false;
+    Block* blk = it->second;
+    live_.erase(it);
+    allocated_ -= blk->size;
+    pt_stat_add("host_arena_allocated", -static_cast<int64_t>(blk->size));
+    // coalesce with free neighbors inside the same chunk
+    if (blk->prev && blk->prev->free) {
+      Block* l = blk->prev;
+      free_.erase(l->free_it);
+      l->size += blk->size;
+      l->next = blk->next;
+      if (blk->next) blk->next->prev = l;
+      delete blk;
+      blk = l;
+    }
+    if (blk->next && blk->next->free) {
+      Block* r = blk->next;
+      free_.erase(r->free_it);
+      blk->size += r->size;
+      blk->next = r->next;
+      if (r->next) r->next->prev = blk;
+      delete r;
+    }
+    blk->free = true;
+    blk->free_it = free_.emplace(blk->size, blk);
+    return true;
+  }
+
+  uint64_t allocated() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return allocated_;
+  }
+
+  uint64_t reserved() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return reserved_;
+  }
+
+ private:
+  uint64_t chunk_size_;
+  uint64_t allocated_ = 0;
+  uint64_t reserved_ = 0;
+  std::mutex mu_;
+  std::multimap<uint64_t, Block*> free_;
+  std::map<uint8_t*, Block*> live_;
+  std::list<Chunk> chunks_;  // list: Block::chunk pointers must stay stable
+};
+
+}  // namespace
+
+extern "C" {
+
+void* pt_arena_create(uint64_t chunk_size) {
+  return new Arena(chunk_size ? chunk_size : (8u << 20));
+}
+
+void* pt_arena_alloc(void* a, uint64_t size) {
+  try {
+    return static_cast<Arena*>(a)->Alloc(size);
+  } catch (...) {
+    return nullptr;
+  }
+}
+
+int pt_arena_free(void* a, void* p) {
+  return static_cast<Arena*>(a)->Free(p) ? 0 : -1;
+}
+
+uint64_t pt_arena_allocated(void* a) { return static_cast<Arena*>(a)->allocated(); }
+uint64_t pt_arena_reserved(void* a) { return static_cast<Arena*>(a)->reserved(); }
+void pt_arena_destroy(void* a) { delete static_cast<Arena*>(a); }
+
+}  // extern "C"

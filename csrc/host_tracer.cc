@@ -1,0 +1,164 @@
+// Host-side event tracer: RecordEvent-style begin/end spans, instants and
+// counters collected into per-thread buffers, exported as a chrome trace.
+//
+// TPU-native counterpart of the reference profiler's host tracer
+// (paddle/fluid/platform/profiler/host_tracer.cc, host_event_recorder.h ring
+// buffer, chrometracing_logger.cc exporter). Device-side timing comes from the
+// XLA/TPU profiler; this covers the host annotations the reference records via
+// RecordEvent (platform/profiler/event_tracing.h).
+#include <atomic>
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct TraceEvent {
+  std::string name;
+  std::string cat;
+  char phase;       // 'B', 'E', 'i', 'C'
+  uint64_t ts_us;
+  uint64_t tid;
+  double value;     // counters only
+};
+
+struct ThreadBuffer {
+  std::vector<TraceEvent> events;
+  uint64_t tid;
+  int open_depth = 0;  // 'B' events awaiting their 'E' in this thread
+};
+
+std::mutex g_registry_mu;
+std::vector<ThreadBuffer*> g_buffers;   // never freed: threads may outlive use
+std::atomic<bool> g_enabled{false};
+std::atomic<uint64_t> g_next_tid{1};
+
+uint64_t NowUs() {
+  return std::chrono::duration_cast<std::chrono::microseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+ThreadBuffer* LocalBuffer() {
+  thread_local ThreadBuffer* buf = [] {
+    auto* b = new ThreadBuffer();
+    b->tid = g_next_tid.fetch_add(1);
+    std::lock_guard<std::mutex> lk(g_registry_mu);
+    g_buffers.push_back(b);
+    return b;
+  }();
+  return buf;
+}
+
+void JsonEscape(const std::string& s, std::string* out) {
+  for (char c : s) {
+    switch (c) {
+      case '"': *out += "\\\""; break;
+      case '\\': *out += "\\\\"; break;
+      case '\n': *out += "\\n"; break;
+      case '\t': *out += "\\t"; break;
+      default:
+        if (static_cast<unsigned char>(c) < 0x20) {
+          char hex[8];
+          std::snprintf(hex, sizeof(hex), "\\u%04x", c);
+          *out += hex;
+        } else {
+          *out += c;
+        }
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void pt_trace_enable(int on) { g_enabled.store(on != 0); }
+int pt_trace_enabled() { return g_enabled.load() ? 1 : 0; }
+
+void pt_trace_begin(const char* name, const char* cat) {
+  if (!g_enabled.load(std::memory_order_relaxed)) return;
+  auto* b = LocalBuffer();
+  b->events.push_back({name, cat ? cat : "host", 'B', NowUs(), b->tid, 0.0});
+  b->open_depth++;
+}
+
+void pt_trace_end() {
+  // close only spans whose 'B' is still in this thread's buffer: a span open
+  // across disable must terminate (or the viewer shows it running forever),
+  // but after pt_trace_clear() an 'E' would orphan-match a stranger's span
+  auto* b = LocalBuffer();
+  if (b->open_depth <= 0) return;
+  b->open_depth--;
+  b->events.push_back({"", "host", 'E', NowUs(), b->tid, 0.0});
+}
+
+void pt_trace_instant(const char* name, const char* cat) {
+  if (!g_enabled.load(std::memory_order_relaxed)) return;
+  auto* b = LocalBuffer();
+  b->events.push_back({name, cat ? cat : "host", 'i', NowUs(), b->tid, 0.0});
+}
+
+void pt_trace_counter(const char* name, double value) {
+  if (!g_enabled.load(std::memory_order_relaxed)) return;
+  auto* b = LocalBuffer();
+  b->events.push_back({name, "counter", 'C', NowUs(), b->tid, value});
+}
+
+uint64_t pt_trace_event_count() {
+  std::lock_guard<std::mutex> lk(g_registry_mu);
+  uint64_t n = 0;
+  for (auto* b : g_buffers) n += b->events.size();
+  return n;
+}
+
+void pt_trace_clear() {
+  std::lock_guard<std::mutex> lk(g_registry_mu);
+  for (auto* b : g_buffers) {
+    b->events.clear();
+    b->open_depth = 0;
+  }
+}
+
+// Chrome trace-event JSON (chrometracing_logger.cc parity). Returns 0 on
+// success. Not thread-safe vs concurrent recording of *new* threads, which is
+// fine for the stop-then-export flow the profiler uses.
+int pt_trace_export(const char* path, const char* process_name) {
+  std::FILE* f = std::fopen(path, "w");
+  if (!f) return -1;
+  std::string out = "{\"traceEvents\":[";
+  out += "{\"name\":\"process_name\",\"ph\":\"M\",\"pid\":0,\"tid\":0,"
+         "\"args\":{\"name\":\"";
+  JsonEscape(process_name ? process_name : "paddle_tpu", &out);
+  out += "\"}}";
+  {
+    std::lock_guard<std::mutex> lk(g_registry_mu);
+    for (auto* b : g_buffers) {
+      for (const auto& e : b->events) {
+        out += ",{\"name\":\"";
+        JsonEscape(e.name, &out);
+        out += "\",\"cat\":\"";
+        JsonEscape(e.cat, &out);
+        out += "\",\"ph\":\"";
+        out += e.phase;
+        out += "\",\"pid\":0,\"tid\":" + std::to_string(e.tid) +
+               ",\"ts\":" + std::to_string(e.ts_us);
+        if (e.phase == 'C') {
+          out += ",\"args\":{\"value\":" + std::to_string(e.value) + "}";
+        }
+        out += "}";
+      }
+    }
+  }
+  out += "]}";
+  size_t n = std::fwrite(out.data(), 1, out.size(), f);
+  std::fclose(f);
+  return n == out.size() ? 0 : -1;
+}
+
+}  // extern "C"

@@ -1,0 +1,50 @@
+// C ABI surface of the paddle_tpu native runtime.
+//
+// One shared library, plain `extern "C"` handles + byte buffers, bound from
+// Python via ctypes (the image has no pybind11). Components:
+//   - channel:  bounded blocking queue (csrc/channel.h)
+//   - tracer:   host event recorder + chrome-trace export (csrc/host_tracer.cc)
+//   - stats:    named int64 counters with peaks (csrc/stats.cc)
+//   - arena:    auto-growth best-fit host allocator (csrc/arena.cc)
+//   - store:    TCP key-value rendezvous store (csrc/tcp_store.cc)
+//   - feed:     threaded record-file reader (csrc/data_feed.cc)
+#include <cstdlib>
+#include <cstring>
+#include <vector>
+
+#include "channel.h"
+
+extern "C" {
+
+// ---------------------------------------------------------------- buffers
+// Buffers returned to Python are malloc'd; Python frees them via pt_buffer_free.
+void pt_buffer_free(void* p) { std::free(p); }
+
+// ---------------------------------------------------------------- channel
+void* pt_channel_create(uint64_t capacity) {
+  return new pt::ByteChannel(static_cast<size_t>(capacity));
+}
+
+int pt_channel_put(void* ch, const void* data, uint64_t len) {
+  auto* c = static_cast<pt::ByteChannel*>(ch);
+  std::vector<uint8_t> buf(static_cast<const uint8_t*>(data),
+                           static_cast<const uint8_t*>(data) + len);
+  return c->Put(std::move(buf)) ? 0 : -1;
+}
+
+// Returns length and sets *out (caller frees), or -1 when closed+drained.
+int64_t pt_channel_get(void* ch, void** out) {
+  auto* c = static_cast<pt::ByteChannel*>(ch);
+  std::vector<uint8_t> buf;
+  if (!c->Get(&buf)) return -1;
+  void* p = std::malloc(buf.size() ? buf.size() : 1);
+  std::memcpy(p, buf.data(), buf.size());
+  *out = p;
+  return static_cast<int64_t>(buf.size());
+}
+
+void pt_channel_close(void* ch) { static_cast<pt::ByteChannel*>(ch)->Close(); }
+uint64_t pt_channel_size(void* ch) { return static_cast<pt::ByteChannel*>(ch)->Size(); }
+void pt_channel_destroy(void* ch) { delete static_cast<pt::ByteChannel*>(ch); }
+
+}  // extern "C"

@@ -1,0 +1,79 @@
+// Named int64 runtime statistics with peak tracking.
+//
+// TPU-native counterpart of the reference's stat registries: memory stats
+// (paddle/fluid/memory/stats.h DEVICE_MEMORY_STAT_*) and the runtime monitor
+// (paddle/fluid/platform/monitor.h StatRegistry / STAT_ADD).
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+
+namespace {
+
+struct Stat {
+  int64_t value = 0;
+  int64_t peak = 0;
+};
+
+std::mutex g_mu;
+std::map<std::string, Stat> g_stats;
+
+}  // namespace
+
+extern "C" {
+
+void pt_stat_add(const char* name, int64_t delta) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  auto& s = g_stats[name];
+  s.value += delta;
+  if (s.value > s.peak) s.peak = s.value;
+}
+
+void pt_stat_set(const char* name, int64_t value) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  auto& s = g_stats[name];
+  s.value = value;
+  if (s.value > s.peak) s.peak = s.value;
+}
+
+int64_t pt_stat_get(const char* name) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  auto it = g_stats.find(name);
+  return it == g_stats.end() ? 0 : it->second.value;
+}
+
+int64_t pt_stat_peak(const char* name) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  auto it = g_stats.find(name);
+  return it == g_stats.end() ? 0 : it->second.peak;
+}
+
+void pt_stat_reset(const char* name) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  g_stats.erase(name);
+}
+
+void pt_stat_clear() {
+  std::lock_guard<std::mutex> lk(g_mu);
+  g_stats.clear();
+}
+
+// Writes newline-joined stat names into buf; returns bytes needed (so callers
+// can size-check) regardless of buflen.
+int64_t pt_stat_names(char* buf, int64_t buflen) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  std::string joined;
+  for (const auto& kv : g_stats) {
+    if (!joined.empty()) joined += '\n';
+    joined += kv.first;
+  }
+  if (buf && buflen > 0) {
+    int64_t n = std::min<int64_t>(buflen - 1, joined.size());
+    std::memcpy(buf, joined.data(), n);
+    buf[n] = '\0';
+  }
+  return static_cast<int64_t>(joined.size()) + 1;
+}
+
+}  // extern "C"

@@ -1,0 +1,365 @@
+// TCP key-value store for distributed rendezvous.
+//
+// TPU-native counterpart of the reference's TCPStore
+// (paddle/fluid/distributed/store/tcp_store.h:97, tcp_utils.cc): rank 0 hosts
+// the store; workers set/get/add keys to exchange addresses and barrier before
+// jax.distributed.initialize-style startup. Blocking waits are client-side
+// polls (the reference blocks server-side; polling keeps the server a simple
+// thread-per-connection loop with no wait registry).
+//
+// Wire format (all little-endian):
+//   request:  u8 op | u32 klen | key bytes | payload
+//     op=1 SET: u64 vlen | value bytes        -> reply u8 ok
+//     op=2 GET:                               -> reply u8 found [| u64 vlen | value]
+//     op=3 ADD: i64 delta                     -> reply i64 new_value
+//     op=4 DEL:                               -> reply u8 existed
+//     op=5 NUM:(key ignored)                  -> reply u64 num_keys
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+bool ReadFull(int fd, void* buf, size_t n) {
+  auto* p = static_cast<uint8_t*>(buf);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool WriteFull(int fd, const void* buf, size_t n) {
+  const auto* p = static_cast<const uint8_t*>(buf);
+  while (n > 0) {
+    ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+class StoreServer {
+ public:
+  explicit StoreServer(int port) : port_(port) {}
+
+  bool Start() {
+    listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd_ < 0) return false;
+    int one = 1;
+    ::setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = htons(static_cast<uint16_t>(port_));
+    if (::bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0 ||
+        ::listen(listen_fd_, 128) < 0) {
+      ::close(listen_fd_);
+      return false;
+    }
+    if (port_ == 0) {
+      socklen_t len = sizeof(addr);
+      ::getsockname(listen_fd_, reinterpret_cast<sockaddr*>(&addr), &len);
+      port_ = ntohs(addr.sin_port);
+    }
+    accept_thread_ = std::thread([this] { AcceptLoop(); });
+    return true;
+  }
+
+  void Stop() {
+    stop_.store(true);
+    ::shutdown(listen_fd_, SHUT_RDWR);
+    ::close(listen_fd_);
+    if (accept_thread_.joinable()) accept_thread_.join();
+    std::lock_guard<std::mutex> lk(workers_mu_);
+    // unblock Serve threads stuck in recv() on live client connections
+    for (int fd : client_fds_) ::shutdown(fd, SHUT_RDWR);
+    for (auto& t : workers_)
+      if (t.joinable()) t.join();
+  }
+
+  int port() const { return port_; }
+
+ private:
+  void AcceptLoop() {
+    while (!stop_.load()) {
+      int fd = ::accept(listen_fd_, nullptr, nullptr);
+      if (fd < 0) break;
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      std::lock_guard<std::mutex> lk(workers_mu_);
+      client_fds_.push_back(fd);
+      workers_.emplace_back([this, fd] { Serve(fd); });
+    }
+  }
+
+  void Serve(int fd) {
+    while (!stop_.load()) {
+      uint8_t op;
+      uint32_t klen;
+      if (!ReadFull(fd, &op, 1) || !ReadFull(fd, &klen, 4) || klen > (1u << 20)) break;
+      std::string key(klen, '\0');
+      if (!ReadFull(fd, key.data(), klen)) break;
+      if (op == 1) {  // SET
+        uint64_t vlen;
+        if (!ReadFull(fd, &vlen, 8) || vlen > (1ull << 32)) break;
+        std::vector<uint8_t> val(vlen);
+        if (!ReadFull(fd, val.data(), vlen)) break;
+        {
+          std::lock_guard<std::mutex> lk(mu_);
+          data_[key] = std::move(val);
+        }
+        uint8_t ok = 1;
+        if (!WriteFull(fd, &ok, 1)) break;
+      } else if (op == 2) {  // GET
+        std::vector<uint8_t> val;
+        uint8_t found = 0;
+        {
+          std::lock_guard<std::mutex> lk(mu_);
+          auto it = data_.find(key);
+          if (it != data_.end()) {
+            found = 1;
+            val = it->second;
+          }
+        }
+        if (!WriteFull(fd, &found, 1)) break;
+        if (found) {
+          uint64_t vlen = val.size();
+          if (!WriteFull(fd, &vlen, 8) || !WriteFull(fd, val.data(), vlen)) break;
+        }
+      } else if (op == 3) {  // ADD
+        int64_t delta;
+        if (!ReadFull(fd, &delta, 8)) break;
+        int64_t result;
+        {
+          std::lock_guard<std::mutex> lk(mu_);
+          auto& val = data_[key];
+          int64_t cur = 0;
+          if (val.size() == 8) std::memcpy(&cur, val.data(), 8);
+          cur += delta;
+          val.resize(8);
+          std::memcpy(val.data(), &cur, 8);
+          result = cur;
+        }
+        if (!WriteFull(fd, &result, 8)) break;
+      } else if (op == 4) {  // DEL
+        uint8_t existed;
+        {
+          std::lock_guard<std::mutex> lk(mu_);
+          existed = data_.erase(key) ? 1 : 0;
+        }
+        if (!WriteFull(fd, &existed, 1)) break;
+      } else if (op == 5) {  // NUM
+        uint64_t n;
+        {
+          std::lock_guard<std::mutex> lk(mu_);
+          n = data_.size();
+        }
+        if (!WriteFull(fd, &n, 8)) break;
+      } else {
+        break;
+      }
+    }
+    {
+      // prune before close: the fd number may be recycled by an unrelated
+      // socket, and Stop must not shutdown() a stranger
+      std::lock_guard<std::mutex> lk(workers_mu_);
+      for (auto it = client_fds_.begin(); it != client_fds_.end(); ++it) {
+        if (*it == fd) {
+          client_fds_.erase(it);
+          break;
+        }
+      }
+    }
+    ::close(fd);
+  }
+
+  int port_;
+  int listen_fd_ = -1;
+  std::atomic<bool> stop_{false};
+  std::thread accept_thread_;
+  std::mutex workers_mu_;
+  std::vector<std::thread> workers_;
+  std::vector<int> client_fds_;
+  std::mutex mu_;
+  std::map<std::string, std::vector<uint8_t>> data_;
+};
+
+class StoreClient {
+ public:
+  bool Connect(const std::string& host, int port, int timeout_ms) {
+    auto deadline = std::chrono::steady_clock::now() + std::chrono::milliseconds(timeout_ms);
+    addrinfo hints{};
+    hints.ai_family = AF_UNSPEC;  // hostnames resolve (coordinator is usually
+    hints.ai_socktype = SOCK_STREAM;  // a DNS name on pods, not an IP literal)
+    const std::string port_str = std::to_string(port);
+    do {
+      addrinfo* res = nullptr;
+      if (::getaddrinfo(host.c_str(), port_str.c_str(), &hints, &res) == 0) {
+        for (addrinfo* ai = res; ai; ai = ai->ai_next) {
+          fd_ = ::socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+          if (fd_ < 0) continue;
+          if (::connect(fd_, ai->ai_addr, ai->ai_addrlen) == 0) {
+            int one = 1;
+            ::setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+            ::freeaddrinfo(res);
+            return true;
+          }
+          ::close(fd_);
+          fd_ = -1;
+        }
+        ::freeaddrinfo(res);
+      }
+      std::this_thread::sleep_for(std::chrono::milliseconds(50));
+    } while (std::chrono::steady_clock::now() < deadline);
+    return false;
+  }
+
+  ~StoreClient() {
+    if (fd_ >= 0) ::close(fd_);
+  }
+
+  bool Set(const std::string& key, const void* val, uint64_t vlen) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (!SendHeader(1, key) || !WriteFull(fd_, &vlen, 8) || !WriteFull(fd_, val, vlen))
+      return false;
+    uint8_t ok;
+    return ReadFull(fd_, &ok, 1) && ok == 1;
+  }
+
+  // Returns: 1 found (fills val), 0 not found, -1 error.
+  int Get(const std::string& key, std::vector<uint8_t>* val) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (!SendHeader(2, key)) return -1;
+    uint8_t found;
+    if (!ReadFull(fd_, &found, 1)) return -1;
+    if (!found) return 0;
+    uint64_t vlen;
+    if (!ReadFull(fd_, &vlen, 8) || vlen > (1ull << 32)) return -1;
+    val->resize(vlen);
+    return ReadFull(fd_, val->data(), vlen) ? 1 : -1;
+  }
+
+  bool Add(const std::string& key, int64_t delta, int64_t* result) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (!SendHeader(3, key) || !WriteFull(fd_, &delta, 8)) return false;
+    return ReadFull(fd_, result, 8);
+  }
+
+  bool Del(const std::string& key, bool* existed) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (!SendHeader(4, key)) return false;
+    uint8_t e;
+    if (!ReadFull(fd_, &e, 1)) return false;
+    *existed = e != 0;
+    return true;
+  }
+
+  bool NumKeys(uint64_t* n) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (!SendHeader(5, "")) return false;
+    return ReadFull(fd_, n, 8);
+  }
+
+ private:
+  bool SendHeader(uint8_t op, const std::string& key) {
+    uint32_t klen = static_cast<uint32_t>(key.size());
+    return WriteFull(fd_, &op, 1) && WriteFull(fd_, &klen, 4) &&
+           WriteFull(fd_, key.data(), klen);
+  }
+
+  int fd_ = -1;
+  std::mutex mu_;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* pt_store_server_start(int port) {
+  auto* s = new StoreServer(port);
+  if (!s->Start()) {
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+
+int pt_store_server_port(void* s) { return static_cast<StoreServer*>(s)->port(); }
+
+void pt_store_server_stop(void* s) {
+  auto* srv = static_cast<StoreServer*>(s);
+  srv->Stop();
+  delete srv;
+}
+
+void* pt_store_client_create(const char* host, int port, int timeout_ms) {
+  auto* c = new StoreClient();
+  if (!c->Connect(host, port, timeout_ms)) {
+    delete c;
+    return nullptr;
+  }
+  return c;
+}
+
+void pt_store_client_destroy(void* c) { delete static_cast<StoreClient*>(c); }
+
+int pt_store_set(void* c, const char* key, const void* val, uint64_t vlen) {
+  return static_cast<StoreClient*>(c)->Set(key, val, vlen) ? 0 : -1;
+}
+
+// Polls until the key exists or timeout; returns value length (caller frees
+// *out via pt_buffer_free), -1 on timeout/error.
+int64_t pt_store_get(void* c, const char* key, void** out, int timeout_ms) {
+  auto* cl = static_cast<StoreClient*>(c);
+  auto deadline = std::chrono::steady_clock::now() + std::chrono::milliseconds(timeout_ms);
+  std::vector<uint8_t> val;
+  do {
+    int r = cl->Get(key, &val);
+    if (r < 0) return -1;
+    if (r == 1) {
+      void* p = std::malloc(val.size() ? val.size() : 1);
+      std::memcpy(p, val.data(), val.size());
+      *out = p;
+      return static_cast<int64_t>(val.size());
+    }
+    std::this_thread::sleep_for(std::chrono::milliseconds(10));
+  } while (std::chrono::steady_clock::now() < deadline);
+  return -1;
+}
+
+int64_t pt_store_add(void* c, const char* key, int64_t delta) {
+  int64_t result = 0;
+  if (!static_cast<StoreClient*>(c)->Add(key, delta, &result)) return INT64_MIN;
+  return result;
+}
+
+int pt_store_del(void* c, const char* key) {
+  bool existed = false;
+  if (!static_cast<StoreClient*>(c)->Del(key, &existed)) return -1;
+  return existed ? 1 : 0;
+}
+
+int64_t pt_store_num_keys(void* c) {
+  uint64_t n = 0;
+  if (!static_cast<StoreClient*>(c)->NumKeys(&n)) return -1;
+  return static_cast<int64_t>(n);
+}
+
+}  // extern "C"

@@ -26,7 +26,29 @@ def init_parallel_env(coordinator_address=None, num_processes=None, process_id=N
     nproc = num_processes or int(os.environ.get("PADDLE_TRAINERS_NUM", "0")) or None
     pid = process_id if process_id is not None else int(os.environ.get("PADDLE_TRAINER_ID", "-1"))
     if addr and nproc and nproc > 1:
+        # TCPStore rendezvous before the XLA coordinator comes up (reference
+        # parallel.py:267-333 barriers on the store before comm init): rank 0
+        # hosts the store one port above the coordinator, all ranks barrier so
+        # late workers don't race jax.distributed.initialize.
+        store = None
+        if pid >= 0:  # with an unknown rank nobody can host; skip the store
+            try:
+                from .store import TCPStore
+
+                host, port = addr.rsplit(":", 1)
+                store = TCPStore(host, int(port) + 1, is_master=(pid == 0),
+                                 world_size=nproc, timeout=30.0)
+                store.barrier("init_parallel_env", timeout=30.0)
+            except Exception as e:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "TCPStore rendezvous skipped (%s); relying on the "
+                    "coordinator's own blocking rendezvous", e)
+                store = None
         jax.distributed.initialize(coordinator_address=addr, num_processes=nproc, process_id=pid if pid >= 0 else None)
+        if store is not None:
+            store.close()
     _initialized = True
 
 

@@ -1,0 +1,121 @@
+"""TCPStore: key-value rendezvous for multi-host bootstrap.
+
+Parity: ``paddle.distributed.TCPStore`` (reference
+paddle/fluid/distributed/store/tcp_store.h:97 + pybind). The store itself is
+native C++ (csrc/tcp_store.cc); this wraps it with the reference's Python API
+(set/get/add/wait) plus a ``barrier``. On TPU pods the heavy collectives ride
+XLA over ICI/DCN — the store only exchanges small bootstrap blobs (coordinator
+address, per-host metadata), exactly the role the reference's store plays for
+NCCL comm-id exchange.
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+from ..framework import native
+
+
+class TCPStore:
+    """Client handle to a TCP key-value store; rank 0 also hosts the server.
+
+    Args mirror the reference binding: ``host``, ``port``, ``is_master``
+    (start the in-process server), ``world_size``, ``timeout`` (seconds).
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, is_master: bool = False,
+                 world_size: int = 1, timeout: float = 300.0):
+        self._lib = native.load_native()
+        self._server = None
+        self.world_size = world_size
+        self.timeout_ms = int(timeout * 1000)
+        if is_master:
+            self._server = self._lib.pt_store_server_start(port)
+            if not self._server:
+                raise OSError(f"TCPStore: cannot bind server on port {port}")
+            port = self._lib.pt_store_server_port(self._server)
+        self.host, self.port = host, port
+        self._client = self._lib.pt_store_client_create(host.encode(), port, self.timeout_ms)
+        if not self._client:
+            self._shutdown_server()
+            raise ConnectionError(f"TCPStore: cannot connect to {host}:{port}")
+
+    # ------------------------------------------------------------- basic ops
+    def set(self, key: str, value) -> None:
+        data = value.encode() if isinstance(value, str) else bytes(value)
+        if self._lib.pt_store_set(self._client, key.encode(), data, len(data)) != 0:
+            raise OSError(f"TCPStore.set({key!r}) failed")
+
+    def get(self, key: str, timeout: Optional[float] = None) -> bytes:
+        """Blocks until the key exists (reference Store::get semantics)."""
+        import ctypes
+
+        out = ctypes.c_void_p()
+        tmo = self.timeout_ms if timeout is None else int(timeout * 1000)
+        n = self._lib.pt_store_get(self._client, key.encode(), ctypes.byref(out), tmo)
+        if n < 0:
+            raise TimeoutError(f"TCPStore.get({key!r}) timed out after {tmo} ms")
+        data = ctypes.string_at(out, n)
+        self._lib.pt_buffer_free(out)
+        return data
+
+    def add(self, key: str, amount: int = 1) -> int:
+        r = self._lib.pt_store_add(self._client, key.encode(), amount)
+        if r == -(2**63):
+            raise OSError(f"TCPStore.add({key!r}) failed")
+        return r
+
+    def delete_key(self, key: str) -> bool:
+        r = self._lib.pt_store_del(self._client, key.encode())
+        if r < 0:
+            raise OSError(f"TCPStore.delete_key({key!r}) failed")
+        return r == 1
+
+    def num_keys(self) -> int:
+        n = self._lib.pt_store_num_keys(self._client)
+        if n < 0:
+            raise OSError("TCPStore.num_keys failed")
+        return n
+
+    def wait(self, keys: List[str], timeout: Optional[float] = None) -> None:
+        for k in keys:
+            self.get(k, timeout=timeout)
+
+    # ------------------------------------------------------------ rendezvous
+    def barrier(self, name: str = "default", timeout: Optional[float] = None) -> None:
+        """All ``world_size`` participants block until everyone arrives."""
+        arrived = self.add(f"__barrier__/{name}/count", 1)
+        round_ = (arrived - 1) // self.world_size  # store survives reuse
+        target = (round_ + 1) * self.world_size
+        if arrived == target:
+            self.set(f"__barrier__/{name}/release/{round_}", b"1")
+        self.get(f"__barrier__/{name}/release/{round_}", timeout=timeout)
+
+    def _shutdown_server(self):
+        if self._server:
+            self._lib.pt_store_server_stop(self._server)
+            self._server = None
+
+    def close(self):
+        if getattr(self, "_client", None):
+            self._lib.pt_store_client_destroy(self._client)
+            self._client = None
+        self._shutdown_server()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # pragma: no cover - interpreter teardown
+            pass
+
+
+def rendezvous_store(world_size: int, rank: int,
+                     endpoint: Optional[str] = None) -> TCPStore:
+    """Build the bootstrap store from env, reference parallel.py:267 style.
+
+    Rank 0 hosts; everyone connects. ``endpoint`` or ``PADDLE_MASTER``
+    formatted ``host:port``.
+    """
+    ep = endpoint or os.environ.get("PADDLE_MASTER", "127.0.0.1:34219")
+    host, port = ep.rsplit(":", 1)
+    return TCPStore(host, int(port), is_master=(rank == 0), world_size=world_size)

@@ -16,3 +16,4 @@ from .dataset import (  # noqa: F401
     WeightedRandomSampler,
     random_split,
 )
+from .record_feed import RecordFileLoader, RecordSchema  # noqa: F401

@@ -3,8 +3,9 @@ platform/profiler).
 
 TPU-first: wraps ``jax.profiler`` — device traces come from XLA/xplane
 (the CUPTI analog), host annotations from ``RecordEvent`` →
-``jax.profiler.TraceAnnotation``. Output is a TensorBoard/perfetto trace dir
-(chrome-trace parity: chrometracing_logger.cc).
+``jax.profiler.TraceAnnotation`` AND the native host tracer
+(csrc/host_tracer.cc ≈ platform/profiler/host_tracer.cc), whose events export
+as a chrome trace (chrometracing_logger.cc parity) via ``Profiler.export``.
 """
 from __future__ import annotations
 
@@ -15,6 +16,28 @@ from enum import Enum
 from typing import Optional
 
 import jax
+
+
+_nlib = None  # cached handle; only Profiler.start pays the one-time build
+
+
+def _native(build: bool = False):
+    """The native tracer lib, or None.
+
+    ``build=False`` (the per-RecordEvent path) never compiles and never takes
+    the build lock — it only returns an already-loaded handle, so hot-loop
+    annotations cost one cached check when profiling is off.
+    """
+    global _nlib
+    if _nlib is not None or not build:
+        return _nlib
+    from ..framework import native
+
+    try:
+        _nlib = native.load_native()
+    except RuntimeError:  # pragma: no cover - g++ is baked into the image
+        pass
+    return _nlib
 
 
 class ProfilerTarget(Enum):
@@ -52,11 +75,18 @@ class RecordEvent:
         self.begin_ns = time.perf_counter_ns()
         self._ann = jax.profiler.TraceAnnotation(self.name)
         self._ann.__enter__()
+        lib = _native()
+        if lib is not None and lib.pt_trace_enabled():
+            lib.pt_trace_begin(self.name.encode(), b"host")
+            self._native_open = True
 
     def end(self):
         if self._ann is not None:
             self._ann.__exit__(None, None, None)
             self._ann = None
+        if getattr(self, "_native_open", False):
+            _native().pt_trace_end()
+            self._native_open = False
         self.end_ns = time.perf_counter_ns()
         _HOST_EVENTS[self.name].append((self.begin_ns, self.end_ns))
 
@@ -74,6 +104,10 @@ class Profiler:
         import tempfile
 
         _HOST_EVENTS.clear()  # spans belong to one profiling session
+        lib = _native(build=True)
+        if lib is not None:
+            lib.pt_trace_clear()
+            lib.pt_trace_enable(1)
         if not self.timer_only:
             self.log_dir = tempfile.mkdtemp(prefix="paddle_tpu_prof_")
             jax.profiler.start_trace(self.log_dir)
@@ -84,6 +118,9 @@ class Profiler:
     def stop(self):
         if self._running and not self.timer_only:
             jax.profiler.stop_trace()
+        lib = _native()
+        if lib is not None:
+            lib.pt_trace_enable(0)
         self._running = False
         self._t1 = time.perf_counter()
 
@@ -111,7 +148,25 @@ class Profiler:
         return out
 
     def export(self, path, format="json"):
-        return self.log_dir
+        """Write the host-event chrome trace to ``path`` (device trace stays
+        in ``self.log_dir`` as an xplane for TensorBoard/perfetto)."""
+        lib = _native(build=True)
+        if lib is not None:
+            if lib.pt_trace_export(str(path).encode(), b"paddle_tpu") != 0:
+                raise OSError(f"failed to export trace to {path}")
+            return path
+        # no native toolchain: still honor the contract from python-side spans
+        import json
+
+        events = [{"name": "process_name", "ph": "M", "pid": 0, "tid": 0,
+                   "args": {"name": "paddle_tpu"}}]
+        for name, spans in _HOST_EVENTS.items():
+            for b, e in spans:
+                events.append({"name": name, "cat": "host", "ph": "X", "pid": 0,
+                               "tid": 0, "ts": b / 1000, "dur": (e - b) / 1000})
+        with open(path, "w") as f:
+            json.dump({"traceEvents": events}, f)
+        return path
 
 
 @contextlib.contextmanager

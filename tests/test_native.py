@@ -1,0 +1,203 @@
+"""Native runtime (csrc/) tests: channel, tracer, stats, arena, TCPStore,
+record data feed. Mirrors the reference's C++ unit-test coverage
+(best_fit_allocator_test.cc, tcp_store usage in parallel_env, data_feed)."""
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.framework import native
+
+
+def test_channel_fifo_and_close():
+    ch = native.Channel(4)
+    assert ch.put(b"a") and ch.put(b"bb")
+    assert ch.get() == b"a"
+    assert ch.get() == b"bb"
+    ch.close()
+    assert ch.get() is None
+    assert ch.put(b"x") is False
+
+
+def test_channel_blocking_backpressure():
+    ch = native.Channel(1)
+    got = []
+
+    def consumer():
+        while True:
+            item = ch.get()
+            if item is None:
+                return
+            got.append(item)
+
+    t = threading.Thread(target=consumer)
+    t.start()
+    for i in range(50):
+        assert ch.put(bytes([i]))
+    ch.close()
+    t.join(timeout=10)
+    assert got == [bytes([i]) for i in range(50)]
+
+
+def test_stats_add_peak_names():
+    native.load_native().pt_stat_clear()
+    native.stat_add("mem", 100)
+    native.stat_add("mem", 50)
+    native.stat_add("mem", -120)
+    assert native.stat_get("mem") == 30
+    assert native.stat_peak("mem") == 150
+    native.stat_set("other", 7)
+    assert set(native.stat_names()) >= {"mem", "other"}
+
+
+def test_arena_alloc_free_coalesce():
+    a = native.HostArena(chunk_size=1 << 16)
+    ptrs = [a.alloc(1000) for _ in range(10)]
+    assert a.allocated >= 10 * 1000
+    reserved_before = a.reserved
+    for p in ptrs:
+        a.free(p)
+    assert a.allocated == 0
+    # freed blocks coalesce: a big alloc must fit in the existing chunk
+    big = a.alloc(1 << 15)
+    assert a.reserved == reserved_before
+    a.free(big)
+    with pytest.raises(ValueError):
+        a.free(12345)
+
+
+def test_arena_feeds_stat_registry():
+    base = native.stat_get("host_arena_allocated")
+    a = native.HostArena()
+    p = a.alloc(4096)
+    assert native.stat_get("host_arena_allocated") >= base + 4096
+    a.free(p)
+    assert native.stat_get("host_arena_allocated") == base
+
+
+def test_tracer_chrome_export(tmp_path):
+    lib = native.load_native()
+    lib.pt_trace_clear()
+    lib.pt_trace_enable(1)
+    lib.pt_trace_begin(b"step", b"host")
+    lib.pt_trace_instant(b"mark", b"host")
+    lib.pt_trace_counter(b"loss", 1.25)
+    lib.pt_trace_end()
+    lib.pt_trace_enable(0)
+    path = tmp_path / "trace.json"
+    assert lib.pt_trace_export(str(path).encode(), b"test") == 0
+    doc = json.loads(path.read_text())
+    events = doc["traceEvents"]
+    names = [e.get("name") for e in events]
+    assert "step" in names and "mark" in names and "loss" in names
+    phases = {e["ph"] for e in events}
+    assert {"B", "E", "i", "C"} <= phases
+
+
+def test_profiler_record_event_to_chrome_trace(tmp_path):
+    import paddle_tpu.profiler as profiler
+
+    prof = profiler.Profiler(timer_only=True)
+    prof.start()
+    with profiler.RecordEvent("forward"):
+        time.sleep(0.01)
+    prof.stop()
+    out = prof.export(tmp_path / "host_trace.json")
+    doc = json.loads(open(out).read())
+    assert any(e.get("name") == "forward" for e in doc["traceEvents"])
+
+
+def test_tcp_store_set_get_add_barrier():
+    from paddle_tpu.distributed import TCPStore
+
+    master = TCPStore("127.0.0.1", 0, is_master=True, world_size=2, timeout=20)
+    worker = TCPStore("127.0.0.1", master.port, is_master=False, world_size=2, timeout=20)
+    master.set("addr", b"10.0.0.1:1234")
+    assert worker.get("addr") == b"10.0.0.1:1234"
+    assert worker.add("counter", 3) == 3
+    assert master.add("counter", 2) == 5
+    assert master.num_keys() == 2
+    # blocking get: value set from another thread after a delay
+    def late_set():
+        time.sleep(0.2)
+        master.set("late", b"v")
+
+    t = threading.Thread(target=late_set)
+    t.start()
+    assert worker.get("late", timeout=10) == b"v"
+    t.join()
+    # barrier across two participants in threads
+    errs = []
+
+    def hit_barrier(store):
+        try:
+            store.barrier("b1", timeout=10)
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    ts = [threading.Thread(target=hit_barrier, args=(s,)) for s in (master, worker)]
+    [t.start() for t in ts]
+    [t.join(timeout=15) for t in ts]
+    assert not errs
+    assert worker.delete_key("addr") is True
+    assert worker.delete_key("addr") is False
+    with pytest.raises(TimeoutError):
+        worker.get("missing", timeout=0.2)
+    worker.close()
+    master.close()
+
+
+def test_record_feed_roundtrip(tmp_path):
+    from paddle_tpu.io import RecordFileLoader, RecordSchema
+
+    schema = RecordSchema([("x", "float32", (4,)), ("y", "int32", ())])
+    rng = np.random.default_rng(0)
+    total = 0
+    files = []
+    for shard in range(3):
+        n = 37 + shard
+        cols = {"x": rng.normal(size=(n, 4)).astype(np.float32),
+                "y": np.arange(total, total + n, dtype=np.int32)}
+        path = tmp_path / f"shard{shard}.bin"
+        assert schema.write_records(str(path), cols) == n
+        files.append(str(path))
+        total += n
+
+    loader = RecordFileLoader(files, schema, batch_size=16, num_workers=3, shuffle=False)
+    seen_y = []
+    nbatches = 0
+    for batch in loader:
+        assert batch["x"].shape[1:] == (4,)
+        assert batch["x"].shape[0] == batch["y"].shape[0] <= 16
+        seen_y.extend(batch["y"].tolist())
+        nbatches += 1
+    assert sorted(seen_y) == list(range(total))
+    assert nbatches == -(-total // 16) or nbatches == total // 16 + (1 if total % 16 else 0)
+
+    # second epoch works (feed restarts)
+    again = sum(b["y"].shape[0] for b in loader)
+    assert again == total
+
+    # drop_last drops the ragged tail
+    loader2 = RecordFileLoader(files, schema, batch_size=16, num_workers=2,
+                               shuffle=True, seed=7, drop_last=True)
+    sizes = [b["y"].shape[0] for b in loader2]
+    assert all(s == 16 for s in sizes)
+    assert sum(sizes) == total - total % 16
+
+
+def test_record_feed_shuffle_changes_order(tmp_path):
+    from paddle_tpu.io import RecordFileLoader, RecordSchema
+
+    schema = RecordSchema([("y", "int64", ())])
+    n = 4096
+    path = tmp_path / "data.bin"
+    schema.write_records(str(path), {"y": np.arange(n, dtype=np.int64)})
+    loader = RecordFileLoader([str(path)], schema, batch_size=64, num_workers=1,
+                              shuffle=True, seed=3)
+    ys = np.concatenate([b["y"] for b in loader])
+    assert sorted(ys.tolist()) == list(range(n))
+    assert ys.tolist() != list(range(n))  # actually shuffled
