@@ -87,10 +87,18 @@ class StoreServer {
     ::shutdown(listen_fd_, SHUT_RDWR);
     ::close(listen_fd_);
     if (accept_thread_.joinable()) accept_thread_.join();
-    std::lock_guard<std::mutex> lk(workers_mu_);
-    // unblock Serve threads stuck in recv() on live client connections
-    for (int fd : client_fds_) ::shutdown(fd, SHUT_RDWR);
-    for (auto& t : workers_)
+    // snapshot under the lock, then shutdown+join WITHOUT holding it: the
+    // Serve exit path locks workers_mu_ to prune client_fds_, so joining
+    // while holding the mutex would deadlock
+    std::vector<std::thread> workers;
+    std::vector<int> fds;
+    {
+      std::lock_guard<std::mutex> lk(workers_mu_);
+      fds = client_fds_;
+      workers.swap(workers_);
+    }
+    for (int fd : fds) ::shutdown(fd, SHUT_RDWR);  // unblock recv()
+    for (auto& t : workers)
       if (t.joinable()) t.join();
   }
 

@@ -18,7 +18,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from . import autograd
+from . import autograd, static_trace
 from .autograd import TapeNode, is_grad_enabled, no_grad
 from .dtype import convert_dtype, get_default_dtype, to_jax_dtype
 
@@ -107,6 +107,11 @@ class Tensor:
 
     # -- conversion -------------------------------------------------------
     def numpy(self):
+        if static_trace.is_symbolic(self._value):
+            raise RuntimeError(
+                f"Variable {self.name or self._value.name!r} is symbolic (static "
+                "graph mode): fetch it through Executor.run(fetch_list=[...]) "
+                "instead of reading its value at build time")
         return np.asarray(self._value)
 
     def item(self):
@@ -233,8 +238,11 @@ _amp_hook = None
 
 
 def _is_float_array(v) -> bool:
-    dt = np.dtype(v.dtype) if hasattr(v, "dtype") else None
-    if dt is None:
+    if not hasattr(v, "dtype"):
+        return False
+    try:
+        dt = np.dtype(v.dtype)
+    except TypeError:  # extended dtypes (PRNG key arrays) are never float
         return False
     return dt.kind == "f" or v.dtype == jnp.bfloat16
 
@@ -247,6 +255,12 @@ def primitive(fn: Callable, *args, _name: str = "", **kwargs):
     differentiated through via ``jax.vjp``; everything else is closed over.
     Returns Tensor or tuple of Tensors mirroring fn's output.
     """
+    if static_trace.current_program() is not None:
+        # static-graph capture (program_guard/enable_static): record the call
+        # instead of executing — shapes via jax.eval_shape, execution deferred
+        # to Executor.run where the whole program compiles as one jit
+        return static_trace.record_op(fn, args, kwargs, _name)
+
     vals = [unwrap(a) for a in args]
     if _amp_hook is not None:
         vals = _amp_hook(_name, vals)

@@ -55,6 +55,31 @@ def split_key():
     return jax.random.fold_in(_STATE.root_key, _STATE.counter)
 
 
+def key_tensor():
+    """A fresh PRNG key as a Tensor, usable as a positional primitive input.
+
+    Eager / rng_scope: wraps :func:`split_key`'s concrete key. Static capture:
+    records a key-derivation op fed by the reserved ``__rng_key__`` scalar the
+    Executor bumps every run — so dropout masks differ across runs, matching
+    the reference's stateful curand semantics without host state in the graph.
+    """
+    from .core import _wrap_value
+    from .static_trace import current_program, record_op
+
+    prog = current_program()
+    if prog is None:
+        return _wrap_value(split_key())
+    base = prog.feeds.get("__rng_key__")
+    if base is None:
+        base = prog.add_feed("__rng_key__", (), jax.numpy.uint32)
+    salt = prog.version  # one distinct stream per recorded key op
+
+    def derive(seed):
+        return jax.random.fold_in(jax.random.key(seed), salt)
+
+    return record_op(derive, [_wrap_value(base)], {}, "rng_key")
+
+
 @contextlib.contextmanager
 def rng_scope(key):
     """Install ``key`` as the RNG source for code executed in this scope.

@@ -1,0 +1,238 @@
+"""Static-graph capture: Program IR recorded at the ``primitive`` chokepoint.
+
+Paddle parity: the Program IR + static frontend (reference
+paddle/fluid/framework/framework.proto:236 ProgramDesc,
+python/paddle/fluid/framework.py:4795 Program / :1222 Variable / :2549
+Operator). TPU-first design: there is no protobuf op schema — every tensor op
+already funnels through :func:`paddle_tpu.framework.core.primitive` with a
+pure jax function, so a "Program" is the recorded list of those calls, shape
+inference is ``jax.eval_shape`` (the InferMeta analog), and execution compiles
+the whole op list into ONE XLA computation via ``jax.jit`` (the
+InterpreterCore/new_executor analog — scheduling, fusion, GC and stream
+management all belong to XLA).
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+
+class SymbolicValue:
+    """Shape/dtype-only placeholder flowing through a Program (VarDesc analog)."""
+
+    __slots__ = ("shape", "dtype", "name")
+
+    def __init__(self, shape, dtype, name):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.name = name
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    @property
+    def size(self):
+        return int(np.prod(self.shape)) if self.shape else 1
+
+    def __repr__(self):
+        return f"SymbolicValue(name={self.name!r}, shape={self.shape}, dtype={self.dtype})"
+
+
+def is_symbolic(v) -> bool:
+    return isinstance(v, SymbolicValue)
+
+
+def guard_inplace(op_name: str, *tensors) -> None:
+    """Raise a clear error for in-place mutation of symbolic values — static
+    programs are pure dataflow; in-place ops have no recordable meaning."""
+    if current_program() is None:
+        return
+    for t in tensors:
+        if t is not None and is_symbolic(getattr(t, "_value", None)):
+            raise RuntimeError(
+                f"{op_name} mutates a symbolic Variable in static-graph mode; "
+                "use the out-of-place form (e.g. y = x + 1) instead")
+
+
+# stand-in extent for -1/None dims during build-time shape inference
+_DYN_PLACEHOLDER = 4
+
+
+class Op:
+    """One recorded primitive call (OpDesc analog: fn + attrs + var refs)."""
+
+    __slots__ = ("fn", "kwargs", "inputs", "outputs", "name")
+
+    def __init__(self, fn, kwargs, inputs, outputs, name):
+        self.fn = fn            # pure jax function of positional arrays
+        self.kwargs = kwargs    # static attributes
+        self.inputs = inputs    # list of ('sym', SymbolicValue)|('tensor', Tensor)|('const', value)
+        self.outputs = outputs  # list of SymbolicValue
+        self.name = name
+
+
+class Program:
+    """Recorded op list + feed registry (ProgramDesc analog, single block —
+    control flow is jax.lax inside an op's fn, not nested blocks)."""
+
+    _ids = itertools.count()
+
+    def __init__(self):
+        self.id = next(Program._ids)
+        self.ops: List[Op] = []
+        self.feeds: Dict[str, SymbolicValue] = {}
+        self._name_counter = itertools.count()
+        # set by Optimizer.minimize in static mode:
+        self.optimizer = None
+        self.loss_var: Optional[SymbolicValue] = None
+        self.grad_vars: Dict[str, SymbolicValue] = {}  # param name -> grad var
+        # deferred stateful-buffer updates (BatchNorm running stats): the
+        # Executor commits env[sym.name] into the Tensor after each run
+        self.buffer_writes: List[Tuple[Any, SymbolicValue]] = []
+        self.random_seed = 0
+
+    # ---------------------------------------------------------------- build
+    def fresh_name(self, hint: str) -> str:
+        return f"{hint}_{self.id}_{next(self._name_counter)}"
+
+    def add_feed(self, name: str, shape, dtype) -> SymbolicValue:
+        if name in self.feeds:
+            raise ValueError(f"duplicate feed name {name!r}")
+        sv = SymbolicValue(shape, dtype, name)
+        self.feeds[name] = sv
+        return sv
+
+    @property
+    def version(self) -> int:
+        return len(self.ops)
+
+    def global_block(self):  # reference Program.global_block() parity
+        return self
+
+    def all_parameters(self):
+        """Trainable concrete Tensors referenced by recorded ops."""
+        seen, out = set(), []
+        for op in self.ops:
+            for kind, ref in op.inputs:
+                if kind == "tensor" and not ref.stop_gradient and id(ref) not in seen:
+                    seen.add(id(ref))
+                    out.append(ref)
+        return out
+
+    def tensor_refs(self):
+        """All concrete Tensors referenced (params + buffers + constants),
+        in first-use order."""
+        seen, out = set(), []
+        for op in self.ops:
+            for kind, ref in op.inputs:
+                if kind == "tensor" and id(ref) not in seen:
+                    seen.add(id(ref))
+                    out.append(ref)
+        return out
+
+    # ------------------------------------------------------------ interpret
+    def interpret(self, env: Dict[str, Any], tensor_vals: Dict[int, Any]) -> Dict[str, Any]:
+        """Evaluate the op list. ``env``: symbolic name -> array (feeds);
+        ``tensor_vals``: id(Tensor) -> array for referenced concrete tensors.
+        Mutates and returns ``env`` including all op outputs."""
+        for op in self.ops:
+            vals = []
+            for kind, ref in op.inputs:
+                if kind == "sym":
+                    if ref.name not in env:
+                        raise KeyError(
+                            f"op {op.name!r} reads {ref.name!r} which is neither "
+                            f"a feed of this run nor produced by an earlier op")
+                    vals.append(env[ref.name])
+                elif kind == "tensor":
+                    vals.append(tensor_vals[id(ref)])
+                else:
+                    vals.append(ref)
+            out = op.fn(*vals, **op.kwargs)
+            outs = out if isinstance(out, (tuple, list)) else (out,)
+            for sv, v in zip(op.outputs, outs):
+                env[sv.name] = v
+        return env
+
+    def __repr__(self):
+        lines = [f"Program(id={self.id}, feeds={list(self.feeds)}, ops={len(self.ops)})"]
+        for op in self.ops:
+            ins = ", ".join(
+                ref.name if kind == "sym" else (getattr(ref, "name", "") or f"tensor@{id(ref):x}") if kind == "tensor" else repr(ref)
+                for kind, ref in op.inputs)
+            outs = ", ".join(o.name for o in op.outputs)
+            lines.append(f"  {outs} = {op.name}({ins})")
+        return "\n".join(lines)
+
+
+class _TraceState(threading.local):
+    stack: List[Program]
+
+    def __init__(self):
+        self.stack = []
+
+
+_STATE = _TraceState()
+
+
+def current_program() -> Optional[Program]:
+    return _STATE.stack[-1] if _STATE.stack else None
+
+
+def push_program(p: Program) -> None:
+    _STATE.stack.append(p)
+
+
+def pop_program() -> Program:
+    return _STATE.stack.pop()
+
+
+def record_op(fn: Callable, args: Sequence[Any], kwargs: Dict[str, Any], name: str):
+    """Record one primitive call into the current program; returns Variables
+    (Tensors wrapping SymbolicValue) mirroring fn's output structure."""
+    from .core import Tensor, _wrap_value
+
+    prog = current_program()
+    assert prog is not None
+
+    # dynamic dims (-1 / None in static.data) get a placeholder extent for
+    # shape inference only; Executor.run re-traces with the fed shapes, so a
+    # new batch size is just a fresh jit specialization (XLA is static-shape)
+    def _spec_shape(shape):
+        return tuple(_DYN_PLACEHOLDER if d < 0 else d for d in shape)
+
+    inputs: List[Tuple[str, Any]] = []
+    specs = []
+    any_diff = False
+    for a in args:
+        if isinstance(a, Tensor):
+            v = a._value
+            if is_symbolic(v):
+                inputs.append(("sym", v))
+                specs.append(jax.ShapeDtypeStruct(_spec_shape(v.shape), v.dtype))
+            else:
+                inputs.append(("tensor", a))
+                specs.append(jax.ShapeDtypeStruct(v.shape, v.dtype))
+            if not a.stop_gradient:
+                any_diff = True
+        elif is_symbolic(a):
+            inputs.append(("sym", a))
+            specs.append(jax.ShapeDtypeStruct(_spec_shape(a.shape), a.dtype))
+        else:
+            inputs.append(("const", a))
+            specs.append(a)
+
+    out_spec = jax.eval_shape(lambda *xs: fn(*xs, **kwargs), *specs)
+    multi = isinstance(out_spec, (tuple, list))
+    out_specs = tuple(out_spec) if multi else (out_spec,)
+    outputs = [SymbolicValue(s.shape, s.dtype, prog.fresh_name(name or "op"))
+               for s in out_specs]
+    prog.ops.append(Op(fn, dict(kwargs), inputs, outputs, name or getattr(fn, "__name__", "op")))
+
+    wrapped = tuple(_wrap_value(sv, stop_gradient=not any_diff) for sv in outputs)
+    return wrapped if multi else wrapped[0]

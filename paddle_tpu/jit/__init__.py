@@ -79,9 +79,6 @@ class TrainStep:
 
     def _build(self, remat):
         model, optimizer, loss_fn = self.model, self.optimizer, self.loss_fn
-        core = optimizer.core
-        clip = optimizer._grad_clip
-        wd = optimizer._weight_decay
 
         def loss_of(params, buffers, inputs, labels, rng):
             def call(p):
@@ -102,12 +99,8 @@ class TrainStep:
             (loss, (out, new_buffers)), grads = jax.value_and_grad(loss_of, has_aux=True)(
                 state["params"], state["buffers"], inputs, labels, rng
             )
-            if wd:
-                grads = jax.tree_util.tree_map(lambda g, p: g + wd * p, grads, state["params"])
-            if clip is not None:
-                grads = clip.apply_tree(grads)
-            lr = optimizer.lr_at(state["step"])
-            new_params, new_opt = core.update(grads, state["opt"], state["params"], lr, state["step"])
+            new_params, new_opt, lr = optimizer._traced_update(
+                grads, state["opt"], state["params"], state["step"])
             new_state = {
                 "params": new_params,
                 "buffers": new_buffers,

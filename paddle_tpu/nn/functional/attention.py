@@ -11,6 +11,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from ...framework import random as _random
 from ...framework.flags import flag
 from ...tensor._helpers import ensure_tensor, op, unwrap
 
@@ -22,23 +23,27 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
     FLAGS_use_flash_attention is set and shapes are tile-friendly.
     """
     q, k, v = ensure_tensor(query), ensure_tensor(key), ensure_tensor(value)
-    mask_val = unwrap(attn_mask) if attn_mask is not None else None
 
-    use_flash = flag("FLAGS_use_flash_attention") and dropout_p == 0.0 and mask_val is None
+    use_flash = flag("FLAGS_use_flash_attention") and dropout_p == 0.0 and attn_mask is None
     if use_flash:
         from ...ops.flash_attention import flash_attention_available, flash_attention
 
         if flash_attention_available(tuple(q.shape), tuple(k.shape)):
             return op(lambda qq, kk, vv: flash_attention(qq, kk, vv, causal=is_causal), q, k, v, _name="flash_attention")
 
-    from ...framework import random as _random
+    dropping = dropout_p > 0.0 and training
+    aux = [ensure_tensor(attn_mask)] if attn_mask is not None else []
+    if dropping:
+        aux.append(_random.key_tensor())
+    has_mask = attn_mask is not None
 
-    drop_key = _random.split_key() if (dropout_p > 0.0 and training) else None
+    def fn(qq, kk, vv, *extra):
+        mask = extra[0] if has_mask else None
+        drop_key = extra[-1] if dropping else None
+        return _sdpa_reference(qq, kk, vv, mask, is_causal,
+                               dropout_p if training else 0.0, drop_key)
 
-    def fn(qq, kk, vv):
-        return _sdpa_reference(qq, kk, vv, mask_val, is_causal, dropout_p if training else 0.0, drop_key)
-
-    return op(fn, q, k, v, _name="sdpa")
+    return op(fn, q, k, v, *aux, _name="sdpa")
 
 
 def _sdpa_reference(q, k, v, mask=None, causal=False, dropout_p=0.0, drop_key=None):

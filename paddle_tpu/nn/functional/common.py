@@ -21,19 +21,17 @@ def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=No
     x = ensure_tensor(x)
     if not training or p == 0.0:
         return x if mode == "upscale_in_train" else op(lambda v: v * (1.0 - p), x, _name="dropout_eval")
-    key = _random.split_key()
-    shape = tuple(x.shape)
-    if axis is not None:
-        axes = axis if isinstance(axis, (list, tuple)) else [axis]
-        shape = tuple(s if i in axes else 1 for i, s in enumerate(shape))
+    axes = None if axis is None else (axis if isinstance(axis, (list, tuple)) else [axis])
 
-    def fn(v):
+    def fn(v, key):
+        shape = tuple(v.shape) if axes is None else tuple(
+            s if i in axes else 1 for i, s in enumerate(v.shape))
         keep = jax.random.bernoulli(key, 1.0 - p, shape)
         if mode == "upscale_in_train":
             return jnp.where(keep, v / (1.0 - p), 0.0).astype(v.dtype)
         return jnp.where(keep, v, 0.0).astype(v.dtype)
 
-    return op(fn, x, _name="dropout")
+    return op(fn, x, _random.key_tensor(), _name="dropout")
 
 
 def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
@@ -53,43 +51,42 @@ def alpha_dropout(x, p=0.5, training=True, name=None):
     alpha = 1.6732632423543772
     scale = 1.0507009873554805
     alpha_p = -alpha * scale
-    key = _random.split_key()
 
-    def fn(v):
+    def fn(v, key):
         keep = jax.random.bernoulli(key, 1.0 - p, tuple(v.shape))
         a = (1.0 / (scale * ((1.0 - p) * (1.0 + p * alpha_p**2)) ** 0.5))
         b = -a * alpha_p * p
         return a * jnp.where(keep, v, alpha_p) + b
 
-    return op(fn, x, _name="alpha_dropout")
+    return op(fn, x, _random.key_tensor(), _name="alpha_dropout")
 
 
 def embedding(x, weight, padding_idx=None, sparse=False, name=None):
-    idx = unwrap(ensure_tensor(x))
-
-    def fn(w):
+    def fn(w, idx):
         out = jnp.take(w, idx, axis=0)
         if padding_idx is not None:
             mask = (idx == padding_idx)[..., None]
             out = jnp.where(mask, 0.0, out)
         return out
 
-    return op(fn, ensure_tensor(weight), _name="embedding")
+    return op(fn, ensure_tensor(weight), ensure_tensor(x), _name="embedding")
 
 
 def one_hot(x, num_classes, name=None):
-    idx = unwrap(ensure_tensor(x))
-    return _wrap_value(jax.nn.one_hot(idx, num_classes, dtype=to_jax_dtype("float32")))
+    return op(lambda idx: jax.nn.one_hot(idx, num_classes, dtype=to_jax_dtype("float32")),
+              ensure_tensor(x), _name="one_hot")
 
 
 def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
-    def fn(v):
+    aux = [ensure_tensor(prior_dist)] if prior_dist is not None else []
+
+    def fn(v, *pd):
         k = v.shape[-1]
-        if prior_dist is not None:
-            return (1.0 - epsilon) * v + epsilon * unwrap(prior_dist)
+        if pd:
+            return (1.0 - epsilon) * v + epsilon * pd[0]
         return (1.0 - epsilon) * v + epsilon / k
 
-    return op(fn, ensure_tensor(label), _name="label_smooth")
+    return op(fn, ensure_tensor(label), *aux, _name="label_smooth")
 
 
 def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):

@@ -16,10 +16,10 @@ def _reduce(v, reduction):
 
 
 def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean", soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0, name=None):
-    lbl = unwrap(ensure_tensor(label))
-    w = unwrap(ensure_tensor(weight)) if weight is not None else None
+    aux = [ensure_tensor(weight)] if weight is not None else []
 
-    def fn(logits):
+    def fn(logits, lbl, *ws):
+        w = ws[0] if ws else None
         logp = jax.nn.log_softmax(logits, axis=axis) if use_softmax else jnp.log(jnp.maximum(logits, 1e-30))
         if soft_label:
             loss = -jnp.sum(lbl * logp, axis=axis)
@@ -46,7 +46,7 @@ def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean"
                 return jnp.sum(loss) / denom
         return _reduce(loss, reduction)
 
-    return op(fn, ensure_tensor(input), _name="cross_entropy")
+    return op(fn, ensure_tensor(input), ensure_tensor(label), *aux, _name="cross_entropy")
 
 
 def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100, axis=-1, return_softmax=False):
@@ -77,10 +77,11 @@ def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
 
 
 def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):
-    lbl = unwrap(ensure_tensor(label)).astype(jnp.int32)
-    w = unwrap(ensure_tensor(weight)) if weight is not None else None
+    aux = [ensure_tensor(weight)] if weight is not None else []
 
-    def fn(logp):
+    def fn(logp, lbl, *ws):
+        lbl = lbl.astype(jnp.int32)
+        w = ws[0] if ws else None
         # class axis is 1 for ndim>=2 ([N,C] or [N,C,d1,...]); gather the
         # label's log-prob along it.
         loss = -jnp.take_along_axis(logp, jnp.expand_dims(lbl, 1), axis=1).squeeze(1)
@@ -93,32 +94,37 @@ def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", nam
             return jnp.sum(loss) / denom
         return _reduce(loss, reduction)
 
-    return op(fn, ensure_tensor(input), _name="nll_loss")
+    return op(fn, ensure_tensor(input), ensure_tensor(label), *aux, _name="nll_loss")
 
 
 def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
-    def fn(p, y):
+    aux = [ensure_tensor(weight)] if weight is not None else []
+
+    def fn(p, y, *ws):
         p2 = jnp.clip(p, 1e-12, 1.0 - 1e-7)
         loss = -(y * jnp.log(p2) + (1.0 - y) * jnp.log(1.0 - p2))
-        if weight is not None:
-            loss = loss * unwrap(weight)
+        if ws:
+            loss = loss * ws[0]
         return _reduce(loss, reduction)
 
-    return op(fn, ensure_tensor(input), ensure_tensor(label), _name="bce")
+    return op(fn, ensure_tensor(input), ensure_tensor(label), *aux, _name="bce")
 
 
 def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean", pos_weight=None, name=None):
-    def fn(z, y):
-        if pos_weight is not None:
-            pw = unwrap(pos_weight)
+    aux = [ensure_tensor(m) for m in (pos_weight, weight) if m is not None]
+    has_pw, has_w = pos_weight is not None, weight is not None
+
+    def fn(z, y, *extra):
+        if has_pw:
+            pw = extra[0]
             loss = (1 - y) * z + (1 + (pw - 1) * y) * (jnp.logaddexp(0.0, -jnp.abs(z)) + jnp.maximum(-z, 0))
         else:
             loss = jnp.maximum(z, 0) - z * y + jnp.logaddexp(0.0, -jnp.abs(z))
-        if weight is not None:
-            loss = loss * unwrap(weight)
+        if has_w:
+            loss = loss * extra[-1]
         return _reduce(loss, reduction)
 
-    return op(fn, ensure_tensor(logit), ensure_tensor(label), _name="bce_with_logits")
+    return op(fn, ensure_tensor(logit), ensure_tensor(label), *aux, _name="bce_with_logits")
 
 
 def kl_div(input, label, reduction="mean", name=None):

@@ -12,6 +12,22 @@ import jax.numpy as jnp
 from ...tensor._helpers import Tensor, ensure_tensor, op, unwrap
 
 
+def _assign_buffer(buf: Tensor, new: Tensor) -> None:
+    """Write an op result into a stateful buffer (running stats).
+
+    Eager: in-place value swap. Static capture: register a deferred write —
+    the program's ops keep reading the pre-run value (reference static-BN
+    dataflow) and the Executor commits the new value after the run.
+    """
+    from ...framework.static_trace import current_program, is_symbolic
+
+    prog = current_program()
+    if prog is not None and is_symbolic(new._value):
+        prog.buffer_writes.append((buf, new._value))
+    else:
+        buf._value = new._value
+
+
 def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=None):
     ns = normalized_shape if isinstance(normalized_shape, (list, tuple)) else [normalized_shape]
     axes = tuple(range(-len(ns), 0))
@@ -51,12 +67,24 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None, training=Fa
             return m, var
 
         m_t, var_t = op(stats_fn, x, _name="bn_stats")
-        # running-stat update is a side effect on buffer tensors (paddle parity)
-        rm._value = momentum * rm._value + (1 - momentum) * m_t._value
-        rv._value = momentum * rv._value + (1 - momentum) * var_t._value
+        # running-stat update is a side effect on buffer tensors (paddle
+        # parity). Routed through op() so static capture records it; the
+        # Executor writes the result back to the buffer after each run.
+        def ema(old, new):
+            return momentum * old + (1 - momentum) * new
+
+        # pass the buffer Tensor itself (not a detached copy) so a recorded
+        # program re-reads the CURRENT buffer value on every run
+        ro_rm = rm if rm.stop_gradient else rm.detach()
+        ro_rv = rv if rv.stop_gradient else rv.detach()
+        new_rm = op(ema, ro_rm, m_t.detach(), _name="bn_update_mean")
+        new_rv = op(ema, ro_rv, var_t.detach(), _name="bn_update_var")
+        _assign_buffer(rm, new_rm)
+        _assign_buffer(rv, new_rv)
         mean_in, var_in = m_t, var_t
     else:
-        mean_in, var_in = rm.detach(), rv.detach()
+        mean_in = rm if rm.stop_gradient else rm.detach()
+        var_in = rv if rv.stop_gradient else rv.detach()
 
     def fn(v, m, var, *rest):
         shape = [1] * v.ndim

@@ -81,6 +81,18 @@ class Optimizer:
     def _pre_update(self, params, ptree):
         """Subclass hook run after grad filtering, before the core update."""
 
+    def _traced_update(self, gtree, opt_state, ptree, step):
+        """Grad → new-param transform shared by every compiled path (jit
+        TrainStep, static Executor): weight decay, clip, lr schedule, core
+        update. One definition so the training semantics cannot diverge."""
+        if self._weight_decay:
+            gtree = jax.tree_util.tree_map(lambda g, p: g + self._weight_decay * p, gtree, ptree)
+        if self._grad_clip is not None:
+            gtree = self._grad_clip.apply_tree(gtree)
+        lr = self.lr_at(step)
+        new_params, new_opt = self.core.update(gtree, opt_state, ptree, lr, step)
+        return new_params, new_opt, lr
+
     def _apply(self, gtree, ptree):
         lr = self.get_lr()
         state_sub = {k: {i: v[i] for i in ptree} for k, v in self._state.items()} if self._state else {}
@@ -96,6 +108,20 @@ class Optimizer:
     clear_gradients = clear_grad
 
     def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        from ..framework.static_trace import current_program, is_symbolic
+
+        if isinstance(loss, Tensor) and is_symbolic(loss._value):
+            # static mode (reference optimizer.py:1165 emits backward + update
+            # ops into the program; here Executor.run fuses them into the jit)
+            from ..static import append_backward, default_main_program
+
+            prog = current_program() or default_main_program()
+            params = parameters or self._params or None
+            params_grads = append_backward(loss, parameter_list=params)
+            if not self._params:
+                self._params = [p for p, _ in params_grads]
+            prog.optimizer = self
+            return None, params_grads
         loss.backward()
         self.step()
         return None, None

@@ -1,0 +1,396 @@
+"""paddle_tpu.static — static-graph frontend.
+
+Paddle parity: ``paddle.static`` (reference python/paddle/static/__init__.py;
+Program python/paddle/fluid/framework.py:4795; Executor
+python/paddle/fluid/executor.py:1108; append_backward
+python/paddle/fluid/backward.py:1555; save/load_inference_model
+python/paddle/fluid/io.py). TPU-first: the Program records primitive calls
+(framework/static_trace.py), ``Executor.run`` compiles the whole program —
+forward, backward (jax.value_and_grad ≈ append_backward's grad-op emission)
+and optimizer update — into ONE jitted XLA computation, which is the
+new_executor/InterpreterCore and ParallelExecutor path collapsed into the XLA
+scheduler. ``save_inference_model`` serializes StableHLO via jax.export
+instead of a ProgramDesc protobuf.
+"""
+from __future__ import annotations
+
+import contextlib
+import pickle
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, _wrap_value, unwrap
+from ..framework.dtype import to_jax_dtype
+from ..framework.static_trace import (
+    Program,
+    SymbolicValue,
+    current_program,
+    is_symbolic,
+    pop_program,
+    push_program,
+)
+
+__all__ = [
+    "Program", "program_guard", "default_main_program", "default_startup_program",
+    "data", "Executor", "append_backward", "CompiledProgram", "InputSpec",
+    "save_inference_model", "load_inference_model", "enable_static",
+    "disable_static", "in_dynamic_mode", "gradients", "name_scope", "py_func",
+]
+
+_default_main = Program()
+_default_startup = Program()
+_static_enabled = False
+
+
+def default_main_program() -> Program:
+    return _default_main
+
+
+def default_startup_program() -> Program:
+    return _default_startup
+
+
+def enable_static() -> None:
+    """paddle.enable_static parity: subsequent ops record into the default
+    main program instead of executing eagerly."""
+    global _static_enabled
+    if not _static_enabled:
+        push_program(_default_main)
+        _static_enabled = True
+
+
+def disable_static() -> None:
+    global _static_enabled
+    if _static_enabled:
+        pop_program()
+        _static_enabled = False
+
+
+def in_dynamic_mode() -> bool:
+    return current_program() is None
+
+
+@contextlib.contextmanager
+def program_guard(main_program: Program, startup_program: Optional[Program] = None):
+    """Route op recording into ``main_program`` (reference
+    fluid.program_guard). ``startup_program`` is accepted for parity; params
+    initialize eagerly on creation, so startup is an empty program."""
+    push_program(main_program)
+    try:
+        yield
+    finally:
+        pop_program()
+
+
+@contextlib.contextmanager
+def name_scope(prefix: str):  # cosmetic parity; names are per-program unique
+    yield
+
+
+def data(name: str, shape: Sequence[int], dtype: str = "float32", lod_level: int = 0) -> Tensor:
+    """Feed placeholder (reference paddle.static.data). ``shape`` may use
+    None/-1 for the batch dim; it is resolved at the first Executor.run from
+    the fed array (static shapes are an XLA requirement — a new batch shape
+    triggers a fresh compile, matching jit semantics)."""
+    prog = current_program()
+    if prog is None:
+        raise RuntimeError("static.data requires enable_static() or a program_guard")
+    shape = tuple(-1 if s is None else int(s) for s in shape)
+    sv = prog.add_feed(name, shape, to_jax_dtype(dtype))
+    t = _wrap_value(sv, stop_gradient=True)
+    t.name = name
+    return t
+
+
+def append_backward(loss: Tensor, parameter_list=None, no_grad_set=None):
+    """Register grad computation for ``loss``; returns [(param, grad_var)].
+
+    Reference fluid/backward.py:1555 walks ops in reverse emitting grad ops;
+    here the backward graph comes from jax.value_and_grad at run time over the
+    recorded forward, so this only names the grad outputs."""
+    prog = current_program() or _default_main
+    if not (isinstance(loss, Tensor) and is_symbolic(loss._value)):
+        raise TypeError("append_backward expects a symbolic loss Variable from this program")
+    prog.loss_var = loss._value
+    params = list(parameter_list) if parameter_list else prog.all_parameters()
+    out = []
+    for i, p in enumerate(params):
+        if id(p) not in {id(x) for x in prog.tensor_refs()}:
+            continue
+        gname = f"{p.name or f'param_{i}'}@GRAD"
+        sv = SymbolicValue(tuple(p._value.shape), p._value.dtype, gname)
+        prog.grad_vars[id(p)] = sv
+        gv = _wrap_value(sv, stop_gradient=True)
+        gv.name = gname
+        out.append((p, gv))
+    return out
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """paddle.static.gradients parity (single target)."""
+    t = targets[0] if isinstance(targets, (list, tuple)) else targets
+    pg = append_backward(t, parameter_list=list(inputs) if isinstance(inputs, (list, tuple)) else [inputs])
+    return [g for _, g in pg]
+
+
+class CompiledProgram:
+    """Parity shim (reference compiler.py CompiledProgram / ParallelExecutor):
+    jit compilation happens in Executor.run regardless; this only carries the
+    program through the same API shape."""
+
+    def __init__(self, program: Program, build_strategy=None):
+        self._program = program
+
+
+class InputSpec:
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = tuple(-1 if s is None else int(s) for s in shape)
+        self.dtype = to_jax_dtype(dtype)
+        self.name = name
+
+
+class Executor:
+    """Compiles and runs Programs (reference executor.py:1108 Executor.run →
+    here: one jax.jit per (program version, feed/fetch signature) cached like
+    _ExecutorCache; parameter/optimizer state round-trips through the concrete
+    Tensors so eager code observes static updates and vice versa)."""
+
+    def __init__(self, place=None):
+        self.place = place
+        self._cache: Dict[tuple, Any] = {}
+        self._opt_states: Dict[int, Any] = {}
+
+    def run(self, program: Optional[Program] = None, feed: Optional[Dict[str, Any]] = None,
+            fetch_list: Optional[List] = None, return_numpy: bool = True):
+        prog = program if program is not None else _default_main
+        if isinstance(prog, CompiledProgram):
+            prog = prog._program
+        feed = feed or {}
+        fetch_list = fetch_list or []
+        fetch_names = []
+        passthrough: Dict[int, Tensor] = {}
+        for i, f in enumerate(fetch_list):
+            if isinstance(f, Tensor) and is_symbolic(f._value):
+                fetch_names.append(f._value.name)
+            elif isinstance(f, str):
+                fetch_names.append(f)
+            elif isinstance(f, Tensor):
+                passthrough[i] = f  # concrete (e.g. a parameter): return as-is
+                fetch_names.append(None)
+            else:
+                raise TypeError(f"fetch item {f!r} is not a Variable or name")
+
+        if not prog.ops:  # startup-program case: params already initialized
+            symbolic_fetches = [n for n in fetch_names if n is not None]
+            if symbolic_fetches:
+                raise ValueError(
+                    f"cannot fetch {symbolic_fetches} from a program with no ops "
+                    "(did you mean to run the main program?)")
+            return [np.asarray(passthrough[i]._value) for i in range(len(fetch_list))]
+
+        feed_arrays = {k: jnp.asarray(unwrap(v)) for k, v in feed.items()}
+        if "__rng_key__" in prog.feeds:  # per-run dropout/rng seed (never user-fed)
+            self._run_counter = getattr(self, "_run_counter", 0) + 1
+            feed_arrays["__rng_key__"] = jnp.uint32(self._run_counter)
+        missing = set(prog.feeds) - set(feed_arrays)
+        used_feeds = {n for op in prog.ops for kind, ref in op.inputs
+                      if kind == "sym" for n in [ref.name] if n in prog.feeds}
+        if missing & used_feeds:
+            raise ValueError(f"missing feeds: {sorted(missing & used_feeds)}")
+
+        train = prog.optimizer is not None or bool(prog.grad_vars)
+        refs = prog.tensor_refs()
+        params = [t for t in refs if not t.stop_gradient] if train else []
+        param_ids = {id(t) for t in params}
+        others = [t for t in refs if id(t) not in param_ids]
+
+        feed_sig = tuple(sorted((k, v.shape, str(v.dtype)) for k, v in feed_arrays.items()))
+        key = (prog.id, prog.version, feed_sig, tuple(fetch_names), train)
+        if key not in self._cache:
+            self._cache[key] = self._build(prog, tuple(sorted(feed_arrays)), fetch_names,
+                                           params, others, train)
+        fn = self._cache[key]
+
+        opt = prog.optimizer
+        if train and opt is not None and prog.id not in self._opt_states:
+            ptree = {i: p._value for i, p in enumerate(params)}
+            self._opt_states[prog.id] = {"opt": opt.core.init(ptree),
+                                         "step": jnp.zeros((), jnp.int32)}
+        state = self._opt_states.get(prog.id) if train and opt is not None else None
+
+        param_vals = tuple(p._value for p in params)
+        other_vals = tuple(t._value for t in others)
+        fetched, buf_updates, new_params, new_state = fn(feed_arrays, param_vals, other_vals, state)
+        if train and opt is not None:
+            for p, v in zip(params, new_params):
+                p._value = v
+            self._opt_states[prog.id] = new_state
+        for buf, sym in prog.buffer_writes:  # commit running-stat updates
+            if sym.name in buf_updates:
+                buf._value = buf_updates[sym.name]
+
+        out = []
+        for i in range(len(fetch_list)):
+            if i in passthrough:
+                v = passthrough[i]._value
+            else:
+                v = fetched[fetch_names[i]]
+            out.append(np.asarray(v) if return_numpy else _wrap_value(v))
+        return out
+
+    def _build(self, prog: Program, feed_names, fetch_names, params, others, train):
+        opt = prog.optimizer
+        param_ids = [id(p) for p in params]
+        other_ids = [id(t) for t in others]
+        grad_names = {id_: sv.name for id_, sv in prog.grad_vars.items()}
+
+        def run_fn(feed_arrays, param_vals, other_vals, state):
+            tensor_vals = dict(zip(other_ids, other_vals))
+
+            def forward(pvals):
+                tv = dict(tensor_vals)
+                tv.update(zip(param_ids, pvals))
+                env = dict(feed_arrays)
+                return prog.interpret(env, tv)
+
+            new_params, new_state = param_vals, state
+            if train and prog.loss_var is not None:
+                def loss_of(pvals):
+                    env = forward(pvals)
+                    loss = env[prog.loss_var.name]
+                    return loss, env
+
+                (loss, env), grads = jax.value_and_grad(loss_of, has_aux=True)(param_vals)
+                for pid, g in zip(param_ids, grads):
+                    if pid in grad_names:
+                        env[grad_names[pid]] = g
+                if opt is not None:
+                    gtree = {i: g for i, g in enumerate(grads)}
+                    ptree = {i: v for i, v in enumerate(param_vals)}
+                    np_tree, new_opt, _lr = opt._traced_update(
+                        gtree, state["opt"], ptree, state["step"])
+                    new_params = tuple(np_tree[i] for i in range(len(param_vals)))
+                    new_state = {"opt": new_opt, "step": state["step"] + 1}
+            else:
+                env = forward(param_vals)
+            fetched = {n: env[n] for n in fetch_names if n is not None}
+            buf_updates = {sym.name: env[sym.name] for _, sym in prog.buffer_writes
+                           if sym.name in env}
+            return fetched, buf_updates, new_params, new_state
+
+        return jax.jit(run_fn)
+
+
+# --------------------------------------------------------- inference format
+def save_inference_model(path_prefix: str, feed_vars: List[Tensor], fetch_vars: List[Tensor],
+                         executor: Optional[Executor] = None, program: Optional[Program] = None,
+                         **kwargs) -> None:
+    """Serialize the inference graph as StableHLO + metadata.
+
+    Reference paddle.static.save_inference_model prunes the program to the
+    feed→fetch subgraph and writes ProgramDesc+params; here jax.export lowers
+    the same subgraph (params embedded as constants) to portable StableHLO —
+    ``{prefix}.pdmodel`` holds the serialized artifact, ``{prefix}.pdiparams``
+    the metadata (feed/fetch names and shapes).
+    """
+    prog = program if program is not None else _default_main
+    if isinstance(prog, CompiledProgram):
+        prog = prog._program
+    feed_names = [v._value.name if is_symbolic(v._value) else v.name for v in feed_vars]
+    fetch_names = [v._value.name for v in fetch_vars]
+    refs = prog.tensor_refs()
+    ref_vals = tuple(t._value for t in refs)
+    ref_ids = [id(t) for t in refs]
+
+    def infer_fn(*feeds):
+        env = dict(zip(feed_names, feeds))
+        env = prog.interpret(env, dict(zip(ref_ids, ref_vals)))
+        return tuple(env[n] for n in fetch_names)
+
+    # dynamic (-1/None) dims export as jax symbolic dimensions so the loaded
+    # artifact accepts any batch size (reference programs are shape-dynamic)
+    scope = jax.export.SymbolicScope()
+    specs = []
+    for i, v in enumerate(feed_vars):
+        shape = tuple(v._value.shape)
+        if any(d < 0 for d in shape):
+            spec_str = ",".join(f"d{i}_{j}" if d < 0 else str(d) for j, d in enumerate(shape))
+            shape = jax.export.symbolic_shape(spec_str, scope=scope)
+        specs.append(jax.ShapeDtypeStruct(shape, v._value.dtype))
+    exported = jax.export.export(jax.jit(infer_fn))(*specs)
+    path = Path(path_prefix)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.with_suffix(".pdmodel").write_bytes(exported.serialize())
+    meta = {
+        "feed_names": feed_names,
+        "fetch_names": fetch_names,
+        # symbolic (dynamic) dims serialize as -1
+        "feed_shapes": [[int(d) if isinstance(d, int) else -1 for d in s.shape] for s in specs],
+        "feed_dtypes": [str(s.dtype) for s in specs],
+    }
+    path.with_suffix(".pdiparams").write_bytes(pickle.dumps(meta))
+
+
+def load_inference_model(path_prefix: str, executor: Optional[Executor] = None):
+    """Returns (callable_program, feed_names, fetch_names); the callable maps
+    feed arrays → list of fetch arrays (reference returns a ProgramDesc — the
+    StableHLO artifact plays that role here)."""
+    path = Path(path_prefix)
+    exported = jax.export.deserialize(path.with_suffix(".pdmodel").read_bytes())
+    meta = pickle.loads(path.with_suffix(".pdiparams").read_bytes())
+
+    def run(*feeds):
+        arrays = [jnp.asarray(unwrap(f)) for f in feeds]
+        return list(exported.call(*arrays))
+
+    run.meta = meta
+    return run, meta["feed_names"], meta["fetch_names"]
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    raise NotImplementedError(
+        "py_func embeds arbitrary Python in the graph, which cannot compile "
+        "to XLA; use jax.pure_callback via a custom primitive instead")
+
+
+# ------------------------------------------------------------- static.nn
+class _StaticNN:
+    """reference paddle.static.nn: LayerHelper-style builders. Each call
+    creates fresh parameters (eagerly, = startup init) and records ops."""
+
+    @staticmethod
+    def fc(x, size, num_flatten_dims=1, activation=None, name=None):
+        from .. import nn
+
+        in_features = int(np.prod(x._value.shape[num_flatten_dims:]))
+        layer = nn.Linear(in_features, size)
+        if num_flatten_dims != 1 or len(x._value.shape) > 2:
+            from ..tensor.manipulation import reshape
+
+            x = reshape(x, [-1, in_features] if num_flatten_dims == 1 else
+                        list(x._value.shape[:num_flatten_dims]) + [in_features])
+        out = layer(x)
+        if activation:
+            import paddle_tpu.nn.functional as F
+
+            out = getattr(F, activation)(out)
+        return out
+
+    @staticmethod
+    def batch_norm(x, **kwargs):
+        from .. import nn
+
+        return nn.BatchNorm(x._value.shape[1])(x)
+
+    @staticmethod
+    def embedding(input, size, **kwargs):
+        from .. import nn
+
+        return nn.Embedding(size[0], size[1])(input)
+
+
+nn = _StaticNN()

@@ -50,6 +50,10 @@ def _getitem(self, idx):
 
 
 def _setitem(self, idx, value):
+    from ..framework.static_trace import guard_inplace
+
+    guard_inplace("Tensor.__setitem__", self, value if isinstance(value, Tensor) else None)
+
     def norm(i):
         if isinstance(i, Tensor):
             return i._value

@@ -85,9 +85,9 @@ def qr(x, mode="reduced", name=None):
 
 
 def eig(x, name=None):
-    v = unwrap(ensure_tensor(x))
-    w, vec = jnp.linalg.eig(v)
-    return _wrap_value(w), _wrap_value(vec)
+    # jax cannot differentiate non-symmetric eig; detach so primitive does
+    # not build a vjp (grad was never available for this op)
+    return op(lambda v: jnp.linalg.eig(v), ensure_tensor(x).detach(), _name="eig")
 
 
 def eigh(x, UPLO="L", name=None):
@@ -95,7 +95,7 @@ def eigh(x, UPLO="L", name=None):
 
 
 def eigvals(x, name=None):
-    return _wrap_value(jnp.linalg.eigvals(unwrap(ensure_tensor(x))))
+    return op(jnp.linalg.eigvals, ensure_tensor(x).detach(), _name="eigvals")
 
 
 def eigvalsh(x, UPLO="L", name=None):
@@ -114,9 +114,8 @@ def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, nam
 
 
 def lstsq(x, y, rcond=None, driver=None, name=None):
-    v, w = unwrap(ensure_tensor(x)), unwrap(ensure_tensor(y))
-    sol, res, rank_, sv = jnp.linalg.lstsq(v, w, rcond=rcond)
-    return _wrap_value(sol), _wrap_value(res), _wrap_value(rank_), _wrap_value(sv)
+    return op(lambda v, w: jnp.linalg.lstsq(v, w, rcond=rcond),
+              ensure_tensor(x), ensure_tensor(y), _name="lstsq")
 
 
 def pinv(x, rcond=1e-15, hermitian=False, name=None):
@@ -128,7 +127,7 @@ def matrix_power(x, n, name=None):
 
 
 def matrix_rank(x, tol=None, hermitian=False, name=None):
-    return _wrap_value(jnp.linalg.matrix_rank(unwrap(ensure_tensor(x)), rtol=tol))
+    return op(lambda v: jnp.linalg.matrix_rank(v, rtol=tol), ensure_tensor(x), _name="matrix_rank")
 
 
 def multi_dot(x, name=None):
@@ -137,9 +136,11 @@ def multi_dot(x, name=None):
 
 
 def lu(x, pivot=True, get_infos=False, name=None):
-    v = unwrap(ensure_tensor(x))
-    lu_, piv = jax.scipy.linalg.lu_factor(v)
-    outs = (_wrap_value(lu_), _wrap_value(piv.astype(jnp.int32)))
-    if get_infos:
-        outs = outs + (_wrap_value(jnp.zeros((), jnp.int32)),)
-    return outs
+    def fn(v):
+        lu_, piv = jax.scipy.linalg.lu_factor(v)
+        outs = (lu_, piv.astype(jnp.int32))
+        if get_infos:
+            outs = outs + (jnp.zeros((), jnp.int32),)
+        return outs
+
+    return op(fn, ensure_tensor(x), _name="lu")

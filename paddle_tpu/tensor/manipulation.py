@@ -29,6 +29,9 @@ def reshape(x, shape, name=None):
 
 
 def reshape_(x, shape, name=None):
+    from ..framework.static_trace import guard_inplace
+
+    guard_inplace("reshape_", x)
     x._value = jnp.reshape(x._value, shape)
     return x
 
@@ -161,29 +164,24 @@ def broadcast_tensors(inputs, name=None):
 
 
 def gather(x, index, axis=0, name=None):
-    idx = unwrap(ensure_tensor(index))
     ax = int(unwrap(axis))
-    return op(lambda v: jnp.take(v, idx, axis=ax), ensure_tensor(x), _name="gather")
+    return op(lambda v, idx: jnp.take(v, idx, axis=ax), ensure_tensor(x), ensure_tensor(index), _name="gather")
 
 
 def gather_nd(x, index, name=None):
-    idx = unwrap(ensure_tensor(index))
-
-    def fn(v):
+    def fn(v, idx):
         return v[tuple(jnp.moveaxis(idx, -1, 0))]
 
-    return op(fn, ensure_tensor(x), _name="gather_nd")
+    return op(fn, ensure_tensor(x), ensure_tensor(index), _name="gather_nd")
 
 
 def take_along_axis(arr, indices, axis, name=None):
-    idx = unwrap(ensure_tensor(indices))
-    return op(lambda v: jnp.take_along_axis(v, idx, axis=axis), ensure_tensor(arr), _name="take_along_axis")
+    return op(lambda v, idx: jnp.take_along_axis(v, idx, axis=axis),
+              ensure_tensor(arr), ensure_tensor(indices), _name="take_along_axis")
 
 
 def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):
-    idx = unwrap(ensure_tensor(indices))
-
-    def fn(v, val):
+    def fn(v, val, idx):
         val = jnp.broadcast_to(val, idx.shape).astype(v.dtype)
         dims = list(range(v.ndim))
         ii = jnp.meshgrid(*[jnp.arange(s) for s in idx.shape], indexing="ij")
@@ -196,37 +194,32 @@ def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):
             return v.at[tuple(ii)].multiply(val)
         raise ValueError(reduce)
 
-    return op(fn, ensure_tensor(arr), ensure_tensor(values), _name="put_along_axis")
+    return op(fn, ensure_tensor(arr), ensure_tensor(values), ensure_tensor(indices), _name="put_along_axis")
 
 
 def scatter(x, index, updates, overwrite=True, name=None):
-    idx = unwrap(ensure_tensor(index)).reshape(-1)
-
-    def fn(v, u):
+    def fn(v, u, idx):
+        idx = idx.reshape(-1)
         if overwrite:
             return v.at[idx].set(u)
         return v.at[idx].add(u)
 
-    return op(fn, ensure_tensor(x), ensure_tensor(updates), _name="scatter")
+    return op(fn, ensure_tensor(x), ensure_tensor(updates), ensure_tensor(index), _name="scatter")
 
 
 def scatter_nd_add(x, index, updates, name=None):
-    idx = unwrap(ensure_tensor(index))
-
-    def fn(v, u):
+    def fn(v, u, idx):
         return v.at[tuple(jnp.moveaxis(idx, -1, 0))].add(u)
 
-    return op(fn, ensure_tensor(x), ensure_tensor(updates), _name="scatter_nd_add")
+    return op(fn, ensure_tensor(x), ensure_tensor(updates), ensure_tensor(index), _name="scatter_nd_add")
 
 
 def scatter_nd(index, updates, shape, name=None):
-    idx = unwrap(ensure_tensor(index))
-
-    def fn(u):
+    def fn(u, idx):
         z = jnp.zeros(shape, u.dtype)
         return z.at[tuple(jnp.moveaxis(idx, -1, 0))].add(u)
 
-    return op(fn, ensure_tensor(updates), _name="scatter_nd")
+    return op(fn, ensure_tensor(updates), ensure_tensor(index), _name="scatter_nd")
 
 
 def index_select(x, index, axis=0, name=None):

@@ -289,22 +289,39 @@ def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
 
 
 def clip(x, min=None, max=None, name=None):
-    lo = unwrap(min) if min is not None else None
-    hi = unwrap(max) if max is not None else None
-    return _unary(lambda v: jnp.clip(v, lo, hi), x, "clip")
+    from ._helpers import ensure_tensor
+
+    # Tensor bounds ride positionally (static-capturable, differentiable);
+    # python scalars stay weakly typed so bf16/f16 inputs keep their dtype
+    lo_is_t, hi_is_t = isinstance(min, Tensor), isinstance(max, Tensor)
+    aux = [m for m in (min, max) if isinstance(m, Tensor)]
+
+    def fn(v, *bounds):
+        lo = bounds[0] if lo_is_t else min
+        hi = bounds[-1] if hi_is_t else max
+        return jnp.clip(v, lo, hi)
+
+    return op(fn, ensure_tensor(x), *aux, _name="clip")
 
 
 def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
-    s, b = unwrap(scale), unwrap(bias)
+    from ._helpers import ensure_tensor
 
-    def fn(v):
-        out = v * s + b if bias_after_scale else (v + b) * s
-        return out
+    s_is_t, b_is_t = isinstance(scale, Tensor), isinstance(bias, Tensor)
+    aux = [a for a in (scale, bias) if isinstance(a, Tensor)]
 
-    return _unary(fn, x, "scale")
+    def fn(v, *ab):
+        s = ab[0] if s_is_t else scale
+        b = ab[-1] if b_is_t else bias
+        return v * s + b if bias_after_scale else (v + b) * s
+
+    return op(fn, ensure_tensor(x), *aux, _name="scale")
 
 
 def increment(x, value=1.0, name=None):
+    from ..framework.static_trace import guard_inplace
+
+    guard_inplace("increment", x)
     x._value = x._value + value
     return x
 
@@ -463,9 +480,17 @@ def trace(x, offset=0, axis1=0, axis2=1, name=None):
 
 
 def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
-    pre = unwrap(prepend) if prepend is not None else None
-    app = unwrap(append) if append is not None else None
-    return _unary(lambda v: jnp.diff(v, n=n, axis=axis, prepend=pre, append=app), x, "diff")
+    from ._helpers import ensure_tensor
+
+    pre_is_t, app_is_t = isinstance(prepend, Tensor), isinstance(append, Tensor)
+    aux = [m for m in (prepend, append) if isinstance(m, Tensor)]
+
+    def fn(v, *edges):
+        pre = edges[0] if pre_is_t else (unwrap(prepend) if prepend is not None else None)
+        app = edges[-1] if app_is_t else (unwrap(append) if append is not None else None)
+        return jnp.diff(v, n=n, axis=axis, prepend=pre, append=app)
+
+    return op(fn, ensure_tensor(x), *aux, _name="diff")
 
 
 def add_n(inputs, name=None):

@@ -8,25 +8,30 @@ from ._helpers import ensure_tensor, op, to_jax_dtype, unwrap, _wrap_value
 
 
 def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
-    v = unwrap(ensure_tensor(x))
-    out = jnp.argmax(v if axis is not None else v.reshape(-1), axis=axis if axis is not None else 0)
-    if keepdim and axis is not None:
-        out = jnp.expand_dims(out, axis)
-    return _wrap_value(out.astype(to_jax_dtype(dtype)))
+    def fn(v):
+        out = jnp.argmax(v if axis is not None else v.reshape(-1), axis=axis if axis is not None else 0)
+        if keepdim and axis is not None:
+            out = jnp.expand_dims(out, axis)
+        return out.astype(to_jax_dtype(dtype))
+
+    return op(fn, ensure_tensor(x), _name="argmax")
 
 
 def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
-    v = unwrap(ensure_tensor(x))
-    out = jnp.argmin(v if axis is not None else v.reshape(-1), axis=axis if axis is not None else 0)
-    if keepdim and axis is not None:
-        out = jnp.expand_dims(out, axis)
-    return _wrap_value(out.astype(to_jax_dtype(dtype)))
+    def fn(v):
+        out = jnp.argmin(v if axis is not None else v.reshape(-1), axis=axis if axis is not None else 0)
+        if keepdim and axis is not None:
+            out = jnp.expand_dims(out, axis)
+        return out.astype(to_jax_dtype(dtype))
+
+    return op(fn, ensure_tensor(x), _name="argmin")
 
 
 def argsort(x, axis=-1, descending=False, name=None):
-    v = unwrap(ensure_tensor(x))
-    out = jnp.argsort(-v if descending else v, axis=axis)
-    return _wrap_value(out.astype(to_jax_dtype("int64")))
+    def fn(v):
+        return jnp.argsort(-v if descending else v, axis=axis).astype(to_jax_dtype("int64"))
+
+    return op(fn, ensure_tensor(x), _name="argsort")
 
 
 def sort(x, axis=-1, descending=False, name=None):
@@ -86,14 +91,16 @@ def nonzero(x, as_tuple=False):
 
 
 def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
-    s = unwrap(ensure_tensor(sorted_sequence))
-    v = unwrap(ensure_tensor(values))
     side = "right" if right else "left"
-    if s.ndim == 1:
-        out = jnp.searchsorted(s, v, side=side)
-    else:
-        out = jax.vmap(lambda ss, vv: jnp.searchsorted(ss, vv, side=side))(s.reshape(-1, s.shape[-1]), v.reshape(-1, v.shape[-1])).reshape(v.shape)
-    return _wrap_value(out.astype(jnp.int32 if out_int32 else to_jax_dtype("int64")))
+
+    def fn(s, v):
+        if s.ndim == 1:
+            out = jnp.searchsorted(s, v, side=side)
+        else:
+            out = jax.vmap(lambda ss, vv: jnp.searchsorted(ss, vv, side=side))(s.reshape(-1, s.shape[-1]), v.reshape(-1, v.shape[-1])).reshape(v.shape)
+        return out.astype(jnp.int32 if out_int32 else to_jax_dtype("int64"))
+
+    return op(fn, ensure_tensor(sorted_sequence), ensure_tensor(values), _name="searchsorted")
 
 
 def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
@@ -101,11 +108,10 @@ def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
 
 
 def index_put(x, indices, value, accumulate=False, name=None):
-    idx = tuple(unwrap(ensure_tensor(i)) for i in indices)
-
-    def fn(v, val):
+    def fn(v, val, *idx):
         if accumulate:
-            return v.at[idx].add(val)
-        return v.at[idx].set(val)
+            return v.at[tuple(idx)].add(val)
+        return v.at[tuple(idx)].set(val)
 
-    return op(fn, ensure_tensor(x), ensure_tensor(value), _name="index_put")
+    return op(fn, ensure_tensor(x), ensure_tensor(value),
+              *[ensure_tensor(i) for i in indices], _name="index_put")

@@ -45,16 +45,36 @@ def nanquantile(x, q, axis=None, keepdim=False, name=None):
 
 
 def histogram(input, bins=100, min=0, max=0, name=None):
-    v = unwrap(ensure_tensor(input))
     lo, hi = (None, None) if (min == 0 and max == 0) else (min, max)
-    hist, _ = jnp.histogram(v, bins=bins, range=(lo, hi) if lo is not None else None)
-    return _wrap_value(hist.astype(to_jax_dtype("int64")))
+
+    def fn(v):
+        hist, _ = jnp.histogram(v, bins=bins, range=(lo, hi) if lo is not None else None)
+        return hist.astype(to_jax_dtype("int64"))
+
+    return op(fn, ensure_tensor(input), _name="histogram")
 
 
 def bincount(x, weights=None, minlength=0, name=None):
+    # output length is data-dependent; XLA needs it static. Eager: read it
+    # from the values. Static capture: minlength must pin it.
+    from ..framework.static_trace import is_symbolic
+
     v = unwrap(ensure_tensor(x))
-    w = unwrap(ensure_tensor(weights)) if weights is not None else None
-    return _wrap_value(jnp.bincount(v, weights=w, minlength=minlength))
+    if is_symbolic(v):
+        if minlength <= 0:
+            raise ValueError(
+                "bincount under static capture needs minlength>0: the output "
+                "length is data-dependent, which XLA cannot compile")
+        n = int(minlength)
+    else:
+        length = int(__import__("numpy").asarray(v).max(initial=-1)) + 1
+        n = max(int(minlength), length)
+    aux = [ensure_tensor(weights)] if weights is not None else []
+
+    def fn(vv, *ws):
+        return jnp.bincount(vv, weights=ws[0] if ws else None, minlength=n, length=n)
+
+    return op(fn, ensure_tensor(x), *aux, _name="bincount")
 
 
 def corrcoef(x, rowvar=True, name=None):

@@ -201,3 +201,24 @@ def test_record_feed_shuffle_changes_order(tmp_path):
     ys = np.concatenate([b["y"] for b in loader])
     assert sorted(ys.tolist()) == list(range(n))
     assert ys.tolist() != list(range(n))  # actually shuffled
+
+
+def test_tcp_store_close_with_live_clients():
+    """Regression: master.close() must not deadlock while worker connections
+    are still open (Stop used to join Serve threads holding the prune lock)."""
+    from paddle_tpu.distributed import TCPStore
+
+    master = TCPStore("127.0.0.1", 0, is_master=True, world_size=2, timeout=10)
+    worker = TCPStore("127.0.0.1", master.port, is_master=False, world_size=2, timeout=10)
+    worker.set("k", b"v")
+    done = []
+
+    def close_master():
+        master.close()
+        done.append(True)
+
+    t = threading.Thread(target=close_master)
+    t.start()
+    t.join(timeout=10)
+    assert done, "master.close() deadlocked with a live client connection"
+    worker.close()

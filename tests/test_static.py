@@ -1,0 +1,235 @@
+"""Static-graph frontend tests (reference test strategy: program construction
++ executor equivalence, unittests/interpreter/test_standalone_executor.py and
+dygraph↔static parity suites)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+
+
+def test_program_records_ops():
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [4, 3])
+        y = paddle.matmul(x, paddle.to_tensor(np.eye(3, dtype="float32")))
+        z = y + 1.0
+    assert prog.version >= 2
+    assert "x" in prog.feeds
+    r = repr(prog)
+    assert "matmul" in r
+
+
+def test_executor_forward():
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [None, 3])
+        w = paddle.to_tensor(np.array([[1.0], [2.0], [3.0]], dtype="float32"))
+        y = paddle.matmul(x, w)
+        out = paddle.nn.functional.relu(y - 1.0)
+    exe = static.Executor()
+    xv = np.array([[1, 0, 0], [0, 0, 1]], dtype="float32")
+    (res,) = exe.run(prog, feed={"x": xv}, fetch_list=[out])
+    np.testing.assert_allclose(res, np.maximum(xv @ [[1.0], [2.0], [3.0]] - 1, 0))
+
+
+def test_dygraph_static_parity():
+    """Same model, eager vs static, identical outputs (reference
+    dygraph_to_static test pattern)."""
+    paddle.seed(42)
+    model = paddle.nn.Sequential(
+        paddle.nn.Linear(8, 16), paddle.nn.GELU(), paddle.nn.Linear(16, 4))
+    xv = np.random.default_rng(0).normal(size=(5, 8)).astype("float32")
+    eager_out = model(paddle.to_tensor(xv)).numpy()
+
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [None, 8])
+        y = model(x)
+    (static_out,) = static.Executor().run(prog, feed={"x": xv}, fetch_list=[y])
+    np.testing.assert_allclose(eager_out, static_out, rtol=2e-5, atol=2e-6)
+
+
+def test_append_backward_grads():
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [2, 3])
+        w = paddle.to_tensor(np.ones((3, 2), dtype="float32"))
+        w.stop_gradient = False
+        w.name = "w"
+        loss = paddle.mean(paddle.matmul(x, w))
+        params_grads = static.append_backward(loss)
+    assert len(params_grads) == 1
+    p, g = params_grads[0]
+    assert p is w
+    xv = np.arange(6, dtype="float32").reshape(2, 3)
+    loss_v, grad_v = static.Executor().run(prog, feed={"x": xv}, fetch_list=[loss, g])
+    # d(mean(xw))/dw[i,j] = mean over batch of x[:, i] / n_out
+    expected = np.repeat(xv.mean(0)[:, None], 2, axis=1) / 2
+    np.testing.assert_allclose(grad_v, expected, rtol=1e-6)
+    np.testing.assert_allclose(loss_v, (xv @ np.ones((3, 2))).mean(), rtol=1e-6)
+
+
+def test_static_training_minimize():
+    """Full static train loop: program + minimize + exe.run updates params."""
+    paddle.seed(0)
+    rng = np.random.default_rng(0)
+    true_w = rng.normal(size=(4, 1)).astype("float32")
+    model = paddle.nn.Linear(4, 1)
+
+    main = static.Program()
+    startup = static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [None, 4])
+        yt = static.data("y", [None, 1])
+        pred = model(x)
+        loss = paddle.mean((pred - yt) ** 2)
+        opt = paddle.optimizer.SGD(learning_rate=0.1)
+        opt.minimize(loss)
+
+    exe = static.Executor()
+    exe.run(startup)
+    losses = []
+    for i in range(60):
+        xv = rng.normal(size=(32, 4)).astype("float32")
+        yv = xv @ true_w
+        (lv,) = exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss])
+        losses.append(float(lv))
+    assert losses[-1] < 0.02 * losses[0], (losses[0], losses[-1])
+    # static updates are visible to the eager parameter tensors
+    np.testing.assert_allclose(model.weight.numpy(), true_w, atol=0.15)
+
+
+def test_enable_disable_static():
+    assert paddle.in_dynamic_mode()
+    paddle.enable_static()
+    try:
+        assert not paddle.in_dynamic_mode()
+        x = static.data(f"x_{np.random.randint(1 << 30)}", [2, 2])
+        y = x * 2.0
+        assert not hasattr(y._value, "device")  # symbolic, not executed
+        with pytest.raises(RuntimeError):
+            y.numpy()
+    finally:
+        paddle.disable_static()
+    assert paddle.in_dynamic_mode()
+    t = paddle.to_tensor([1.0]) * 2.0
+    np.testing.assert_allclose(t.numpy(), [2.0])
+
+
+def test_save_load_inference_model(tmp_path):
+    paddle.seed(7)
+    model = paddle.nn.Sequential(paddle.nn.Linear(6, 3), paddle.nn.Softmax())
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [2, 6])
+        out = model(x)
+    prefix = str(tmp_path / "infer" / "model")
+    exe = static.Executor()
+    static.save_inference_model(prefix, [x], [out], exe, program=prog)
+
+    runner, feed_names, fetch_names = static.load_inference_model(prefix, exe)
+    assert feed_names == ["x"]
+    xv = np.random.default_rng(1).normal(size=(2, 6)).astype("float32")
+    (loaded,) = runner(xv)
+    (direct,) = exe.run(prog, feed={"x": xv}, fetch_list=[out])
+    np.testing.assert_allclose(np.asarray(loaded), direct, rtol=1e-6)
+
+
+def test_static_nn_fc():
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [3, 5])
+        out = static.nn.fc(x, size=2, activation="relu")
+    (res,) = static.Executor().run(
+        prog, feed={"x": np.ones((3, 5), dtype="float32")}, fetch_list=[out])
+    assert res.shape == (3, 2)
+    assert (res >= 0).all()
+
+
+def test_missing_feed_raises():
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [2, 2])
+        y = x + 1.0
+    with pytest.raises(ValueError, match="missing feeds"):
+        static.Executor().run(prog, feed={}, fetch_list=[y])
+
+
+def test_static_dropout_varies_per_run():
+    """Dropout masks must differ across Executor runs (reference stateful
+    curand semantics; here the __rng_key__ per-run feed)."""
+    import paddle_tpu.nn.functional as F
+
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [64], "float32")
+        y = F.dropout(x, p=0.5, training=True)
+    exe = static.Executor()
+    xv = np.ones(64, dtype="float32")
+    (r1,) = exe.run(prog, feed={"x": xv}, fetch_list=[y])
+    (r2,) = exe.run(prog, feed={"x": xv}, fetch_list=[y])
+    assert not np.array_equal(r1, r2), "identical dropout masks across runs"
+    assert set(np.unique(r1)) <= {0.0, 2.0}
+
+
+def test_static_bincount_requires_minlength():
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("xi", [6], "int64")
+        with pytest.raises(ValueError, match="minlength"):
+            paddle.bincount(x)
+        counts = paddle.bincount(x, minlength=8)
+    (res,) = static.Executor().run(
+        prog, feed={"xi": np.array([1, 2, 2, 5, 1, 1], dtype="int64")}, fetch_list=[counts])
+    np.testing.assert_array_equal(res, [0, 3, 2, 0, 0, 1, 0, 0])
+
+
+def test_static_batchnorm_training_updates_buffers():
+    """BN under static capture: batch stats in-graph, running stats committed
+    back to the buffers after each run (reference static-BN var updates)."""
+    paddle.seed(0)
+    bn = paddle.nn.BatchNorm1D(3)
+    rm_before = bn._mean.numpy().copy()
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [None, 3])
+        y = bn(x)
+    exe = static.Executor()
+    xv = np.random.default_rng(0).normal(loc=5.0, size=(64, 3)).astype("float32")
+    (out,) = exe.run(prog, feed={"x": xv}, fetch_list=[y])
+    # output normalized with batch stats
+    np.testing.assert_allclose(out.mean(0), 0.0, atol=1e-5)
+    rm_after = bn._mean.numpy()
+    assert not np.allclose(rm_before, rm_after), "running mean not updated"
+    # second run moves stats further toward the batch mean
+    exe.run(prog, feed={"x": xv}, fetch_list=[y])
+    assert np.linalg.norm(bn._mean.numpy() - xv.mean(0)) < np.linalg.norm(rm_after - xv.mean(0))
+
+
+def test_static_inplace_raises():
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [2, 2])
+        with pytest.raises(RuntimeError, match="static"):
+            paddle.increment(x)
+
+
+def test_bf16_scalar_ops_keep_dtype():
+    x = paddle.to_tensor(np.ones((4,), dtype="float32")).astype("bfloat16")
+    assert paddle.clip(x, 0.0, 1.0).dtype == x.dtype
+    assert paddle.scale(x, 2.0, 1.0).dtype == x.dtype
+
+
+def test_save_inference_model_dynamic_batch(tmp_path):
+    model = paddle.nn.Linear(5, 2)
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [None, 5])
+        out = model(x)
+    prefix = str(tmp_path / "dyn" / "model")
+    static.save_inference_model(prefix, [x], [out], program=prog)
+    runner, _, _ = static.load_inference_model(prefix)
+    for bs in (1, 7):
+        (res,) = runner(np.ones((bs, 5), dtype="float32"))
+        assert np.asarray(res).shape == (bs, 2)
