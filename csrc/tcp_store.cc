@@ -87,17 +87,15 @@ class StoreServer {
     ::shutdown(listen_fd_, SHUT_RDWR);
     ::close(listen_fd_);
     if (accept_thread_.joinable()) accept_thread_.join();
-    // snapshot under the lock, then shutdown+join WITHOUT holding it: the
-    // Serve exit path locks workers_mu_ to prune client_fds_, so joining
-    // while holding the mutex would deadlock
+    // shutdown the live fds UNDER the lock (prune-then-close in Serve can't
+    // interleave, so no fd-reuse race), but join OUTSIDE it (Serve's exit
+    // path locks workers_mu_ to prune; joining while holding it deadlocks)
     std::vector<std::thread> workers;
-    std::vector<int> fds;
     {
       std::lock_guard<std::mutex> lk(workers_mu_);
-      fds = client_fds_;
+      for (int fd : client_fds_) ::shutdown(fd, SHUT_RDWR);  // unblock recv()
       workers.swap(workers_);
     }
-    for (int fd : fds) ::shutdown(fd, SHUT_RDWR);  // unblock recv()
     for (auto& t : workers)
       if (t.joinable()) t.join();
   }

@@ -1,0 +1,164 @@
+"""paddle_tpu.inference — deployment API.
+
+Parity: ``paddle.inference`` (reference AnalysisPredictor
+paddle/fluid/inference/api/analysis_predictor.h:93, Config
+paddle_analysis_config.h, Tensor handles paddle_tensor.h). TPU-first design:
+the serialized model is a StableHLO artifact (jax.export) produced by
+``paddle.static.save_inference_model`` or ``paddle.jit.save``; "IR pass
+pipeline + TensorRT subgraphs" collapse into XLA compilation at load, so
+Config's optimization toggles are accepted no-ops.
+"""
+from __future__ import annotations
+
+import pickle
+from pathlib import Path
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["Config", "Predictor", "create_predictor", "PredictorTensor"]
+
+
+class Config:
+    """reference AnalysisConfig: model paths + backend knobs."""
+
+    def __init__(self, prog_file: Optional[str] = None, params_file: Optional[str] = None):
+        # accept either a prefix ("model") or explicit "model.pdmodel"
+        if prog_file and prog_file.endswith(".pdmodel"):
+            prog_file = prog_file[: -len(".pdmodel")]
+        self.prefix = prog_file
+        self.params_file = params_file
+        self._device = "tpu"
+        self._memory_optim = True
+
+    def set_model(self, prog_file: str, params_file: Optional[str] = None):
+        self.__init__(prog_file, params_file)
+
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        self._device = "tpu"  # accelerator alias
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def enable_memory_optim(self, x=True):
+        self._memory_optim = x
+
+    def switch_ir_optim(self, x=True):  # XLA always optimizes
+        pass
+
+    def set_cpu_math_library_num_threads(self, n):  # XLA-managed
+        pass
+
+    def enable_tensorrt_engine(self, *a, **k):  # no TRT on TPU; XLA compiles
+        pass
+
+    def model_dir(self):
+        return str(Path(self.prefix).parent) if self.prefix else ""
+
+
+class PredictorTensor:
+    """Input/output handle (reference paddle_infer::Tensor): stage numpy in,
+    read numpy out."""
+
+    def __init__(self, name: str, owner: "Predictor", is_input: bool):
+        self.name = name
+        self._owner = owner
+        self._is_input = is_input
+
+    def copy_from_cpu(self, data: np.ndarray) -> None:
+        if not self._is_input:
+            raise RuntimeError(f"{self.name} is an output handle")
+        self._owner._inputs[self.name] = jnp.asarray(data)
+
+    def copy_to_cpu(self) -> np.ndarray:
+        if self._is_input:
+            raise RuntimeError(f"{self.name} is an input handle")
+        out = self._owner._outputs.get(self.name)
+        if out is None:
+            raise RuntimeError("run() has not produced outputs yet")
+        return np.asarray(out)
+
+    def reshape(self, shape):  # reference API; shape comes from copy_from_cpu
+        pass
+
+    @property
+    def shape(self):
+        src = self._owner._inputs if self._is_input else self._owner._outputs
+        v = src.get(self.name)
+        return list(v.shape) if v is not None else None
+
+
+class Predictor:
+    """Loads a .pdmodel StableHLO artifact and runs it on the default device
+    (TPU when present). First run() compiles; later runs hit the XLA cache."""
+
+    def __init__(self, config: Config):
+        if not config.prefix:
+            raise ValueError("Config has no model path; call set_model(prefix)")
+        # prefix + ".pdmodel" (plain concatenation: a dotted prefix like
+        # "net.v2" must not have its suffix replaced)
+        model_path = Path(str(config.prefix) + ".pdmodel")
+        if not model_path.exists():
+            raise FileNotFoundError(f"{model_path} not found")
+        self.config = config
+        self._exported = jax.export.deserialize(model_path.read_bytes())
+        meta_path = Path(str(config.prefix) + ".pdiparams")
+        if meta_path.exists():
+            self._meta = pickle.loads(meta_path.read_bytes())
+        else:  # artifact without metadata: positional names
+            self._meta = {
+                "feed_names": [f"input_{i}" for i in range(len(self._exported.in_avals))],
+                "fetch_names": [f"output_{i}" for i in range(len(self._exported.out_avals))],
+            }
+        self._inputs: Dict[str, jax.Array] = {}
+        self._outputs: Dict[str, jax.Array] = {}
+
+    # ------------------------------------------------------------- handles
+    def get_input_names(self) -> List[str]:
+        return list(self._meta["feed_names"])
+
+    def get_output_names(self) -> List[str]:
+        return list(self._meta["fetch_names"])
+
+    def get_input_handle(self, name: str) -> PredictorTensor:
+        if name not in self._meta["feed_names"]:
+            raise KeyError(f"unknown input {name!r}; inputs: {self._meta['feed_names']}")
+        return PredictorTensor(name, self, is_input=True)
+
+    get_input_tensor = get_input_handle
+
+    def get_output_handle(self, name: str) -> PredictorTensor:
+        if name not in self._meta["fetch_names"]:
+            raise KeyError(f"unknown output {name!r}; outputs: {self._meta['fetch_names']}")
+        return PredictorTensor(name, self, is_input=False)
+
+    get_output_tensor = get_output_handle
+
+    # ----------------------------------------------------------------- run
+    def run(self, inputs: Optional[List[np.ndarray]] = None):
+        """Execute. Either positional ``inputs`` or previously staged
+        copy_from_cpu handles."""
+        feed_names = self._meta["feed_names"]
+        if inputs is not None:
+            vals = [jnp.asarray(x) for x in inputs]
+        else:
+            missing = [n for n in feed_names if n not in self._inputs]
+            if missing:
+                raise RuntimeError(f"inputs not staged: {missing}")
+            vals = [self._inputs[n] for n in feed_names]
+        outs = self._exported.call(*vals)
+        outs = list(outs) if isinstance(outs, (tuple, list)) else [outs]
+        self._outputs = dict(zip(self._meta["fetch_names"], outs))
+        if inputs is not None:
+            return [np.asarray(o) for o in outs]
+        return True
+
+    def clear_intermediate_tensor(self):
+        self._inputs.clear()
+        self._outputs.clear()
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

@@ -211,39 +211,89 @@ def to_static(function=None, input_spec=None, full_graph=True, **kwargs):
 
 
 def save(layer, path, input_spec=None, **configs):
-    """paddle.jit.save parity: serialized params + StableHLO module.
+    """paddle.jit.save parity: serialized params + executable StableHLO.
 
     The reference serializes a pruned ProgramDesc + params
     (python/paddle/fluid/dygraph/jit.py). Here: ``<path>.pdparams`` state
-    dict + ``<path>.stablehlo.mlir`` exported module when input_spec given.
+    dict always; with ``input_spec``, an executable jax.export artifact
+    (``<path>.pdmodel`` + ``<path>.pdiparams`` metadata — the same format
+    static.save_inference_model writes) loadable by ``jit.load`` as a
+    TranslatedLayer and by ``paddle.inference.create_predictor``.
     """
+    import pickle
+    from pathlib import Path
+
     from ..framework.io import save as _save
 
     model = getattr(layer, "__wrapped_layer__", layer)
     _save(model.state_dict(), path + ".pdparams")
     if input_spec:
-        shapes = [jax.ShapeDtypeStruct(tuple(s.shape), jnp.dtype(s.dtype if isinstance(s.dtype, str) else "float32")) for s in input_spec]
+        scope = jax.export.SymbolicScope()
+        specs, meta_shapes = [], []
+        for i, s in enumerate(input_spec):
+            shape = tuple(-1 if d is None else int(d) for d in s.shape)
+            meta_shapes.append(list(shape))
+            dt = jnp.dtype(s.dtype)  # handles str, np.dtype and scalar types
+            if any(d < 0 for d in shape):
+                spec_str = ",".join(f"d{i}_{j}" if d < 0 else str(d) for j, d in enumerate(shape))
+                shape = jax.export.symbolic_shape(spec_str, scope=scope)
+            specs.append(jax.ShapeDtypeStruct(shape, dt))
 
-        def _fwd(params, buffers, args):
+        params, buffers = model.param_arrays(), model.buffer_arrays()
+
+        def _fwd(*args):
             out, _ = _pure_model_call(model, {**params, **buffers}, args, {}, False, None)
             return out
 
-        lowered = jax.jit(_fwd).lower(model.param_arrays(), model.buffer_arrays(), tuple(shapes))
-        with open(path + ".stablehlo.mlir", "w") as f:
-            f.write(lowered.as_text(dialect="stablehlo"))
+        exported = jax.export.export(jax.jit(_fwd))(*specs)
+        Path(path + ".pdmodel").write_bytes(exported.serialize())
+        meta = {
+            "feed_names": [getattr(s, "name", None) or f"input_{i}" for i, s in enumerate(input_spec)],
+            "fetch_names": [f"output_{i}" for i in range(len(exported.out_avals))],
+            "feed_shapes": meta_shapes,
+            "feed_dtypes": [str(s.dtype) for s in specs],
+        }
+        Path(path + ".pdiparams").write_bytes(pickle.dumps(meta))
     return path
 
 
+class TranslatedLayer:
+    """Loaded inference layer (reference TranslatedLayer
+    python/paddle/fluid/dygraph/io.py:1137): callable like the original
+    model, backed by the exported StableHLO artifact."""
+
+    def __init__(self, prefix: str):
+        from ..inference import Config, create_predictor
+
+        self._predictor = create_predictor(Config(prefix))
+        self.training = False
+
+    def __call__(self, *args):
+        arrays = [unwrap(a) if isinstance(a, Tensor) else jnp.asarray(a) for a in args]
+        outs = self._predictor.run(arrays)
+        wrapped = [_wrap_value(jnp.asarray(o)) for o in outs]
+        return wrapped[0] if len(wrapped) == 1 else tuple(wrapped)
+
+    forward = __call__
+
+    def eval(self):
+        return self
+
+    def train(self):
+        raise RuntimeError("TranslatedLayer is inference-only (reference parity)")
+
+
 def load(path, **configs):
+    """jit.load parity: with a .pdmodel artifact returns a TranslatedLayer;
+    otherwise the bare state dict saved by jit.save."""
+    import os
+
     from ..framework.io import load as _load
 
+    if os.path.exists(path + ".pdmodel"):
+        return TranslatedLayer(path)
     return _load(path + ".pdparams")
 
 
-class InputSpec:
-    """Parity: paddle.static.InputSpec."""
-
-    def __init__(self, shape, dtype="float32", name=None):
-        self.shape = shape
-        self.dtype = dtype
-        self.name = name
+from ..static import InputSpec  # noqa: E402 — one class for jit AND static
+# (reference: paddle.static.InputSpec is the single spec type both use)

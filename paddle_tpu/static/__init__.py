@@ -117,9 +117,13 @@ def append_backward(loss: Tensor, parameter_list=None, no_grad_set=None):
         raise TypeError("append_backward expects a symbolic loss Variable from this program")
     prog.loss_var = loss._value
     params = list(parameter_list) if parameter_list else prog.all_parameters()
+    if no_grad_set:
+        excluded = {id(t) for t in no_grad_set}
+        params = [p for p in params if id(p) not in excluded]
+    ref_ids = {id(x) for x in prog.tensor_refs()}
     out = []
     for i, p in enumerate(params):
-        if id(p) not in {id(x) for x in prog.tensor_refs()}:
+        if id(p) not in ref_ids:
             continue
         gname = f"{p.name or f'param_{i}'}@GRAD"
         sv = SymbolicValue(tuple(p._value.shape), p._value.dtype, gname)
@@ -204,7 +208,13 @@ class Executor:
 
         train = prog.optimizer is not None or bool(prog.grad_vars)
         refs = prog.tensor_refs()
-        params = [t for t in refs if not t.stop_gradient] if train else []
+        if train and prog.grad_vars:
+            # append_backward already applied parameter_list/no_grad_set
+            params = [t for t in refs if id(t) in prog.grad_vars]
+        elif train:
+            params = [t for t in refs if not t.stop_gradient]
+        else:
+            params = []
         param_ids = {id(t) for t in params}
         others = [t for t in refs if id(t) not in param_ids]
 
@@ -324,7 +334,7 @@ def save_inference_model(path_prefix: str, feed_vars: List[Tensor], fetch_vars: 
     exported = jax.export.export(jax.jit(infer_fn))(*specs)
     path = Path(path_prefix)
     path.parent.mkdir(parents=True, exist_ok=True)
-    path.with_suffix(".pdmodel").write_bytes(exported.serialize())
+    Path(str(path) + ".pdmodel").write_bytes(exported.serialize())
     meta = {
         "feed_names": feed_names,
         "fetch_names": fetch_names,
@@ -332,16 +342,15 @@ def save_inference_model(path_prefix: str, feed_vars: List[Tensor], fetch_vars: 
         "feed_shapes": [[int(d) if isinstance(d, int) else -1 for d in s.shape] for s in specs],
         "feed_dtypes": [str(s.dtype) for s in specs],
     }
-    path.with_suffix(".pdiparams").write_bytes(pickle.dumps(meta))
+    Path(str(path) + ".pdiparams").write_bytes(pickle.dumps(meta))
 
 
 def load_inference_model(path_prefix: str, executor: Optional[Executor] = None):
     """Returns (callable_program, feed_names, fetch_names); the callable maps
     feed arrays → list of fetch arrays (reference returns a ProgramDesc — the
     StableHLO artifact plays that role here)."""
-    path = Path(path_prefix)
-    exported = jax.export.deserialize(path.with_suffix(".pdmodel").read_bytes())
-    meta = pickle.loads(path.with_suffix(".pdiparams").read_bytes())
+    exported = jax.export.deserialize(Path(str(path_prefix) + ".pdmodel").read_bytes())
+    meta = pickle.loads(Path(str(path_prefix) + ".pdiparams").read_bytes())
 
     def run(*feeds):
         arrays = [jnp.asarray(unwrap(f)) for f in feeds]

@@ -24,7 +24,9 @@ def cast(x, dtype):
 
 
 def reshape(x, shape, name=None):
-    shape = [int(unwrap(s)) if not isinstance(s, int) else s for s in shape]
+    # coerce Tensor/array extents to ints; leave ints AND symbolic dims
+    # (jax.export shape polymorphism) untouched
+    shape = [int(unwrap(s)) if isinstance(s, (Tensor, np.ndarray, jnp.ndarray)) else s for s in shape]
     return op(lambda v: jnp.reshape(v, shape), ensure_tensor(x), _name="reshape")
 
 

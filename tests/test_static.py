@@ -233,3 +233,48 @@ def test_save_inference_model_dynamic_batch(tmp_path):
     for bs in (1, 7):
         (res,) = runner(np.ones((bs, 5), dtype="float32"))
         assert np.asarray(res).shape == (bs, 2)
+
+
+def test_inference_predictor_api(tmp_path):
+    """AnalysisPredictor-parity flow: Config -> create_predictor -> handles."""
+    from paddle_tpu import inference
+
+    paddle.seed(3)
+    model = paddle.nn.Sequential(paddle.nn.Linear(4, 8), paddle.nn.ReLU(), paddle.nn.Linear(8, 2))
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("feat", [None, 4])
+        out = model(x)
+    prefix = str(tmp_path / "m")
+    static.save_inference_model(prefix, [x], [out], program=prog)
+
+    cfg = inference.Config(prefix)
+    pred = inference.create_predictor(cfg)
+    assert pred.get_input_names() == ["feat"]
+    xv = np.random.default_rng(0).normal(size=(3, 4)).astype("float32")
+    h = pred.get_input_handle("feat")
+    h.copy_from_cpu(xv)
+    assert pred.run() is True
+    got = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+    expect = model(paddle.to_tensor(xv)).numpy()
+    np.testing.assert_allclose(got, expect, rtol=2e-5, atol=2e-6)
+    # positional run too
+    (got2,) = pred.run([xv])
+    np.testing.assert_allclose(got2, got)
+
+
+def test_jit_save_load_translated_layer(tmp_path):
+    paddle.seed(11)
+    model = paddle.nn.Sequential(paddle.nn.Linear(6, 12), paddle.nn.GELU(), paddle.nn.Linear(12, 3))
+    model.eval()
+    prefix = str(tmp_path / "jitmodel")
+    paddle.jit.save(model, prefix, input_spec=[paddle.jit.InputSpec([None, 6], "float32", name="x")])
+
+    loaded = paddle.jit.load(prefix)
+    for bs in (2, 5):
+        xv = np.random.default_rng(bs).normal(size=(bs, 6)).astype("float32")
+        np.testing.assert_allclose(
+            loaded(paddle.to_tensor(xv)).numpy(),
+            model(paddle.to_tensor(xv)).numpy(), rtol=2e-5, atol=2e-6)
+    with pytest.raises(RuntimeError):
+        loaded.train()
