@@ -56,11 +56,24 @@ class TrainStep:
     inplace/vars GC in interpretercore; here it's XLA buffer donation).
     """
 
-    def __init__(self, model, optimizer, loss_fn, mesh=None, state_shardings=None, batch_shardings=None, remat=False, seed=0):
+    def __init__(self, model, optimizer, loss_fn, mesh=None, state_shardings=None, batch_shardings=None, remat=False, seed=0, amp_level=None, amp_dtype="bfloat16"):
         self.model = model
         self.optimizer = optimizer
         self.loss_fn = loss_fn
         self.mesh = mesh
+        # AMP (reference amp.decorate semantics, bf16-first for TPU).
+        # O2: master params stay f32 in state; compute casts params+inputs to
+        #     amp_dtype so matmuls hit the MXU at bf16; loss input back to f32.
+        # O1: white/black-list autocast via the amp module's primitive hook.
+        if amp_level not in (None, "O0", "O1", "O2"):
+            raise ValueError(f"amp_level must be None/'O0'/'O1'/'O2', got {amp_level!r}")
+        self.amp_level = None if amp_level == "O0" else amp_level
+        self.amp_dtype = jnp.dtype(amp_dtype) if self.amp_level else None
+        if self.amp_dtype == jnp.float16:
+            raise ValueError(
+                "float16 in the fused TrainStep has no loss-scaling hook and "
+                "gradients underflow silently; use bfloat16 (TPU-native) or "
+                "the eager path with amp.GradScaler")
         params = model.param_arrays()
         buffers = model.buffer_arrays()
         self.state = {
@@ -79,10 +92,36 @@ class TrainStep:
 
     def _build(self, remat):
         model, optimizer, loss_fn = self.model, self.optimizer, self.loss_fn
+        amp_dt, amp_level = self.amp_dtype, self.amp_level
+        o2 = amp_level == "O2"
+
+        def _to_amp(tree):
+            return jax.tree_util.tree_map(
+                lambda a: a.astype(amp_dt) if a.dtype == jnp.float32 else a, tree)
+
+        def _to_f32(x):
+            return jax.tree_util.tree_map(
+                lambda a: a.astype(jnp.float32) if a.dtype == amp_dt else a, x)
 
         def loss_of(params, buffers, inputs, labels, rng):
             def call(p):
-                out, new_buffers = _pure_model_call(model, {**p, **buffers}, inputs, {}, True, rng)
+                if o2:
+                    # cast-through: grads of the cast are a cast back, so the
+                    # optimizer sees f32 grads against f32 master params
+                    p = _to_amp(p)
+                    inputs_c = _to_amp(inputs)
+                else:
+                    inputs_c = inputs
+                if amp_level == "O1":  # white/black-list autocast (traced)
+                    from .. import amp as _amp
+
+                    ctx = _amp.auto_cast(True, level="O1", dtype=str(amp_dt))
+                else:
+                    ctx = contextlib.nullcontext()
+                with ctx:
+                    out, new_buffers = _pure_model_call(model, {**p, **buffers}, inputs_c, {}, True, rng)
+                if amp_dt is not None:
+                    out = _to_f32(out)  # loss math in f32 (amp black list)
                 with no_grad():
                     loss_t = loss_fn(*_wrap_tree([out]), *_wrap_tree(list(labels)))
                 return unwrap(loss_t), (out, new_buffers)

@@ -135,6 +135,44 @@ def _flash_vjp_bwd(causal, res, g):
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
-def flash_attention(q, k, v, causal=False):
-    """q/k/v: [batch, seq, heads, head_dim]; returns same layout."""
+def _jax_library_flash(q, k, v, causal):
+    """JAX's in-tree Pallas TPU flash kernels (fwd AND bwd are flash —
+    flat-memory backward, unlike our recompute-reference bwd)."""
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        BlockSizes,
+        flash_attention as _fa,
+    )
+
+    b, s, h, d = q.shape
+    blk = min(512, s)
+    sizes = BlockSizes(
+        block_q=blk, block_k_major=blk, block_k=blk, block_b=1,
+        block_q_major_dkv=blk, block_k_major_dkv=blk, block_k_dkv=blk, block_q_dkv=blk,
+        block_k_major_dq=blk, block_k_dq=blk, block_q_dq=blk,
+    )
+    out = _fa(jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2), jnp.swapaxes(v, 1, 2),
+              causal=causal, sm_scale=1.0 / (d ** 0.5), block_sizes=sizes)
+    return jnp.swapaxes(out, 1, 2)
+
+
+def flash_attention(q, k, v, causal=False, impl="auto"):
+    """q/k/v: [batch, seq, heads, head_dim]; returns same layout.
+
+    ``impl``: 'auto' prefers the jax library Pallas kernel pair (flash
+    backward); 'own' forces this module's kernel (flash fwd, recompute bwd).
+    Genuine input errors (shape mismatches) propagate; only a missing/older
+    library API falls back.
+    """
+    if tuple(k.shape) != tuple(q.shape) or tuple(v.shape) != tuple(q.shape):
+        raise ValueError(
+            f"flash_attention requires equal q/k/v shapes (self-attention); got "
+            f"q{tuple(q.shape)} k{tuple(k.shape)} v{tuple(v.shape)} — use "
+            "scaled_dot_product_attention for cross-length attention")
+    s = q.shape[1]
+    lib_ok = impl != "own" and s % min(512, s) == 0
+    if lib_ok:
+        try:
+            return _jax_library_flash(q, k, v, causal)
+        except (ImportError, AttributeError, TypeError):  # jax API drift only
+            pass
     return _flash(q, k, v, causal)

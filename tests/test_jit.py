@@ -156,3 +156,17 @@ def test_jit_save_preserves_int_input_dtype():
     np.testing.assert_allclose(
         loaded(paddle.to_tensor(ids)).numpy(),
         emb(paddle.to_tensor(ids)).numpy(), rtol=1e-6)
+
+
+def test_train_step_amp_o2_converges():
+    """bf16-compute/f32-master AMP step trains (the bench.py flagship path)."""
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 32), nn.GELU(), nn.Linear(32, 4))
+    step = TrainStep(net, paddle.optimizer.Adam(learning_rate=1e-2),
+                     nn.CrossEntropyLoss(), amp_level="O2")
+    x = _rand(16, 8)
+    y = np.random.randint(0, 4, 16)
+    losses = [float(step(x, y)["loss"]) for _ in range(30)]
+    assert losses[-1] < losses[0] * 0.5
+    # master params stayed f32
+    assert all(str(a.dtype) == "float32" for a in step.state["params"].values())
