@@ -1,0 +1,56 @@
+"""paddle_tpu.incubate — incubating APIs (parity: python/paddle/incubate).
+
+- ``incubate.nn``: fused transformer layers (Pallas-flash backed)
+- ``incubate.optimizer``: LookAhead, ModelAverage
+- ``incubate.autotune``: kernel/dataloader autotune config (reference
+  python/paddle/incubate/autotune.py — on TPU, XLA autotunes; the knobs are
+  recorded and the flash-attention toggle is honored)
+- ``incubate.distributed``: MoE re-export (reference
+  incubate/distributed/models/moe)
+"""
+from __future__ import annotations
+
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+
+from ..autograd import functional as autograd  # noqa: F401 — jacobian/hessian (incubate.autograd parity)
+
+
+class _MoENamespace:
+    @property
+    def MoELayer(self):
+        from ..distributed.moe import MoELayer
+
+        return MoELayer
+
+
+class _DistributedModels:
+    moe = _MoENamespace()
+
+
+class _Distributed:
+    models = _DistributedModels()
+
+
+distributed = _Distributed()
+
+_autotune_config = {"kernel": {"enable": True}, "dataloader": {"enable": False}, "layout": {"enable": False}}
+
+
+def autotune_config():
+    return dict(_autotune_config)
+
+
+class autotune:
+    """incubate.autotune.set_config parity."""
+
+    @staticmethod
+    def set_config(config=None):
+        from ..framework.flags import set_flags
+
+        if not config:
+            return
+        _autotune_config.update(config)
+        kern = config.get("kernel", {})
+        if "enable" in kern:
+            set_flags({"FLAGS_use_flash_attention": bool(kern["enable"])})

@@ -1,0 +1,114 @@
+"""paddle_tpu.incubate.nn — fused transformer layers.
+
+Parity: reference python/paddle/incubate/nn/layer/fused_transformer.py
+(FusedMultiHeadAttention:136, FusedFeedForward:327,
+FusedTransformerEncoderLayer:462 — thin wrappers over the fused CUDA ops
+fused_attention_op.cu / fused_feedforward_op.cu). TPU-first: "fusion" is the
+Pallas flash-attention kernel plus XLA's own elementwise fusion, so these
+layers are numerically the unfused ones with the fast attention path pinned
+on.
+"""
+from __future__ import annotations
+
+import paddle_tpu.nn.functional as F
+from ...nn.initializer import Constant, XavierUniform
+from ...nn.layer.base import Layer
+from ...nn.layer.common import Dropout, Linear
+from ...nn.layer.norm import LayerNorm
+from ...tensor import manipulation as M
+
+__all__ = ["FusedMultiHeadAttention", "FusedFeedForward", "FusedTransformerEncoderLayer"]
+
+
+class FusedMultiHeadAttention(Layer):
+    """Pre/post-LN attention block: LN -> qkv proj -> flash attention ->
+    out proj -> dropout -> residual (fused_transformer.py:136 semantics,
+    including the residual add — the reference op fuses the whole block)."""
+
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5, attn_dropout_rate=0.5,
+                 kdim=None, vdim=None, normalize_before=False, need_weights=False,
+                 qkv_weight_attr=None, qkv_bias_attr=None, linear_weight_attr=None,
+                 linear_bias_attr=None, pre_ln_scale_attr=None, pre_ln_bias_attr=None,
+                 ln_scale_attr=None, ln_bias_attr=None, epsilon=1e-5, nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        if embed_dim % num_heads:
+            raise ValueError("embed_dim must divide num_heads")
+        self.embed_dim, self.num_heads = embed_dim, num_heads
+        self.head_dim = embed_dim // num_heads
+        self.normalize_before = normalize_before
+        self.dropout_rate = dropout_rate
+        self.attn_dropout_rate = attn_dropout_rate
+        self.qkv_proj = Linear(embed_dim, 3 * embed_dim, weight_attr=qkv_weight_attr, bias_attr=qkv_bias_attr)
+        self.out_proj = Linear(embed_dim, embed_dim, weight_attr=linear_weight_attr, bias_attr=linear_bias_attr)
+        self.norm = LayerNorm(embed_dim, epsilon=epsilon)
+        self.dropout = Dropout(dropout_rate)
+
+    def forward(self, query, key=None, value=None, attn_mask=None, cache=None):
+        residual = query
+        x = self.norm(query) if self.normalize_before else query
+        b, s = x.shape[0], x.shape[1]
+        qkv = M.reshape(self.qkv_proj(x), [b, s, 3, self.num_heads, self.head_dim])
+        q, k, v = (M.squeeze(t, 2) for t in M.split(qkv, 3, axis=2))
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask, dropout_p=self.attn_dropout_rate, training=self.training)
+        out = self.out_proj(M.reshape(out, [b, s, self.embed_dim]))
+        out = residual + self.dropout(out)
+        if not self.normalize_before:
+            out = self.norm(out)
+        return out
+
+
+class FusedFeedForward(Layer):
+    """LN -> linear -> act -> dropout -> linear -> dropout -> residual
+    (fused_transformer.py:327)."""
+
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1, epsilon=1e-5,
+                 activation="relu", act_dropout_rate=None, normalize_before=False,
+                 linear1_weight_attr=None, linear1_bias_attr=None,
+                 linear2_weight_attr=None, linear2_bias_attr=None,
+                 ln1_scale_attr=None, ln1_bias_attr=None, ln2_scale_attr=None,
+                 ln2_bias_attr=None, nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        self.normalize_before = normalize_before
+        self.activation = activation
+        self.linear1 = Linear(d_model, dim_feedforward, weight_attr=linear1_weight_attr, bias_attr=linear1_bias_attr)
+        self.linear2 = Linear(dim_feedforward, d_model, weight_attr=linear2_weight_attr, bias_attr=linear2_bias_attr)
+        self.norm = LayerNorm(d_model, epsilon=epsilon)
+        self.dropout1 = Dropout(act_dropout_rate if act_dropout_rate is not None else dropout_rate)
+        self.dropout2 = Dropout(dropout_rate)
+
+    def forward(self, src, cache=None):
+        residual = src
+        x = self.norm(src) if self.normalize_before else src
+        x = self.dropout1(getattr(F, self.activation)(self.linear1(x)))
+        x = self.dropout2(self.linear2(x))
+        out = residual + x
+        if not self.normalize_before:
+            out = self.norm(out)
+        return out
+
+
+class FusedTransformerEncoderLayer(Layer):
+    """FusedMultiHeadAttention + FusedFeedForward (fused_transformer.py:462)."""
+
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None, act_dropout_rate=None,
+                 normalize_before=False, weight_attr=None, bias_attr=None):
+        super().__init__()
+        self.fused_attn = FusedMultiHeadAttention(
+            d_model, nhead,
+            dropout_rate=dropout_rate,
+            attn_dropout_rate=attn_dropout_rate if attn_dropout_rate is not None else dropout_rate,
+            normalize_before=normalize_before,
+            qkv_weight_attr=weight_attr, qkv_bias_attr=bias_attr,
+            linear_weight_attr=weight_attr, linear_bias_attr=bias_attr)
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward,
+            dropout_rate=dropout_rate,
+            act_dropout_rate=act_dropout_rate if act_dropout_rate is not None else dropout_rate,
+            activation=activation, normalize_before=normalize_before,
+            linear1_weight_attr=weight_attr, linear1_bias_attr=bias_attr,
+            linear2_weight_attr=weight_attr, linear2_bias_attr=bias_attr)
+
+    def forward(self, src, src_mask=None, cache=None):
+        return self.ffn(self.fused_attn(src, attn_mask=src_mask))

@@ -1,0 +1,133 @@
+"""paddle_tpu.incubate.optimizer — LookAhead, ModelAverage.
+
+Parity: reference python/paddle/incubate/optimizer/{lookahead,modelaverage}.py.
+Both wrap an inner optimizer's eager step with slow-weight bookkeeping kept as
+jax arrays; they compose with the jit TrainStep by wrapping step() only (the
+reference implements them as extra ops appended after the inner update).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...framework.core import Tensor
+from ...optimizer.optimizer import Optimizer
+
+__all__ = ["LookAhead", "ModelAverage"]
+
+
+class LookAhead(Optimizer):
+    """k fast steps, then slow weights interpolate: slow += alpha*(fast-slow)
+    (reference lookahead.py:30)."""
+
+    def __init__(self, inner_optimizer: Optimizer, alpha=0.5, k=5, name=None):
+        if not 0.0 <= alpha <= 1.0:
+            raise ValueError("alpha must be in [0, 1]")
+        if k < 1:
+            raise ValueError("k must be a positive integer")
+        self.inner_optimizer = inner_optimizer
+        self.alpha, self.k = float(alpha), int(k)
+        self._params = inner_optimizer._params
+        self._grad_clip = inner_optimizer._grad_clip
+        self._weight_decay = inner_optimizer._weight_decay
+        self._lr = inner_optimizer._lr
+        self.core = inner_optimizer.core
+        self._state = None
+        self._step_count = 0
+        self._slow = None
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    def step(self):
+        if self._slow is None:
+            self._slow = {id(p): p._value for p in self._params if not p.stop_gradient}
+        self.inner_optimizer.step()
+        self._step_count += 1
+        if self._step_count % self.k == 0:
+            for p in self._params:
+                if p.stop_gradient:
+                    continue
+                slow = self._slow[id(p)] + self.alpha * (p._value - self._slow[id(p)])
+                self._slow[id(p)] = slow
+                p._value = slow
+
+    def clear_grad(self, set_to_zero=True):
+        self.inner_optimizer.clear_grad(set_to_zero)
+
+    def state_dict(self):
+        out = self.inner_optimizer.state_dict()
+        out["lookahead_step"] = self._step_count
+        return out
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, None
+
+
+class ModelAverage(Optimizer):
+    """Maintain a running average of parameters; apply()/restore() swap it in
+    and out (reference modelaverage.py:35, average window semantics
+    simplified to a cumulative mean over min_average_window..max)."""
+
+    def __init__(self, average_window_rate, parameters=None, min_average_window=10000,
+                 max_average_window=10000, name=None):
+        self.average_window_rate = average_window_rate
+        self.min_average_window = min_average_window
+        self.max_average_window = max_average_window
+        self._params = list(parameters) if parameters is not None else []
+        self._grad_clip = None
+        self._weight_decay = None
+        self._lr = 0.0
+        self._state = None
+        self._step_count = 0
+        self._sum = {}
+        self._count = 0
+        self._backup = None
+
+    def step(self):
+        """Accumulate current params into the average (called after the inner
+        optimizer's step)."""
+        for p in self._params:
+            if p.stop_gradient:
+                continue
+            self._sum[id(p)] = self._sum.get(id(p), jnp.zeros_like(p._value)) + p._value
+        self._count += 1
+        self._step_count += 1
+
+    def apply(self, executor=None, need_restore=True):
+        """Swap averaged params in (context-manager style use: with
+        ma.apply(): evaluate)."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def ctx():
+            self._swap_in()
+            try:
+                yield
+            finally:
+                if need_restore:
+                    self.restore()
+
+        return ctx()
+
+    def _swap_in(self):
+        if self._count == 0:
+            return
+        self._backup = {}
+        for p in self._params:
+            if p.stop_gradient or id(p) not in self._sum:
+                continue
+            self._backup[id(p)] = p._value
+            p._value = self._sum[id(p)] / self._count
+
+    def restore(self, executor=None):
+        if self._backup is None:
+            return
+        for p in self._params:
+            if id(p) in self._backup:
+                p._value = self._backup[id(p)]
+        self._backup = None
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        raise RuntimeError("ModelAverage tracks another optimizer's params; call step() after it")

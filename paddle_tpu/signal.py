@@ -1,0 +1,139 @@
+"""paddle_tpu.signal — STFT/ISTFT and framing.
+
+Parity: ``paddle.signal`` (reference python/paddle/signal.py: frame,
+overlap_add, stft, istft over the frame/overlap_add ops in
+paddle/fluid/operators/{frame_op,overlap_add_op}.cc). TPU-first: framing is a
+gather (XLA fuses it), FFTs are XLA FFT HLOs, everything rides ``primitive``
+for autograd/jit/static.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from .tensor._helpers import Tensor, ensure_tensor, op, unwrap
+
+
+def frame(x, frame_length: int, hop_length: int, axis: int = -1, name=None):
+    """Slice overlapping frames: [..., seq] -> [..., frame_length, n_frames]
+    (axis=-1) or [seq, ...] -> [n_frames, frame_length, ...] (axis=0)."""
+    if axis not in (0, -1):
+        raise ValueError("frame: axis must be 0 or -1")
+
+    def fn(v):
+        seq = v.shape[axis]
+        n_frames = 1 + (seq - frame_length) // hop_length
+        starts = jnp.arange(n_frames) * hop_length
+        offs = jnp.arange(frame_length)
+        if axis == -1:
+            idx = starts[None, :] + offs[:, None]          # [frame_length, n_frames]
+            return jnp.take(v, idx, axis=-1)
+        idx = starts[:, None] + offs[None, :]              # [n_frames, frame_length]
+        return jnp.take(v, idx, axis=0)
+
+    return op(fn, ensure_tensor(x), _name="frame")
+
+
+def overlap_add(x, hop_length: int, axis: int = -1, name=None):
+    """Inverse of frame: overlap-add frames back into a signal."""
+    if axis not in (0, -1):
+        raise ValueError("overlap_add: axis must be 0 or -1")
+
+    def fn(v):
+        if axis == -1:
+            frame_length, n_frames = v.shape[-2], v.shape[-1]
+            seq = (n_frames - 1) * hop_length + frame_length
+            starts = jnp.arange(n_frames) * hop_length
+            idx = starts[None, :] + jnp.arange(frame_length)[:, None]  # [fl, nf]
+            out = jnp.zeros(v.shape[:-2] + (seq,), v.dtype)
+            return out.at[..., idx].add(v)
+        n_frames, frame_length = v.shape[0], v.shape[1]
+        seq = (n_frames - 1) * hop_length + frame_length
+        starts = jnp.arange(n_frames) * hop_length
+        idx = starts[:, None] + jnp.arange(frame_length)[None, :]      # [nf, fl]
+        out = jnp.zeros((seq,) + v.shape[2:], v.dtype)
+        return out.at[idx].add(v)
+
+    return op(fn, ensure_tensor(x), _name="overlap_add")
+
+
+def _window_array(window, n_fft, dtype):
+    if window is None:
+        return jnp.ones((n_fft,), dtype)
+    w = unwrap(window) if isinstance(window, Tensor) else jnp.asarray(window)
+    if w.shape != (n_fft,):
+        raise ValueError(f"window must have shape ({n_fft},), got {tuple(w.shape)}")
+    return w.astype(dtype)
+
+
+def stft(x, n_fft: int, hop_length: Optional[int] = None, win_length: Optional[int] = None,
+         window=None, center: bool = True, pad_mode: str = "reflect",
+         normalized: bool = False, onesided: bool = True, name=None):
+    """[batch, seq] (or [seq]) -> [batch, n_fft//2+1 or n_fft, n_frames]
+    complex spectrogram (reference signal.py:stft semantics)."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    aux = [ensure_tensor(window)] if window is not None else []
+
+    def fn(v, *w):
+        real_dtype = v.dtype if jnp.issubdtype(v.dtype, jnp.floating) else jnp.float32
+        win = _window_array(w[0] if w else None, win_length, real_dtype)
+        if win_length < n_fft:  # center-pad the window to n_fft
+            lp = (n_fft - win_length) // 2
+            win = jnp.pad(win, (lp, n_fft - win_length - lp))
+        squeeze = v.ndim == 1
+        if squeeze:
+            v = v[None]
+        if center:
+            v = jnp.pad(v, [(0, 0), (n_fft // 2, n_fft // 2)], mode=pad_mode)
+        seq = v.shape[-1]
+        n_frames = 1 + (seq - n_fft) // hop_length
+        starts = jnp.arange(n_frames) * hop_length
+        idx = starts[:, None] + jnp.arange(n_fft)[None, :]   # [nf, n_fft]
+        frames = v[:, idx] * win[None, None, :]              # [b, nf, n_fft]
+        spec = jnp.fft.rfft(frames, axis=-1) if onesided else jnp.fft.fft(frames, axis=-1)
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        spec = jnp.swapaxes(spec, -1, -2)                    # [b, freq, nf]
+        return spec[0] if squeeze else spec
+
+    return op(fn, ensure_tensor(x), *aux, _name="stft")
+
+
+def istft(x, n_fft: int, hop_length: Optional[int] = None, win_length: Optional[int] = None,
+          window=None, center: bool = True, normalized: bool = False,
+          onesided: bool = True, length: Optional[int] = None, return_complex: bool = False, name=None):
+    """Inverse STFT with window-envelope normalization (reference istft)."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    aux = [ensure_tensor(window)] if window is not None else []
+
+    def fn(spec, *w):
+        win = _window_array(w[0] if w else None, win_length, jnp.float32)
+        if win_length < n_fft:
+            lp = (n_fft - win_length) // 2
+            win = jnp.pad(win, (lp, n_fft - win_length - lp))
+        squeeze = spec.ndim == 2
+        if squeeze:
+            spec = spec[None]
+        spec = jnp.swapaxes(spec, -1, -2)                    # [b, nf, freq]
+        if normalized:
+            spec = spec * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+        frames = jnp.fft.irfft(spec, n=n_fft, axis=-1) if onesided else jnp.fft.ifft(spec, axis=-1).real
+        frames = frames * win[None, None, :]
+        n_frames = frames.shape[1]
+        seq = (n_frames - 1) * hop_length + n_fft
+        starts = jnp.arange(n_frames) * hop_length
+        idx = starts[:, None] + jnp.arange(n_fft)[None, :]
+        sig = jnp.zeros((frames.shape[0], seq), frames.dtype).at[:, idx].add(frames)
+        env = jnp.zeros((seq,), frames.dtype).at[idx.reshape(-1)].add(
+            jnp.tile(win * win, n_frames))
+        sig = sig / jnp.maximum(env, 1e-11)[None, :]
+        if center:
+            sig = sig[:, n_fft // 2: seq - n_fft // 2]
+        if length is not None:
+            sig = sig[:, :length]
+        return sig[0] if squeeze else sig
+
+    return op(fn, ensure_tensor(x), *aux, _name="istft")

@@ -1,0 +1,239 @@
+"""paddle_tpu.sparse — COO/CSR sparse tensors.
+
+Parity: ``paddle.sparse``/``paddle.incubate.sparse`` (reference
+paddle/phi/core/sparse_coo_tensor.h, sparse_csr_tensor.h + kernels under
+paddle/phi/kernels/sparse/). TPU-first: backed by jax.experimental.sparse
+BCOO/BCSR, whose ops lower to XLA gather/scatter — dense-compute-with-mask is
+usually faster on the MXU, so to_dense() is the recommended hot-path escape.
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ..framework.core import Tensor, _wrap_value, unwrap
+
+__all__ = [
+    "SparseCooTensor", "SparseCsrTensor", "sparse_coo_tensor", "sparse_csr_tensor",
+    "add", "subtract", "multiply", "matmul", "relu", "sum", "transpose", "nn",
+]
+
+
+class SparseCooTensor:
+    """COO sparse tensor (reference sparse_coo_tensor.h): indices [ndim, nnz]
+    + values [nnz, ...]."""
+
+    def __init__(self, bcoo: jsparse.BCOO):
+        self._m = bcoo
+
+    # -- reference API ----------------------------------------------------
+    def indices(self) -> Tensor:
+        return _wrap_value(self._m.indices.T)  # paddle layout [ndim, nnz]
+
+    def values(self) -> Tensor:
+        return _wrap_value(self._m.data)
+
+    def to_dense(self) -> Tensor:
+        return _wrap_value(self._m.todense())
+
+    def to_sparse_csr(self) -> "SparseCsrTensor":
+        dense = self._m.todense()
+        if dense.ndim != 2:
+            raise ValueError("to_sparse_csr requires a 2-D tensor")
+        return SparseCsrTensor(jsparse.BCSR.fromdense(dense))
+
+    def coalesce(self) -> "SparseCooTensor":
+        return SparseCooTensor(self._m.sum_duplicates())
+
+    @property
+    def shape(self):
+        return list(self._m.shape)
+
+    @property
+    def nnz(self) -> int:
+        return int(self._m.nse)
+
+    @property
+    def dtype(self):
+        return self._m.dtype
+
+    def is_sparse_coo(self):
+        return True
+
+    def is_sparse_csr(self):
+        return False
+
+    def __repr__(self):
+        return f"SparseCooTensor(shape={self.shape}, nnz={self.nnz}, dtype={self.dtype})"
+
+
+class SparseCsrTensor:
+    """CSR sparse tensor (reference sparse_csr_tensor.h)."""
+
+    def __init__(self, bcsr: jsparse.BCSR):
+        self._m = bcsr
+
+    def crows(self) -> Tensor:
+        return _wrap_value(self._m.indptr)
+
+    def cols(self) -> Tensor:
+        return _wrap_value(self._m.indices)
+
+    def values(self) -> Tensor:
+        return _wrap_value(self._m.data)
+
+    def to_dense(self) -> Tensor:
+        return _wrap_value(self._m.todense())
+
+    def to_sparse_coo(self, sparse_dim=None) -> SparseCooTensor:
+        return SparseCooTensor(jsparse.BCOO.fromdense(self._m.todense()))
+
+    @property
+    def shape(self):
+        return list(self._m.shape)
+
+    @property
+    def nnz(self) -> int:
+        return int(self._m.nse)
+
+    @property
+    def dtype(self):
+        return self._m.dtype
+
+    def is_sparse_coo(self):
+        return False
+
+    def is_sparse_csr(self):
+        return True
+
+    def __repr__(self):
+        return f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz}, dtype={self.dtype})"
+
+
+def sparse_coo_tensor(indices, values, shape: Sequence[int] = None, dtype=None, place=None, stop_gradient=True):
+    """Build COO from paddle-layout indices [ndim, nnz] + values [nnz]."""
+    idx = jnp.asarray(unwrap(indices) if isinstance(indices, Tensor) else indices)
+    val = jnp.asarray(unwrap(values) if isinstance(values, Tensor) else values)
+    if dtype is not None:
+        from ..framework.dtype import to_jax_dtype
+
+        val = val.astype(to_jax_dtype(dtype))
+    if shape is None:
+        shape = tuple(int(i) for i in (idx.max(axis=1) + 1))
+    return SparseCooTensor(jsparse.BCOO((val, idx.T.astype(jnp.int32)), shape=tuple(shape)))
+
+
+def sparse_csr_tensor(crows, cols, values, shape: Sequence[int], dtype=None, place=None, stop_gradient=True):
+    cr = jnp.asarray(unwrap(crows) if isinstance(crows, Tensor) else crows, jnp.int32)
+    cc = jnp.asarray(unwrap(cols) if isinstance(cols, Tensor) else cols, jnp.int32)
+    val = jnp.asarray(unwrap(values) if isinstance(values, Tensor) else values)
+    if dtype is not None:
+        from ..framework.dtype import to_jax_dtype
+
+        val = val.astype(to_jax_dtype(dtype))
+    return SparseCsrTensor(jsparse.BCSR((val, cc, cr), shape=tuple(shape)))
+
+
+def _mat(x):
+    if isinstance(x, (SparseCooTensor, SparseCsrTensor)):
+        return x._m
+    return unwrap(x) if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _rewrap(template, m):
+    if isinstance(m, jsparse.BCOO):
+        return SparseCooTensor(m)
+    if isinstance(m, jsparse.BCSR):
+        return SparseCsrTensor(m)
+    return _wrap_value(m)
+
+
+def add(x, y):
+    a, b = _mat(x), _mat(y)
+    if isinstance(a, jsparse.BCOO) and isinstance(b, jsparse.BCOO):
+        return SparseCooTensor((a + b).sum_duplicates())
+    return _rewrap(x, a.todense() + b if hasattr(a, "todense") else a + b.todense())
+
+
+def subtract(x, y):
+    a, b = _mat(x), _mat(y)
+    if isinstance(a, jsparse.BCOO) and isinstance(b, jsparse.BCOO):
+        return SparseCooTensor((a + (-b)).sum_duplicates())
+    return _rewrap(x, a.todense() - b if hasattr(a, "todense") else a - b.todense())
+
+
+def multiply(x, y):
+    a, b = _mat(x), _mat(y)
+    da = a.todense() if hasattr(a, "todense") else a
+    db = b.todense() if hasattr(b, "todense") else b
+    out = da * db
+    if isinstance(x, SparseCooTensor) or isinstance(y, SparseCooTensor):
+        return SparseCooTensor(jsparse.BCOO.fromdense(out))
+    return _wrap_value(out)
+
+
+def matmul(x, y):
+    """sparse @ dense (reference sparse matmul kernels). Differentiable
+    w.r.t. the dense operand: routed through primitive with the sparse
+    structure closed over (constant)."""
+    from ..tensor._helpers import ensure_tensor, op
+
+    a = _mat(x)
+    if hasattr(a, "todense") and isinstance(y, (Tensor, jnp.ndarray)) or isinstance(y, Tensor):
+        m = a
+
+        def fn(w):
+            out = m @ w
+            return out.todense() if hasattr(out, "todense") else out
+
+        return op(fn, ensure_tensor(y), _name="sparse_matmul")
+    b = _mat(y)
+    out = a @ b
+    return _wrap_value(out.todense() if hasattr(out, "todense") else out)
+
+
+def masked_matmul(x, y, mask):
+    """dense @ dense evaluated only at mask's nonzeros (reference
+    masked_matmul): returns sparse with mask's sparsity."""
+    a, b = _mat(x), _mat(y)
+    m = mask._m if isinstance(mask, SparseCooTensor) else jsparse.BCOO.fromdense(_mat(mask))
+    rows, cols = m.indices[:, 0], m.indices[:, 1]
+    vals = jnp.einsum("nk,nk->n", a[rows, :], b[:, cols].T)
+    return SparseCooTensor(jsparse.BCOO((vals, m.indices), shape=a.shape[:1] + b.shape[1:]))
+
+
+def relu(x):
+    if isinstance(x, SparseCooTensor):
+        m = x._m
+        return SparseCooTensor(jsparse.BCOO((jnp.maximum(m.data, 0), m.indices), shape=m.shape))
+    if isinstance(x, SparseCsrTensor):
+        m = x._m
+        return SparseCsrTensor(jsparse.BCSR((jnp.maximum(m.data, 0), m.indices, m.indptr), shape=m.shape))
+    return _wrap_value(jnp.maximum(_mat(x), 0))
+
+
+def sum(x, axis=None, keepdim=False):
+    d = _mat(x)
+    d = d.todense() if hasattr(d, "todense") else d
+    return _wrap_value(jnp.sum(d, axis=axis, keepdims=keepdim))
+
+
+def transpose(x, perm):
+    if isinstance(x, SparseCooTensor):
+        return SparseCooTensor(x._m.transpose(tuple(perm)))
+    return _wrap_value(jnp.transpose(_mat(x), perm))
+
+
+class _SparseNN:
+    class ReLU:
+        def __call__(self, x):
+            return relu(x)
+
+    def __init__(self):
+        self.ReLU = _SparseNN.ReLU
+
+
+nn = _SparseNN()

@@ -1,0 +1,224 @@
+"""fft / signal / sparse / incubate tests (OpTest-style numeric checks vs
+numpy/scipy references — reference test strategy SURVEY §4)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import fft, signal, sparse
+
+
+# ------------------------------------------------------------------- fft
+def test_fft_roundtrip_and_numpy_parity():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(4, 32)).astype("float32")
+    got = fft.fft(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(got, np.fft.fft(x), rtol=1e-4, atol=1e-4)
+    back = fft.ifft(fft.fft(paddle.to_tensor(x))).numpy()
+    np.testing.assert_allclose(back.real, x, rtol=1e-4, atol=1e-4)
+
+
+def test_rfft_irfft():
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(3, 16)).astype("float32")
+    sp = fft.rfft(paddle.to_tensor(x))
+    assert sp.numpy().shape == (3, 9)
+    np.testing.assert_allclose(sp.numpy(), np.fft.rfft(x), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(fft.irfft(sp, n=16).numpy(), x, rtol=1e-4, atol=1e-4)
+
+
+def test_fft2_fftn_norms():
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(2, 8, 8)).astype("float32")
+    for norm in ("backward", "ortho", "forward"):
+        np.testing.assert_allclose(
+            fft.fft2(paddle.to_tensor(x), norm=norm).numpy(),
+            np.fft.fft2(x, norm=norm), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(
+        fft.fftn(paddle.to_tensor(x)).numpy(), np.fft.fftn(x), rtol=1e-4, atol=1e-4)
+
+
+def test_fftshift_fftfreq():
+    np.testing.assert_allclose(fft.fftfreq(8, d=0.5).numpy(), np.fft.fftfreq(8, d=0.5))
+    x = np.arange(8, dtype="float32")
+    np.testing.assert_allclose(fft.fftshift(paddle.to_tensor(x)).numpy(), np.fft.fftshift(x))
+    np.testing.assert_allclose(
+        fft.ifftshift(fft.fftshift(paddle.to_tensor(x))).numpy(), x)
+
+
+def test_fft_gradients():
+    """rfft|.|^2 grads flow (fft ops are on the tape)."""
+    x = paddle.to_tensor(np.random.default_rng(3).normal(size=(8,)).astype("float32"),
+                         stop_gradient=False)
+    loss = paddle.sum((fft.irfft(fft.rfft(x), n=8) - x) ** 2)
+    loss.backward()
+    assert x.grad is not None
+    np.testing.assert_allclose(x.grad.numpy(), np.zeros(8), atol=1e-5)
+
+
+# ---------------------------------------------------------------- signal
+def test_frame_overlap_add_roundtrip():
+    x = np.arange(32, dtype="float32")
+    f = signal.frame(paddle.to_tensor(x), frame_length=8, hop_length=8)  # no overlap
+    assert f.numpy().shape == (8, 4)
+    back = signal.overlap_add(f, hop_length=8).numpy()
+    np.testing.assert_allclose(back, x)
+
+
+def test_stft_istft_roundtrip():
+    rng = np.random.default_rng(4)
+    x = rng.normal(size=(2, 256)).astype("float32")
+    win = np.hanning(64).astype("float32")
+    spec = signal.stft(paddle.to_tensor(x), n_fft=64, hop_length=16,
+                       window=paddle.to_tensor(win))
+    assert spec.numpy().shape == (2, 33, 256 // 16 + 1)
+    back = signal.istft(spec, n_fft=64, hop_length=16,
+                        window=paddle.to_tensor(win), length=256).numpy()
+    np.testing.assert_allclose(back, x, rtol=1e-3, atol=1e-3)
+
+
+def test_stft_matches_scipy():
+    from scipy import signal as ssig
+
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(512,)).astype("float32")
+    win = np.hanning(128).astype("float32")
+    got = signal.stft(paddle.to_tensor(x), n_fft=128, hop_length=32,
+                      window=paddle.to_tensor(win), center=False).numpy()
+    _, _, ref = ssig.stft(x, window=win, nperseg=128, noverlap=96, boundary=None,
+                          padded=False, return_onesided=True)
+    ref = ref * win.sum()  # scipy normalizes by window sum
+    np.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-3)
+
+
+# ---------------------------------------------------------------- sparse
+def test_sparse_coo_roundtrip():
+    indices = [[0, 1, 2], [1, 2, 0]]
+    values = [1.0, 2.0, 3.0]
+    s = sparse.sparse_coo_tensor(indices, values, shape=[3, 3])
+    assert s.nnz == 3 and s.shape == [3, 3]
+    dense = s.to_dense().numpy()
+    expect = np.zeros((3, 3), "float32")
+    expect[0, 1], expect[1, 2], expect[2, 0] = 1, 2, 3
+    np.testing.assert_allclose(dense, expect)
+    np.testing.assert_allclose(np.sort(s.values().numpy()), [1, 2, 3])
+
+
+def test_sparse_csr_and_convert():
+    crows, cols, values = [0, 2, 3, 5], [1, 3, 2, 0, 1], [1.0, 2.0, 3.0, 4.0, 5.0]
+    s = sparse.sparse_csr_tensor(crows, cols, values, shape=[3, 4])
+    d = s.to_dense().numpy()
+    assert d[0, 1] == 1 and d[0, 3] == 2 and d[1, 2] == 3 and d[2, 0] == 4 and d[2, 1] == 5
+    coo = s.to_sparse_coo()
+    np.testing.assert_allclose(coo.to_dense().numpy(), d)
+    back = coo.to_sparse_csr()
+    np.testing.assert_allclose(back.to_dense().numpy(), d)
+
+
+def test_sparse_ops():
+    rng = np.random.default_rng(6)
+    a_d = (rng.random((4, 4)) * (rng.random((4, 4)) > 0.5)).astype("float32")
+    b_d = rng.normal(size=(4, 3)).astype("float32")
+    idx = np.array(np.nonzero(a_d))
+    s = sparse.sparse_coo_tensor(idx, a_d[tuple(idx)], shape=[4, 4])
+    # sparse @ dense
+    np.testing.assert_allclose(sparse.matmul(s, paddle.to_tensor(b_d)).numpy(),
+                               a_d @ b_d, rtol=1e-5)
+    # add
+    s2 = sparse.add(s, s)
+    np.testing.assert_allclose(s2.to_dense().numpy(), 2 * a_d, rtol=1e-6)
+    # relu keeps sparsity
+    neg = sparse.sparse_coo_tensor([[0], [0]], [-5.0], shape=[2, 2])
+    np.testing.assert_allclose(sparse.relu(neg).to_dense().numpy(), np.zeros((2, 2)))
+    # sum/transpose
+    np.testing.assert_allclose(sparse.sum(s).numpy(), a_d.sum(), rtol=1e-6)
+    np.testing.assert_allclose(sparse.transpose(s, [1, 0]).to_dense().numpy(), a_d.T)
+
+
+# -------------------------------------------------------------- incubate
+def test_fused_transformer_layers():
+    from paddle_tpu.incubate.nn import (
+        FusedFeedForward,
+        FusedMultiHeadAttention,
+        FusedTransformerEncoderLayer,
+    )
+
+    paddle.seed(0)
+    x = paddle.to_tensor(np.random.default_rng(7).normal(size=(2, 16, 32)).astype("float32"))
+    attn = FusedMultiHeadAttention(32, 4, dropout_rate=0.0, attn_dropout_rate=0.0)
+    attn.eval()
+    out = attn(x)
+    assert out.shape == [2, 16, 32]
+    ffn = FusedFeedForward(32, 64, dropout_rate=0.0)
+    ffn.eval()
+    assert ffn(x).shape == [2, 16, 32]
+    layer = FusedTransformerEncoderLayer(32, 4, 64, dropout_rate=0.0)
+    layer.eval()
+    y = layer(x)
+    assert y.shape == [2, 16, 32]
+    assert np.isfinite(y.numpy()).all()
+    # trains end-to-end
+    layer.train()
+    opt = paddle.optimizer.Adam(learning_rate=1e-3, parameters=layer.parameters())
+    loss = paddle.mean(layer(x) ** 2)
+    loss.backward()
+    opt.step()
+
+
+def test_lookahead_optimizer():
+    from paddle_tpu.incubate.optimizer import LookAhead
+
+    paddle.seed(0)
+    rng = np.random.default_rng(8)
+    net = paddle.nn.Linear(4, 1)
+    inner = paddle.optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+    opt = LookAhead(inner, alpha=0.5, k=3)
+    true_w = rng.normal(size=(4, 1)).astype("float32")
+    losses = []
+    for _ in range(40):
+        x = rng.normal(size=(16, 4)).astype("float32")
+        y = x @ true_w
+        loss = paddle.mean((net(paddle.to_tensor(x)) - paddle.to_tensor(y)) ** 2)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < 0.05 * losses[0]
+
+
+def test_model_average():
+    from paddle_tpu.incubate.optimizer import ModelAverage
+
+    net = paddle.nn.Linear(2, 1)
+    ma = ModelAverage(0.15, parameters=net.parameters())
+    vals = []
+    for v in (1.0, 2.0, 3.0):
+        net.weight.set_value(np.full((2, 1), v, "float32"))
+        ma.step()
+        vals.append(v)
+    with ma.apply():
+        np.testing.assert_allclose(net.weight.numpy(), np.full((2, 1), 2.0), rtol=1e-6)
+    np.testing.assert_allclose(net.weight.numpy(), np.full((2, 1), 3.0), rtol=1e-6)
+
+
+def test_incubate_autotune_config():
+    from paddle_tpu import incubate
+    from paddle_tpu.framework.flags import flag
+
+    incubate.autotune.set_config({"kernel": {"enable": False}})
+    assert flag("FLAGS_use_flash_attention") is False
+    incubate.autotune.set_config({"kernel": {"enable": True}})
+    assert flag("FLAGS_use_flash_attention") is True
+
+
+def test_sparse_matmul_grads_flow():
+    """Regression: sparse @ dense must be differentiable w.r.t. the dense
+    operand (was detached from the tape)."""
+    rng = np.random.default_rng(9)
+    A = np.diag(np.arange(1.0, 5.0)).astype("float32")
+    idx = np.array(np.nonzero(A))
+    s = sparse.sparse_coo_tensor(idx, A[tuple(idx)], shape=[4, 4])
+    W = paddle.to_tensor(np.ones((4, 2), "float32"), stop_gradient=False)
+    loss = paddle.sum(sparse.matmul(s, W))
+    loss.backward()
+    assert W.grad is not None
+    np.testing.assert_allclose(W.grad.numpy(), np.tile(np.arange(1.0, 5.0)[:, None], 2))
