@@ -37,7 +37,10 @@ class Categorical(Distribution):
     def sample(self, shape=()):
         shape = tuple(shape) + self.batch_shape
         idx = jrandom.categorical(split_key(), self._log_p, shape=shape)
-        return _wrap_value(idx)  # default index dtype (int32 without x64)
+        from ..framework.dtype import to_jax_dtype
+
+        # int64 parity policy applied uniformly with argmax/argsort
+        return _wrap_value(idx.astype(to_jax_dtype("int64")))
 
     @staticmethod
     def _gather(table, v):

@@ -80,6 +80,27 @@ def key_tensor():
     return record_op(derive, [_wrap_value(base)], {}, "rng_key")
 
 
+def train_flag_tensor():
+    """Scalar 0/1 "is training" Tensor threaded into recorded rng ops.
+
+    Static capture records it as the reserved ``__train_flag__`` feed so a
+    captured program can be flipped to inference post-hoc — the analog of the
+    reference's ``Program.clone(for_test=True)`` rewriting ops' ``is_test``
+    attr (python/paddle/fluid/framework.py Program.clone). Eager code never
+    reads it (Python ``training`` flags branch before recording).
+    """
+    from .core import _wrap_value
+    from .static_trace import current_program
+
+    prog = current_program()
+    if prog is None:
+        return _wrap_value(jax.numpy.uint32(1), stop_gradient=True)
+    flag = prog.feeds.get("__train_flag__")
+    if flag is None:
+        flag = prog.add_feed("__train_flag__", (), jax.numpy.uint32)
+    return _wrap_value(flag, stop_gradient=True)
+
+
 @contextlib.contextmanager
 def rng_scope(key):
     """Install ``key`` as the RNG source for code executed in this scope.

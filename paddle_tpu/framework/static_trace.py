@@ -59,8 +59,12 @@ def guard_inplace(op_name: str, *tensors) -> None:
                 "use the out-of-place form (e.g. y = x + 1) instead")
 
 
-# stand-in extent for -1/None dims during build-time shape inference
+# stand-in extents for -1/None dims during build-time shape inference.
+# eval_shape runs twice with two distinct probe extents; output dims that
+# differ between probes are recorded as -1 (the reference propagates -1
+# through InferMeta the same way — framework.proto VarDesc dims)
 _DYN_PLACEHOLDER = 4
+_DYN_PLACEHOLDER_B = 8
 
 
 class Op:
@@ -113,6 +117,26 @@ class Program:
 
     def global_block(self):  # reference Program.global_block() parity
         return self
+
+    def clone(self, for_test: bool = False) -> "Program":
+        """Reference Program.clone (framework.py): ``for_test=True`` flips
+        recorded rng ops (dropout etc.) to inference via the reserved
+        ``__train_flag__`` feed instead of rewriting op attrs. Ops/feeds are
+        shared (the recorded list is append-only per version)."""
+        import copy
+
+        c = copy.copy(self)
+        c.id = next(Program._ids)
+        c.ops = list(self.ops)
+        c.feeds = dict(self.feeds)
+        c.grad_vars = dict(self.grad_vars)
+        c.buffer_writes = list(self.buffer_writes)
+        c.for_test = for_test
+        if for_test:  # reference clone(for_test=True) prunes the backward
+            c.optimizer = None
+            c.loss_var = None
+            c.grad_vars = {}
+        return c
 
     def all_parameters(self):
         """Trainable concrete Tensors referenced by recorded ops."""
@@ -203,35 +227,56 @@ def record_op(fn: Callable, args: Sequence[Any], kwargs: Dict[str, Any], name: s
     # dynamic dims (-1 / None in static.data) get a placeholder extent for
     # shape inference only; Executor.run re-traces with the fed shapes, so a
     # new batch size is just a fresh jit specialization (XLA is static-shape)
-    def _spec_shape(shape):
-        return tuple(_DYN_PLACEHOLDER if d < 0 else d for d in shape)
-
     inputs: List[Tuple[str, Any]] = []
-    specs = []
     any_diff = False
+    has_dyn = False
     for a in args:
         if isinstance(a, Tensor):
             v = a._value
             if is_symbolic(v):
                 inputs.append(("sym", v))
-                specs.append(jax.ShapeDtypeStruct(_spec_shape(v.shape), v.dtype))
+                has_dyn = has_dyn or any(d < 0 for d in v.shape)
             else:
                 inputs.append(("tensor", a))
-                specs.append(jax.ShapeDtypeStruct(v.shape, v.dtype))
             if not a.stop_gradient:
                 any_diff = True
         elif is_symbolic(a):
             inputs.append(("sym", a))
-            specs.append(jax.ShapeDtypeStruct(_spec_shape(a.shape), a.dtype))
+            has_dyn = has_dyn or any(d < 0 for d in a.shape)
         else:
             inputs.append(("const", a))
-            specs.append(a)
 
-    out_spec = jax.eval_shape(lambda *xs: fn(*xs, **kwargs), *specs)
+    def _specs_with(ph):
+        specs = []
+        for kind, ref in inputs:
+            if kind == "sym":
+                specs.append(jax.ShapeDtypeStruct(
+                    tuple(ph if d < 0 else d for d in ref.shape), ref.dtype))
+            elif kind == "tensor":
+                v = ref._value
+                specs.append(jax.ShapeDtypeStruct(v.shape, v.dtype))
+            else:
+                specs.append(ref)
+        return specs
+
+    out_spec = jax.eval_shape(lambda *xs: fn(*xs, **kwargs), *_specs_with(_DYN_PLACEHOLDER))
     multi = isinstance(out_spec, (tuple, list))
     out_specs = tuple(out_spec) if multi else (out_spec,)
-    outputs = [SymbolicValue(s.shape, s.dtype, prog.fresh_name(name or "op"))
-               for s in out_specs]
+    out_shapes = [tuple(s.shape) for s in out_specs]
+    if has_dyn:
+        # second probe: output dims that track an input's dynamic dim change
+        # with it — record those as -1 instead of baking the placeholder in
+        try:
+            spec_b = jax.eval_shape(lambda *xs: fn(*xs, **kwargs), *_specs_with(_DYN_PLACEHOLDER_B))
+            specs_b = tuple(spec_b) if isinstance(spec_b, (tuple, list)) else (spec_b,)
+            out_shapes = [
+                tuple(-1 if da != db else da for da, db in zip(sa.shape, sb.shape))
+                for sa, sb in zip(out_specs, specs_b)
+            ]
+        except Exception:
+            pass  # shape fn rejects the probe extent; keep the static guess
+    outputs = [SymbolicValue(shp, s.dtype, prog.fresh_name(name or "op"))
+               for shp, s in zip(out_shapes, out_specs)]
     prog.ops.append(Op(fn, dict(kwargs), inputs, outputs, name or getattr(fn, "__name__", "op")))
 
     wrapped = tuple(_wrap_value(sv, stop_gradient=not any_diff) for sv in outputs)

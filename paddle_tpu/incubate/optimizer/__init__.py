@@ -8,6 +8,7 @@ reference implements them as extra ops appended after the inner update).
 from __future__ import annotations
 
 import jax.numpy as jnp
+import numpy as np
 
 from ...framework.core import Tensor
 from ...optimizer.optimizer import Optimizer
@@ -57,7 +58,31 @@ class LookAhead(Optimizer):
     def state_dict(self):
         out = self.inner_optimizer.state_dict()
         out["lookahead_step"] = self._step_count
+        if self._slow is not None:
+            # slow weights persist like the reference's accumulators; keyed
+            # positionally since id() is not stable across processes
+            order = [id(p) for p in self._params if not p.stop_gradient]
+            out["lookahead_slow"] = [np.asarray(self._slow[i]) for i in order]
         return out
+
+    def set_state_dict(self, state):
+        state = dict(state)
+        self._step_count = int(state.pop("lookahead_step", self._step_count))
+        slow = state.pop("lookahead_slow", None)
+        if slow is not None:
+            trainable = [p for p in self._params if not p.stop_gradient]
+            if len(trainable) != len(slow):
+                raise ValueError(
+                    f"lookahead_slow has {len(slow)} entries but the optimizer "
+                    f"tracks {len(trainable)} trainable params — param list "
+                    "changed since the checkpoint was saved")
+            for p, v in zip(trainable, slow):
+                if tuple(p._value.shape) != tuple(np.shape(v)):
+                    raise ValueError(
+                        f"lookahead_slow shape {np.shape(v)} does not match "
+                        f"param shape {tuple(p._value.shape)}")
+            self._slow = {id(p): jnp.asarray(v) for p, v in zip(trainable, slow)}
+        self.inner_optimizer.set_state_dict(state)
 
     def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
         loss.backward()

@@ -35,18 +35,20 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
     aux = [ensure_tensor(attn_mask)] if attn_mask is not None else []
     if dropping:
         aux.append(_random.key_tensor())
+        aux.append(_random.train_flag_tensor())
     has_mask = attn_mask is not None
 
     def fn(qq, kk, vv, *extra):
         mask = extra[0] if has_mask else None
-        drop_key = extra[-1] if dropping else None
+        drop_key = extra[-2] if dropping else None
+        train = extra[-1] if dropping else None
         return _sdpa_reference(qq, kk, vv, mask, is_causal,
-                               dropout_p if training else 0.0, drop_key)
+                               dropout_p if training else 0.0, drop_key, train)
 
     return op(fn, q, k, v, *aux, _name="sdpa")
 
 
-def _sdpa_reference(q, k, v, mask=None, causal=False, dropout_p=0.0, drop_key=None):
+def _sdpa_reference(q, k, v, mask=None, causal=False, dropout_p=0.0, drop_key=None, train=None):
     # [B, S, H, D] -> [B, H, S, D]
     qh = jnp.swapaxes(q, 1, 2)
     kh = jnp.swapaxes(k, 1, 2)
@@ -65,6 +67,10 @@ def _sdpa_reference(q, k, v, mask=None, causal=False, dropout_p=0.0, drop_key=No
     probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
     if dropout_p > 0.0 and drop_key is not None:
         keep = jax.random.bernoulli(drop_key, 1.0 - dropout_p, probs.shape)
-        probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0).astype(probs.dtype)
+        scale = 1.0 / (1.0 - dropout_p)
+        if train is not None:  # captured program flipped to inference
+            keep = keep | (train == 0)
+            scale = jnp.where(train == 0, 1.0, scale)
+        probs = jnp.where(keep, probs * scale, 0.0).astype(probs.dtype)
     out = jnp.einsum("bhqk,bhkd->bhqd", probs, vh)
     return jnp.swapaxes(out, 1, 2)

@@ -23,15 +23,18 @@ def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=No
         return x if mode == "upscale_in_train" else op(lambda v: v * (1.0 - p), x, _name="dropout_eval")
     axes = None if axis is None else (axis if isinstance(axis, (list, tuple)) else [axis])
 
-    def fn(v, key):
+    def fn(v, key, train):
         shape = tuple(v.shape) if axes is None else tuple(
             s if i in axes else 1 for i, s in enumerate(v.shape))
-        keep = jax.random.bernoulli(key, 1.0 - p, shape)
+        # train==0 (a captured program flipped to inference) keeps everything
+        keep = jax.random.bernoulli(key, 1.0 - p, shape) | (train == 0)
         if mode == "upscale_in_train":
-            return jnp.where(keep, v / (1.0 - p), 0.0).astype(v.dtype)
-        return jnp.where(keep, v, 0.0).astype(v.dtype)
+            scale = jnp.where(train == 0, 1.0, 1.0 / (1.0 - p)).astype(v.dtype)
+            return jnp.where(keep, v * scale, 0.0).astype(v.dtype)
+        out = jnp.where(keep, v, 0.0).astype(v.dtype)
+        return jnp.where(train == 0, (v * (1.0 - p)).astype(v.dtype), out)
 
-    return op(fn, x, _random.key_tensor(), _name="dropout")
+    return op(fn, x, _random.key_tensor(), _random.train_flag_tensor(), _name="dropout")
 
 
 def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
@@ -52,13 +55,14 @@ def alpha_dropout(x, p=0.5, training=True, name=None):
     scale = 1.0507009873554805
     alpha_p = -alpha * scale
 
-    def fn(v, key):
+    def fn(v, key, train):
         keep = jax.random.bernoulli(key, 1.0 - p, tuple(v.shape))
         a = (1.0 / (scale * ((1.0 - p) * (1.0 + p * alpha_p**2)) ** 0.5))
         b = -a * alpha_p * p
-        return a * jnp.where(keep, v, alpha_p) + b
+        out = a * jnp.where(keep, v, alpha_p) + b
+        return jnp.where(train == 0, v, out.astype(v.dtype))
 
-    return op(fn, x, _random.key_tensor(), _name="alpha_dropout")
+    return op(fn, x, _random.key_tensor(), _random.train_flag_tensor(), _name="alpha_dropout")
 
 
 def embedding(x, weight, padding_idx=None, sparse=False, name=None):

@@ -166,7 +166,9 @@ class Executor:
     def __init__(self, place=None):
         self.place = place
         self._cache: Dict[tuple, Any] = {}
-        self._opt_states: Dict[int, Any] = {}
+        # keyed (prog.id, param-identity tuple); at most one live entry per
+        # program — growing a program evicts its stale state
+        self._opt_states: Dict[tuple, Any] = {}
 
     def run(self, program: Optional[Program] = None, feed: Optional[Dict[str, Any]] = None,
             fetch_list: Optional[List] = None, return_numpy: bool = True):
@@ -200,6 +202,8 @@ class Executor:
         if "__rng_key__" in prog.feeds:  # per-run dropout/rng seed (never user-fed)
             self._run_counter = getattr(self, "_run_counter", 0) + 1
             feed_arrays["__rng_key__"] = jnp.uint32(self._run_counter)
+        if "__train_flag__" in prog.feeds:  # clone(for_test=True) flips to 0
+            feed_arrays["__train_flag__"] = jnp.uint32(0 if getattr(prog, "for_test", False) else 1)
         missing = set(prog.feeds) - set(feed_arrays)
         used_feeds = {n for op in prog.ops for kind, ref in op.inputs
                       if kind == "sym" for n in [ref.name] if n in prog.feeds}
@@ -226,11 +230,17 @@ class Executor:
         fn = self._cache[key]
 
         opt = prog.optimizer
-        if train and opt is not None and prog.id not in self._opt_states:
+        # keyed by param identity too: appending ops/params to the program
+        # after a trained run must rebuild the state, not pair the stale
+        # pytree with a different params list
+        opt_key = (prog.id, tuple(id(p) for p in params))
+        if train and opt is not None and opt_key not in self._opt_states:
+            for stale in [k for k in self._opt_states if k[0] == prog.id]:
+                del self._opt_states[stale]
             ptree = {i: p._value for i, p in enumerate(params)}
-            self._opt_states[prog.id] = {"opt": opt.core.init(ptree),
+            self._opt_states[opt_key] = {"opt": opt.core.init(ptree),
                                          "step": jnp.zeros((), jnp.int32)}
-        state = self._opt_states.get(prog.id) if train and opt is not None else None
+        state = self._opt_states.get(opt_key) if train and opt is not None else None
 
         param_vals = tuple(p._value for p in params)
         other_vals = tuple(t._value for t in others)
@@ -238,7 +248,7 @@ class Executor:
         if train and opt is not None:
             for p, v in zip(params, new_params):
                 p._value = v
-            self._opt_states[prog.id] = new_state
+            self._opt_states[opt_key] = new_state
         for buf, sym in prog.buffer_writes:  # commit running-stat updates
             if sym.name in buf_updates:
                 buf._value = buf_updates[sym.name]
@@ -318,6 +328,11 @@ def save_inference_model(path_prefix: str, feed_vars: List[Tensor], fetch_vars: 
 
     def infer_fn(*feeds):
         env = dict(zip(feed_names, feeds))
+        if "__rng_key__" in prog.feeds and "__rng_key__" not in env:
+            env["__rng_key__"] = jnp.uint32(0)
+        if "__train_flag__" in prog.feeds and "__train_flag__" not in env:
+            # export is inference: recorded rng ops (dropout) become identity
+            env["__train_flag__"] = jnp.uint32(0)
         env = prog.interpret(env, dict(zip(ref_ids, ref_vals)))
         return tuple(env[n] for n in fetch_names)
 

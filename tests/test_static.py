@@ -278,3 +278,104 @@ def test_jit_save_load_translated_layer(tmp_path):
             model(paddle.to_tensor(xv)).numpy(), rtol=2e-5, atol=2e-6)
     with pytest.raises(RuntimeError):
         loaded.train()
+
+
+def test_dynamic_dims_propagate_not_baked():
+    """ADVICE r1 (high): -1 dims must propagate through recorded op shapes
+    instead of baking the eval_shape placeholder extent in."""
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [None, 16, 8], "float32")
+        h = paddle.sum(x, axis=[1, 2])
+        assert h._value.shape == (-1,), h._value.shape
+        y = paddle.reshape(x, [x.shape[0], 128])  # shape-reading builder
+        assert y._value.shape == (-1, 128), y._value.shape
+    exe = static.Executor()
+    xv = np.random.default_rng(0).normal(size=(16, 16, 8)).astype("float32")
+    (out,) = exe.run(prog, feed={"x": xv}, fetch_list=[y])
+    assert out.shape == (16, 128)
+    np.testing.assert_allclose(out, xv.reshape(16, 128))
+
+
+def test_fused_mha_static_capture_dynamic_batch():
+    """ADVICE r1 repro: FusedMultiHeadAttention(normalize_before=True) under
+    static capture with a dynamic batch dim."""
+    from paddle_tpu.incubate.nn import FusedMultiHeadAttention
+
+    paddle.seed(5)
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [None, 16, 8], "float32")
+        m = FusedMultiHeadAttention(8, 2, normalize_before=True)
+        y = m(x)
+    exe = static.Executor()
+    for bs in (4, 7):
+        xv = np.random.default_rng(bs).normal(size=(bs, 16, 8)).astype("float32")
+        (out,) = exe.run(prog, feed={"x": xv}, fetch_list=[y])
+        assert out.shape == (bs, 16, 8)
+
+
+def test_save_inference_model_train_mode_rng(tmp_path):
+    """ADVICE r1: export of a program captured with train-mode dropout must
+    bind the reserved __rng_key__ feed instead of raising KeyError."""
+    paddle.seed(3)
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [None, 6], "float32")
+        lin = paddle.nn.Linear(6, 4)
+        drop = paddle.nn.Dropout(0.5)
+        y = drop(lin(x))
+    assert "__rng_key__" in prog.feeds  # dropout recorded an rng read
+    prefix = str(tmp_path / "train_mode_export")
+    static.save_inference_model(prefix, [x], [y], program=prog)
+    run, feeds, fetches = static.load_inference_model(prefix)
+    xv = np.random.default_rng(1).normal(size=(3, 6)).astype("float32")
+    (out,) = run(xv)
+    # exported dropout must be IDENTITY, not a frozen train-mode mask
+    expect = lin(paddle.to_tensor(xv)).numpy()
+    np.testing.assert_allclose(np.asarray(out), expect, rtol=2e-5, atol=2e-6)
+
+
+def test_program_clone_for_test_dropout_identity():
+    """Program.clone(for_test=True) parity: recorded dropout flips to
+    identity via the __train_flag__ feed (reference rewrites is_test attrs)."""
+    paddle.seed(9)
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [None, 8], "float32")
+        lin = paddle.nn.Linear(8, 8)
+        y = paddle.nn.Dropout(0.5)(lin(x))
+    test_prog = prog.clone(for_test=True)
+    exe = static.Executor()
+    xv = np.random.default_rng(4).normal(size=(5, 8)).astype("float32")
+    (train_out,) = exe.run(prog, feed={"x": xv}, fetch_list=[y])
+    (test_out,) = exe.run(test_prog, feed={"x": xv}, fetch_list=[y])
+    expect = lin(paddle.to_tensor(xv)).numpy()
+    np.testing.assert_allclose(test_out, expect, rtol=2e-5, atol=2e-6)
+    assert np.any(train_out == 0.0)  # train path still actually drops
+
+
+def test_executor_opt_state_rebuilt_on_program_growth():
+    """ADVICE r1: _opt_states must be invalidated when params are appended."""
+    import paddle_tpu.optimizer as opt
+
+    paddle.seed(7)
+    prog = static.Program()
+    exe = static.Executor()
+    xv = np.random.default_rng(2).normal(size=(4, 6)).astype("float32")
+    with static.program_guard(prog):
+        x = static.data("x", [None, 6], "float32")
+        l1 = paddle.nn.Linear(6, 6)
+        h = l1(x)
+        loss = paddle.mean(h)
+        sgd = opt.Adam(learning_rate=1e-3)
+        sgd.minimize(loss)
+    exe.run(prog, feed={"x": xv}, fetch_list=[loss])
+    with static.program_guard(prog):
+        l2 = paddle.nn.Linear(6, 1)
+        loss2 = paddle.mean(l2(h))
+        prog.loss_var = loss2._value
+        prog.grad_vars = {}
+        static.append_backward(loss2)
+    (v,) = exe.run(prog, feed={"x": xv}, fetch_list=[loss2])  # must not crash
+    assert np.isfinite(v)
