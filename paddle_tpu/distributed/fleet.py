@@ -76,8 +76,16 @@ class Fleet:
         return optimizer
 
     # -- the TPU-native training entry ------------------------------------
-    def distributed_step(self, model, optimizer, loss_fn, seed=0):
-        """Build a sharded jit TrainStep per the active DistributedStrategy."""
+    def distributed_step(self, model, optimizer, loss_fn, seed=0, batch_sharding=None):
+        """Build a sharded jit TrainStep per the active DistributedStrategy.
+
+        Consumes every strategy knob: hybrid degrees (mesh), sharding stage
+        (ZeRO specs), recompute, amp_configs (TrainStep amp_level/dtype),
+        pipeline accumulate_steps (microbatch count for the pp trunk), and
+        gradient_merge (lax.scan grad accumulation). Inputs default to
+        batch-dim sharding over dp×sdp — the per-rank feed split the
+        reference does in fleet/utils/hybrid_parallel_util.py:111.
+        """
         from ..jit import TrainStep
 
         assert self._hcg is not None, "call fleet.init(strategy=...) first"
@@ -85,13 +93,25 @@ class Fleet:
         strat = self._strategy
         stage = strat.sharding_configs.sharding_stage if (strat.sharding or strat.hybrid_configs.sharding_degree > 1) else 0
         remat = strat.recompute or strat.recompute_configs.enable
+        amp_level = strat.amp_configs.level if (strat.amp or strat.amp_configs.enable) else None
+        amp_dtype = strat.amp_configs.dtype if amp_level else "bfloat16"
+        accumulate = 1
+        if strat.gradient_merge:
+            accumulate = int(strat.gradient_merge_configs.get("k_steps", 1))
+        elif strat.hybrid_configs.pp_degree == 1:
+            # pipeline_configs.accumulate_steps doubles as grad accumulation
+            # when there is no pipeline to microbatch (reference semantics)
+            accumulate = int(strat.pipeline_configs.accumulate_steps)
 
-        # mp/expert specs collected from annotated parameters
+        # mp/pp specs collected from annotated parameters
         mp_specs = {name: p.dist_spec for name, p in model.named_parameters() if getattr(p, "dist_spec", None) is not None}
 
-        step = TrainStep(model, optimizer, loss_fn, remat=remat, seed=seed)
+        step = TrainStep(model, optimizer, loss_fn, remat=remat, seed=seed,
+                         amp_level=amp_level, amp_dtype=amp_dtype, accumulate_steps=accumulate)
         shardings = state_shardings(step.state, mesh, stage=stage, mp_specs=mp_specs)
-        batch_sharding = None  # leaves XLA free; inputs pre-placed by caller
+        if batch_sharding is None:
+            # default: every batch leaf sharded on dim0 over the data axes
+            batch_sharding = NamedSharding(mesh, P(("dp", "sdp")))
         step.mesh = mesh
         step.state = jax.device_put(step.state, shardings)
         step._jit = jax.jit(step._step, donate_argnums=0, in_shardings=(shardings, batch_sharding), out_shardings=(shardings, None))

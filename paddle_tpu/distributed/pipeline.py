@@ -8,45 +8,69 @@ activations move between stages with ``lax.ppermute`` (the collective-permute
 analog of send_v2/recv_v2) inside a ``lax.fori_loop`` schedule. Autodiff
 through ppermute gives the backward pipeline for free (its transpose is the
 reverse permute), so fwd+bwd is one XLA computation — no host-driven 1F1B
-interleave, no interceptor runtime (fleet_executor/). Memory behaves like
-GPipe; combine with remat (jax.checkpoint on stage_fn) for 1F1B-like
-footprints.
+interleave, no interceptor runtime (fleet_executor/). The shard_map is
+*partial-manual* (``axis_names={'pp'}``): only the pipeline axis is manual,
+so dp/sdp batch sharding and mp tensor parallelism inside each stage remain
+GSPMD-automatic and compose with the pipeline. Memory behaves like GPipe;
+combine with remat (per-layer jax.checkpoint) for 1F1B-like footprints.
 """
 from __future__ import annotations
 
 import functools
-from typing import Any, Callable, List
+from typing import Any, Callable, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
-def spmd_pipeline(stage_fn: Callable, stacked_params: Any, x_mb: jnp.ndarray, mesh: Mesh, axis: str = "pp", remat: bool = False):
+def spmd_pipeline(stage_fn: Callable, stacked_params: Any, x_mb: jnp.ndarray, mesh: Mesh, axis: str = "pp", remat: bool = False, extras: Tuple = (), mb_index: bool = False):
     """Run ``stage_fn`` as an ``n_stages``-deep pipeline over microbatches.
 
-    stage_fn(local_params, x) -> y with y.shape == x.shape
-    stacked_params: pytree; every leaf has leading dim n_stages
-    x_mb: [n_micro, micro_batch, ...] microbatched input (replicated)
-    returns [n_micro, micro_batch, ...] outputs of the final stage (replicated)
+    stage_fn(layer_params, x, *extras) -> y applies ONE layer; y.shape == x.shape.
+    stacked_params: pytree; every leaf has leading dim L (the total layer
+        count), a multiple of ``n_stages``. Stage ``s`` holds layers
+        [s*L/n, (s+1)*L/n) and scans ``stage_fn`` over them.
+    x_mb: [n_micro, micro_batch, ...] microbatched input (replicated over
+        ``axis``; dp/mp sharding of the trailing dims stays automatic).
+    extras: arrays passed through to every stage_fn call (e.g. dropout keys).
+    mb_index: if True, stage_fn is called as
+        stage_fn(layer_params, x, mb_idx, *extras) with the scalar microbatch
+        index being processed — needed e.g. to draw distinct dropout masks
+        per microbatch.
+    returns [n_micro, micro_batch, ...] outputs of the final stage.
     """
     n_stages = mesh.shape[axis]
     n_micro = x_mb.shape[0]
-    if remat:
-        stage_fn = jax.checkpoint(stage_fn)
+    leaves = jax.tree_util.tree_leaves(stacked_params)
+    n_layers = leaves[0].shape[0]
+    assert n_layers % n_stages == 0, f"{n_layers} layers not divisible by {n_stages} stages"
+    body = jax.checkpoint(stage_fn) if remat else stage_fn
 
-    def per_stage(params_local, x):
-        params_local = jax.tree_util.tree_map(lambda p: jnp.squeeze(p, 0), params_local)
+    def apply_stage(params_local, h, mb, extra):
+        def scan_body(hh, lp):
+            if mb_index:
+                return body(lp, hh, mb, *extra), None
+            return body(lp, hh, *extra), None
+
+        h, _ = jax.lax.scan(scan_body, h, params_local)
+        return h
+
+    def per_stage(params_local, x, *extra):
         stage_id = jax.lax.axis_index(axis)
         perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
-        state = jnp.zeros_like(x[0])
-        outputs = jnp.zeros_like(x)
+        # carries are per-stage values: mark them device-varying over 'pp'
+        state = jax.lax.pcast(jnp.zeros_like(x[0]), (axis,), to="varying")
+        outputs = jax.lax.pcast(jnp.zeros_like(x), (axis,), to="varying")
 
         def tick(t, carry):
             state, outputs = carry
             mb_in = jax.lax.dynamic_index_in_dim(x, jnp.clip(t, 0, n_micro - 1), axis=0, keepdims=False)
             inp = jnp.where(stage_id == 0, mb_in, state)
-            out = stage_fn(params_local, inp)
+            # the microbatch flowing through stage s at tick t entered at
+            # tick t-s: that index keys per-microbatch randomness
+            mb = jnp.clip(t - stage_id, 0, n_micro - 1)
+            out = apply_stage(params_local, inp, mb, extra)
             out_t = t - (n_stages - 1)
             write = (stage_id == n_stages - 1) & (out_t >= 0)
             upd = jax.lax.dynamic_update_index_in_dim(outputs, out, jnp.clip(out_t, 0, n_micro - 1), axis=0)
@@ -55,20 +79,68 @@ def spmd_pipeline(stage_fn: Callable, stacked_params: Any, x_mb: jnp.ndarray, me
             return state, outputs
 
         state, outputs = jax.lax.fori_loop(0, n_micro + n_stages - 1, tick, (state, outputs))
-        # make outputs replicated across the pp axis (only last stage wrote)
+        # broadcast the last stage's outputs across the pp axis
         src = n_stages - 1
         outputs = jax.lax.psum(jnp.where(jax.lax.axis_index(axis) == src, outputs, jnp.zeros_like(outputs)), axis)
         return outputs
 
-    param_specs = jax.tree_util.tree_map(lambda p: P(axis, *([None] * (p.ndim - 1))), stacked_params)
+    param_specs = jax.tree_util.tree_map(lambda p: P(axis), stacked_params)
     mapped = jax.shard_map(
         per_stage,
         mesh=mesh,
-        in_specs=(param_specs, P()),
+        in_specs=(param_specs, P()) + tuple(P() for _ in extras),
         out_specs=P(),
-        check_vma=False,
+        axis_names={axis},
     )
-    return mapped(stacked_params, x_mb)
+    return mapped(stacked_params, x_mb, *extras)
+
+
+def microbatch(x: jnp.ndarray, n_micro: int, mesh: Optional[Mesh] = None):
+    """[B, ...] -> [n_micro, B/n_micro, ...] with microbatch i = rows i::n_micro.
+
+    The strided assignment keeps each device's dp-shard of the batch local:
+    reshape [B] -> [B/n_micro, n_micro] splits within each device's contiguous
+    block, so no cross-device resharding (the contiguous-chunk reshape would
+    reassign rows across the dp axis).
+    """
+    b = x.shape[0]
+    assert b % n_micro == 0, f"batch {b} not divisible by {n_micro} microbatches"
+    xm = jnp.swapaxes(x.reshape(b // n_micro, n_micro, *x.shape[1:]), 0, 1)
+    if mesh is not None:
+        xm = jax.lax.with_sharding_constraint(xm, NamedSharding(mesh, P(None, ("dp", "sdp"))))
+    return xm
+
+
+def unmicrobatch(xm: jnp.ndarray, mesh: Optional[Mesh] = None):
+    """Inverse of :func:`microbatch`."""
+    n_micro, mb = xm.shape[0], xm.shape[1]
+    x = jnp.swapaxes(xm, 0, 1).reshape(n_micro * mb, *xm.shape[2:])
+    if mesh is not None:
+        x = jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(("dp", "sdp"))))
+    return x
+
+
+def active_pipeline_plan():
+    """(mesh, n_micro) for the live fleet pipeline, or (None, 1).
+
+    Consumes ``strategy.pipeline_configs.accumulate_steps`` (parity:
+    distributed_strategy.proto pipeline micro_batch config) — the piece
+    fleet.distributed_step routes into the model trunk.
+    """
+    from .fleet import fleet
+
+    if fleet._hcg is None:
+        return None, 1
+    mesh = fleet._hcg.mesh
+    pp = mesh.shape.get("pp", 1)
+    if pp <= 1:
+        return None, 1
+    n_micro = 1
+    if fleet._strategy is not None:
+        n_micro = int(fleet._strategy.pipeline_configs.accumulate_steps)
+    if n_micro <= 1:
+        n_micro = 2 * pp  # default: enough microbatches to keep bubbles ~1/3
+    return mesh, n_micro
 
 
 class LayerDesc:
@@ -114,23 +186,108 @@ class SegmentLayers:
 
 
 class PipelineLayer:
-    """Parity: PipelineLayer (pp_layers.py:162). Holds the LayerDesc list and
-    segment boundaries; the jit path consumes the stacked-parameter form via
-    spmd_pipeline. Provided for API compat — the TPU-first way to write a
-    pipelined model is a homogeneous stacked-block trunk (see
-    models/gpt.py GPTModel, whose blocks already live on a stacked leading
-    axis ready to shard over 'pp')."""
+    """Parity: PipelineLayer (pp_layers.py:162).
+
+    TPU-first execution: when the built layers form a *homogeneous* run (same
+    class, identical parameter shapes — the GPT/BERT trunk pattern) and a
+    fleet mesh with pp>1 is live, their parameters are stacked on a leading
+    axis and the run executes through :func:`spmd_pipeline`, microbatched and
+    genuinely pipelined over the 'pp' axis. Heterogeneous prefix/suffix
+    layers (embedding, head) run replicated across stages — the analog of
+    the reference's shared first/last-stage layers. Without a pp mesh the
+    layers run sequentially (single-stage pipeline).
+    """
 
     def __init__(self, layers, num_stages=None, topology=None, loss_fn=None, seg_method="uniform", recompute_interval=0, **kwargs):
         self.descs = layers
         self.num_stages = num_stages
         self.loss_fn = loss_fn
+        self.recompute_interval = recompute_interval
         self.segments = SegmentLayers(layers, num_stages or 1).do_segment()
         self.built = [d.build_layer() if isinstance(d, LayerDesc) else d for d in layers]
+        self._homo = self._homogeneous_run()
+
+    def _homogeneous_run(self):
+        """Longest run [i, j) of built layers with identical class + param
+        shape signature — the pipelinable trunk."""
+        from ..nn.layer.base import Layer
+
+        def sig(l):
+            if not isinstance(l, Layer):
+                return None
+            shapes = tuple((n, tuple(p.shape)) for n, p in sorted(l.named_parameters()))
+            return (type(l), shapes)
+
+        sigs = [sig(l) for l in self.built]
+        best = (0, 0)
+        i = 0
+        while i < len(sigs):
+            if sigs[i] is None:
+                i += 1
+                continue
+            j = i
+            while j < len(sigs) and sigs[j] == sigs[i]:
+                j += 1
+            if j - i > best[1] - best[0]:
+                best = (i, j)
+            i = j
+        return best
 
     def forward(self, x):
-        for layer in self.built:
+        mesh, n_micro = active_pipeline_plan()
+        lo, hi = self._homo
+        n_run = hi - lo
+        pipelined = (
+            mesh is not None
+            and n_run >= 2
+            and n_run % mesh.shape["pp"] == 0
+        )
+        if not pipelined:
+            for layer in self.built:
+                x = layer(x) if callable(layer) else x
+            return x
+
+        from ..framework.core import Tensor, unwrap
+        from ..tensor._helpers import ensure_tensor, op
+
+        for layer in self.built[:lo]:
+            x = layer(x) if callable(layer) else x
+
+        run = self.built[lo:hi]
+        # stack homogeneous params: leaf k = stack of layer-i's k-th param
+        names = [n for n, _ in sorted(run[0].named_parameters())]
+        stacked_tensors = []
+        for n in names:
+            per_layer = [dict(sorted(l.named_parameters()))[n] for l in run]
+            stacked_tensors.append(per_layer)
+        proto = run[0]
+
+        def fn(xx, *flat):
+            import jax.numpy as jnp
+
+            stacks = [jnp.stack(flat[i * n_run:(i + 1) * n_run]) for i in range(len(names))]
+
+            def stage_fn(lp, h):
+                arrays = dict(zip(names, lp))
+                with proto.bind(arrays):
+                    out = proto(ensure_tensor(h))
+                return unwrap(out)
+
+            xm = microbatch(xx, n_micro, mesh)
+            out = spmd_pipeline(stage_fn, tuple(stacks), xm, mesh, remat=self.recompute_interval > 0)
+            return unmicrobatch(out, mesh)
+
+        flat = [p for group in stacked_tensors for p in group]
+        x = op(fn, ensure_tensor(x), *flat, _name="pipeline_layer")
+        for layer in self.built[hi:]:
             x = layer(x) if callable(layer) else x
         return x
 
     __call__ = forward
+
+    def parameters(self):
+        out = []
+        for l in self.built:
+            if hasattr(l, "parameters"):
+                out.extend(l.parameters())
+        return out

@@ -20,9 +20,18 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
-def _extend_spec(spec: Optional[P], shape, axis_size: int, axis_name="sdp", min_size=16384) -> P:
-    """Add axis_name sharding on the largest dim not already sharded and
-    divisible by axis_size. Small params stay replicated."""
+def _extend_spec(spec: Optional[P], shape, axis_size: int, axis_name="sdp", min_size=16384, mesh=None) -> P:
+    """Add ``axis_name`` (ZeRO) sharding to a param/opt spec.
+
+    Preference order:
+    1. Compose with an already-sharded dim: a dim carrying 'mp' becomes
+       ('mp', 'sdp'). This keeps the ZeRO split aligned with the TP split,
+       so grads reduce-scatter along the dim that is already model-parallel
+       — sharding a *fresh* (hidden) dim instead pulls activations toward
+       hidden-sharded layouts and triggers XLA's "Involuntary full
+       rematerialization" reshards (VERDICT r2 bug).
+    2. Otherwise the largest unsharded dim divisible by axis_size.
+    Small params stay replicated."""
     base = list(spec) if spec is not None else [None] * len(shape)
     while len(base) < len(shape):
         base.append(None)
@@ -34,7 +43,25 @@ def _extend_spec(spec: Optional[P], shape, axis_size: int, axis_name="sdp", min_
 
     if axis_size <= 1 or int(np.prod(shape)) < min_size:
         return canon(base)
-    # pick largest eligible dim
+
+    def axes_of(entry):
+        if entry is None:
+            return ()
+        return tuple(entry) if isinstance(entry, (tuple, list)) else (entry,)
+
+    def size_of(axes):
+        return int(np.prod([mesh.shape.get(a, 1) for a in axes]))
+
+    # 1. compose with an existing model-parallel dim ('pp' stacking axes are
+    #    layer indices, not tensor dims to subdivide further)
+    for i in range(len(shape)):
+        ax = axes_of(base[i])
+        if ax and "pp" not in ax and axis_name not in ax:
+            existing = size_of(ax) if mesh is not None else 0
+            if existing and shape[i] % (existing * axis_size) == 0:
+                base[i] = ax + (axis_name,)
+                return canon(base)
+    # 2. a fresh dim
     cand = [
         (shape[i], i)
         for i in range(len(shape))
@@ -57,12 +84,12 @@ def build_state_specs(params: Dict[str, np.ndarray], mesh: Mesh, stage: int = 1,
         base = mp_specs.get(name)
         shape = tuple(arr.shape)
         if stage >= 3:
-            spec = _extend_spec(base, shape, sdp)
+            spec = _extend_spec(base, shape, sdp, mesh=mesh)
         else:
             spec = P(*base) if base is not None else P()
         param_specs[name] = spec
         if stage >= 1:
-            opt_specs[name] = _extend_spec(base, shape, sdp)
+            opt_specs[name] = _extend_spec(base, shape, sdp, mesh=mesh)
         else:
             opt_specs[name] = spec
     return param_specs, opt_specs

@@ -56,11 +56,12 @@ class TrainStep:
     inplace/vars GC in interpretercore; here it's XLA buffer donation).
     """
 
-    def __init__(self, model, optimizer, loss_fn, mesh=None, state_shardings=None, batch_shardings=None, remat=False, seed=0, amp_level=None, amp_dtype="bfloat16"):
+    def __init__(self, model, optimizer, loss_fn, mesh=None, state_shardings=None, batch_shardings=None, remat=False, seed=0, amp_level=None, amp_dtype="bfloat16", accumulate_steps=1):
         self.model = model
         self.optimizer = optimizer
         self.loss_fn = loss_fn
         self.mesh = mesh
+        self.accumulate_steps = int(accumulate_steps)
         # AMP (reference amp.decorate semantics, bf16-first for TPU).
         # O2: master params stay f32 in state; compute casts params+inputs to
         #     amp_dtype so matmuls hit the MXU at bf16; loss input back to f32.
@@ -120,11 +121,15 @@ class TrainStep:
                     ctx = contextlib.nullcontext()
                 with ctx:
                     out, new_buffers = _pure_model_call(model, {**p, **buffers}, inputs_c, {}, True, rng)
-                if amp_dt is not None:
-                    out = _to_f32(out)  # loss math in f32 (amp black list)
                 with no_grad():
                     loss_t = loss_fn(*_wrap_tree([out]), *_wrap_tree(list(labels)))
-                return unwrap(loss_t), (out, new_buffers)
+                loss_v = unwrap(loss_t)
+                if amp_dt is not None and loss_v.dtype == amp_dt:
+                    # loss scalar in f32 (amp black list); the loss fns do
+                    # their reductions in f32 internally — logits stay bf16,
+                    # which avoids materializing an f32 [..., vocab] tensor
+                    loss_v = loss_v.astype(jnp.float32)
+                return loss_v, (out, new_buffers)
 
             if remat:
                 # rematerialize the forward in backward (paddle recompute /
@@ -132,12 +137,40 @@ class TrainStep:
                 call = jax.checkpoint(call)
             return call(params)
 
+        k = self.accumulate_steps
+
         def _step(state, batch):
             inputs, labels = batch
             rng = jax.random.fold_in(state["rng"], state["step"])
-            (loss, (out, new_buffers)), grads = jax.value_and_grad(loss_of, has_aux=True)(
-                state["params"], state["buffers"], inputs, labels, rng
-            )
+            if k <= 1:
+                (loss, (out, new_buffers)), grads = jax.value_and_grad(loss_of, has_aux=True)(
+                    state["params"], state["buffers"], inputs, labels, rng
+                )
+            else:
+                # gradient merge (parity: fleet/meta_optimizers/
+                # gradient_merge_optimizer.py): k microbatches through a
+                # lax.scan, summed grads, one optimizer update. The strided
+                # microbatch split (rows [i::k]) is shared with the pipeline.
+                from ..distributed.pipeline import microbatch
+
+                mb_in = jax.tree_util.tree_map(lambda a: microbatch(a, k), inputs)
+                mb_lb = jax.tree_util.tree_map(lambda a: microbatch(a, k), labels)
+                zeros = jax.tree_util.tree_map(jnp.zeros_like, state["params"])
+
+                def acc(carry, xs):
+                    gsum, lsum, buffers = carry
+                    i, mi, ml = xs
+                    (l, (_, nb)), g = jax.value_and_grad(loss_of, has_aux=True)(
+                        state["params"], buffers, mi, ml, jax.random.fold_in(rng, i)
+                    )
+                    gsum = jax.tree_util.tree_map(jnp.add, gsum, g)
+                    return (gsum, lsum + l, nb), None
+
+                (gsum, lsum, new_buffers), _ = jax.lax.scan(
+                    acc, (zeros, jnp.zeros((), jnp.float32), state["buffers"]),
+                    (jnp.arange(k), mb_in, mb_lb))
+                grads = jax.tree_util.tree_map(lambda g: g / k, gsum)
+                loss = lsum / k
             new_params, new_opt, lr = optimizer._traced_update(
                 grads, state["opt"], state["params"], state["step"])
             new_state = {

@@ -8,6 +8,11 @@ from ...tensor._helpers import Tensor, ensure_tensor, op, unwrap
 
 
 def _reduce(v, reduction):
+    """Reductions accumulate in f32: under AMP O2 the per-element losses
+    arrive in bf16 and a bf16 mean over millions of terms loses ~5 digits;
+    the cast fuses into the reduce so nothing extra materializes."""
+    if v.dtype in (jnp.bfloat16, jnp.float16):
+        v = v.astype(jnp.float32)
     if reduction == "mean":
         return jnp.mean(v)
     if reduction == "sum":
@@ -15,11 +20,73 @@ def _reduce(v, reduction):
     return v
 
 
+def _fused_softmax_ce(logits, lab, axis):
+    """Per-token hard-label CE with a hand-rolled vjp.
+
+    The naive form (log_softmax → take_along_axis) materializes an f32
+    [..., V] log-prob tensor and scatters in backward — ~2.5GB of HBM
+    traffic per step at GPT vocab sizes (profiled, see BASELINE.md). Here
+    the forward keeps only row reductions (logsumexp) + a label gather, and
+    the backward rebuilds softmax from the saved logits dtype (bf16 under
+    AMP) with the one-hot expressed as an iota compare — no scatter, no f32
+    [..., V] tensor anywhere. Parity: the fused
+    softmax_with_cross_entropy CUDA kernel (operators/softmax_with_cross_entropy_op.cu).
+    """
+    ax = axis % logits.ndim
+    labx = jnp.expand_dims(lab, ax)
+
+    def _lse(lg):
+        m = jnp.max(lg, axis=ax, keepdims=True)
+        se = jnp.sum(jnp.exp(lg.astype(jnp.float32) - m.astype(jnp.float32)), axis=ax, keepdims=True)
+        return jnp.log(se) + m.astype(jnp.float32)
+
+    @jax.custom_vjp
+    def ce(lg):
+        lab_logit = jnp.take_along_axis(lg, labx, axis=ax).astype(jnp.float32)
+        return (_lse(lg) - lab_logit).squeeze(ax)
+
+    def fwd(lg):
+        lse = _lse(lg)
+        lab_logit = jnp.take_along_axis(lg, labx, axis=ax).astype(jnp.float32)
+        return (lse - lab_logit).squeeze(ax), (lg, lse)
+
+    def bwd(res, g):
+        lg, lse = res
+        gx = jnp.expand_dims(g, ax).astype(jnp.float32)
+        p = jnp.exp(lg.astype(jnp.float32) - lse)
+        iota = jax.lax.broadcasted_iota(labx.dtype, lg.shape, ax)
+        dlg = (p - (iota == labx).astype(jnp.float32)) * gx
+        return (dlg.astype(lg.dtype),)
+
+    ce.defvjp(fwd, bwd)
+    return ce(logits)
+
+
 def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean", soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0, name=None):
     aux = [ensure_tensor(weight)] if weight is not None else []
 
     def fn(logits, lbl, *ws):
         w = ws[0] if ws else None
+        if not soft_label and use_softmax and label_smoothing == 0.0:
+            lab = lbl
+            if lab.ndim == logits.ndim and lab.shape[axis] == 1:
+                lab = jnp.squeeze(lab, axis=axis)
+            lab = lab.astype(jnp.int32)
+            loss = _fused_softmax_ce(logits, lab, axis)
+            valid = lab != ignore_index
+            loss = jnp.where(valid, loss, 0.0)
+            if w is not None:
+                loss = loss * jnp.take(w, jnp.maximum(lab, 0))
+            if reduction == "mean":
+                denom = jnp.maximum(jnp.sum(valid.astype(loss.dtype)), 1.0) if w is None else jnp.sum(
+                    jnp.where(valid, jnp.take(w, jnp.maximum(lab, 0)), 0.0)
+                )
+                return jnp.sum(loss) / denom
+            return _reduce(loss, reduction)
+        # non-fused fallback (soft labels / smoothing / pre-softmaxed input):
+        # full f32 log-probs, matching the pre-AMP-change numerics
+        if logits.dtype in (jnp.bfloat16, jnp.float16):
+            logits = logits.astype(jnp.float32)
         logp = jax.nn.log_softmax(logits, axis=axis) if use_softmax else jnp.log(jnp.maximum(logits, 1e-30))
         if soft_label:
             loss = -jnp.sum(lbl * logp, axis=axis)
@@ -28,13 +95,10 @@ def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean"
             if lab.ndim == logits.ndim and lab.shape[axis] == 1:
                 lab = jnp.squeeze(lab, axis=axis)
             lab = lab.astype(jnp.int32)
-            if label_smoothing > 0.0:
-                n = logits.shape[axis]
-                onehot = jax.nn.one_hot(lab, n, dtype=logp.dtype, axis=axis)
-                smoothed = onehot * (1.0 - label_smoothing) + label_smoothing / n
-                loss = -jnp.sum(smoothed * logp, axis=axis)
-            else:
-                loss = -jnp.take_along_axis(logp, jnp.expand_dims(lab, axis), axis=axis).squeeze(axis)
+            n = logits.shape[axis]
+            onehot = jax.nn.one_hot(lab, n, dtype=logp.dtype, axis=axis)
+            smoothed = onehot * (1.0 - label_smoothing) + label_smoothing / n
+            loss = -jnp.sum(smoothed * logp, axis=axis)
             valid = lab != ignore_index
             loss = jnp.where(valid, loss, 0.0)
             if w is not None:
@@ -58,17 +122,22 @@ def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-10
     return out
 
 
+def _f32(x):
+    """Upcast low-precision inputs for loss math (fuses into the consumer)."""
+    return x.astype(jnp.float32) if x.dtype in (jnp.bfloat16, jnp.float16) else x
+
+
 def mse_loss(input, label, reduction="mean", name=None):
-    return op(lambda a, b: _reduce(jnp.square(a - b), reduction), ensure_tensor(input), ensure_tensor(label), _name="mse_loss")
+    return op(lambda a, b: _reduce(jnp.square(_f32(a) - _f32(b)), reduction), ensure_tensor(input), ensure_tensor(label), _name="mse_loss")
 
 
 def l1_loss(input, label, reduction="mean", name=None):
-    return op(lambda a, b: _reduce(jnp.abs(a - b), reduction), ensure_tensor(input), ensure_tensor(label), _name="l1_loss")
+    return op(lambda a, b: _reduce(jnp.abs(_f32(a) - _f32(b)), reduction), ensure_tensor(input), ensure_tensor(label), _name="l1_loss")
 
 
 def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
     def fn(a, b):
-        d = a - b
+        d = _f32(a) - _f32(b)
         absd = jnp.abs(d)
         loss = jnp.where(absd < delta, 0.5 * d * d / delta, absd - 0.5 * delta)
         return _reduce(loss, reduction)
@@ -101,7 +170,7 @@ def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None)
     aux = [ensure_tensor(weight)] if weight is not None else []
 
     def fn(p, y, *ws):
-        p2 = jnp.clip(p, 1e-12, 1.0 - 1e-7)
+        p2 = jnp.clip(_f32(p), 1e-12, 1.0 - 1e-7)
         loss = -(y * jnp.log(p2) + (1.0 - y) * jnp.log(1.0 - p2))
         if ws:
             loss = loss * ws[0]
@@ -115,6 +184,7 @@ def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean"
     has_pw, has_w = pos_weight is not None, weight is not None
 
     def fn(z, y, *extra):
+        z = _f32(z)
         if has_pw:
             pw = extra[0]
             loss = (1 - y) * z + (1 + (pw - 1) * y) * (jnp.logaddexp(0.0, -jnp.abs(z)) + jnp.maximum(-z, 0))

@@ -141,8 +141,12 @@ class TestShardingPolicies:
         mesh = HybridCommunicateGroup(dp_degree=2, sharding_degree=2, mp_degree=2).mesh
         params = {"w": np.zeros((256, 128), "float32")}
         p3, _ = build_state_specs(params, mesh, stage=3, mp_specs={"w": P(None, "mp")})
-        # mp kept on dim 1, sdp added on dim 0
-        assert p3["w"] == P("sdp", "mp")
+        # sdp composes with the mp dim (128 % (2*2) == 0) so the ZeRO split
+        # rides the already-model-parallel dim — no fresh activation reshard
+        assert p3["w"] == P(None, ("mp", "sdp"))
+        # params with no mp spec get sdp on the largest divisible dim
+        p3b, _ = build_state_specs(params, mesh, stage=3, mp_specs={})
+        assert p3b["w"] == P("sdp")
 
 
 class TestMPLayers:

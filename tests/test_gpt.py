@@ -48,7 +48,7 @@ def test_gpt_hybrid_fleet_step_converges():
     opt = paddle.optimizer.AdamW(learning_rate=1e-3, parameters=m.parameters())
     step = fleet.distributed_step(m, opt, GPTPretrainingCriterion())
     ids = fleet.shard_batch(_batch(cfg, b=8))
-    losses = [float(step(ids, ids)["loss"]) for _ in range(8)]
+    losses = [float(step(ids, ids)["loss"]) for _ in range(10)]
     assert losses[-1] < losses[0] - 0.5, losses
 
 

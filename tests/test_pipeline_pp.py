@@ -1,0 +1,214 @@
+"""Round-3 pipeline-parallelism tests: stacked GPT trunk, fleet pp_degree,
+multi-layer-per-stage spmd_pipeline, PipelineLayer pipelining, zero-reshard
+assertion, gradient accumulation.
+
+Parity targets: fleet/meta_parallel/pipeline_parallel.py:154 (train_batch),
+pp_layers.py:162 (PipelineLayer), gradient_merge_optimizer.py.
+"""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.strategy import DistributedStrategy
+from paddle_tpu.models.gpt import GPTConfig, GPTForPretraining, GPTPretrainingCriterion
+
+
+def _init_fleet(dp=1, mp=1, pp=1, sdp=1, accum=1, stage=0):
+    strat = DistributedStrategy()
+    strat.hybrid_configs = {"dp_degree": dp, "mp_degree": mp, "pp_degree": pp, "sharding_degree": sdp}
+    strat.sharding_configs = {"sharding_stage": stage}
+    strat.pipeline_configs = {"accumulate_steps": accum}
+    fleet.init(is_collective=True, strategy=strat)
+    return strat
+
+
+def _reset_fleet():
+    fleet._hcg = None
+    fleet._strategy = None
+    fleet._is_initialized = False
+
+
+@pytest.fixture(autouse=True)
+def _clean_fleet():
+    yield
+    _reset_fleet()
+
+
+def _one_step_losses(dp, mp, pp, sdp, accum=4, steps=3, layers=4, stage=0):
+    paddle.seed(7)
+    np.random.seed(7)
+    _init_fleet(dp=dp, mp=mp, pp=pp, sdp=sdp, accum=accum, stage=stage)
+    cfg = GPTConfig.tiny()
+    cfg.num_layers = layers
+    m = GPTForPretraining(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3, parameters=m.parameters())
+    step = fleet.distributed_step(m, opt, GPTPretrainingCriterion())
+    ids = fleet.shard_batch(paddle.to_tensor(
+        np.random.default_rng(0).integers(0, cfg.vocab_size, (8, 16)).astype("int32")))
+    return [float(step(ids, ids)["loss"]) for _ in range(steps)]
+
+
+def test_stacked_matches_layerlist():
+    """GPTBlockStack == LayerList trunk given identical weights."""
+    from paddle_tpu.models.gpt import GPTBlockStack
+
+    cfg_u = GPTConfig.tiny()
+    cfg_u.stacked = False
+    paddle.seed(3)
+    unstacked = GPTForPretraining(cfg_u)
+    cfg_s = GPTConfig.tiny()
+    paddle.seed(4)
+    stacked = GPTForPretraining(cfg_s)
+    # align all weights
+    stacked.gpt.layers.load_blocks(list(unstacked.gpt.layers))
+    for name in ("embeddings.word_embeddings.weight", "embeddings.position_embeddings.weight",
+                 "final_norm.weight", "final_norm.bias"):
+        obj_s, obj_u = stacked.gpt, unstacked.gpt
+        for part in name.split("."):
+            obj_s, obj_u = getattr(obj_s, part), getattr(obj_u, part)
+        obj_s.set_value(obj_u.numpy())
+    ids = paddle.to_tensor(np.random.randint(0, cfg_u.vocab_size, (2, 16)).astype("int32"))
+    unstacked.eval(), stacked.eval()
+    np.testing.assert_allclose(stacked(ids).numpy(), unstacked(ids).numpy(), rtol=2e-5, atol=2e-5)
+
+
+def test_pp4_matches_pp1():
+    """GPipe spmd_pipeline over 4 stages reproduces the serial trunk losses."""
+    l1 = _one_step_losses(1, 1, 1, 1)
+    l4 = _one_step_losses(1, 1, 4, 1)
+    np.testing.assert_allclose(l1, l4, rtol=1e-4)
+    assert l1[-1] < l1[0]  # and it actually trains
+
+
+def test_hybrid_dp_mp_pp_matches_serial():
+    """Full 3-axis hybrid (dp2 x mp2 x pp2) == single-device numerics."""
+    l1 = _one_step_losses(1, 1, 1, 1)
+    lh = _one_step_losses(2, 2, 2, 1)
+    np.testing.assert_allclose(l1, lh, rtol=1e-4)
+
+
+def test_pp_with_zero_sharding():
+    """pp2 x sdp2 with ZeRO stage 2 opt-state sharding trains."""
+    losses = _one_step_losses(1, 1, 2, 2, stage=2, steps=5)
+    assert losses[-1] < losses[0]
+
+
+def test_no_resharding_warnings(capfd):
+    """The hybrid dp x sdp x mp step must compile without XLA's 'Involuntary
+    full rematerialization' resharding fallback (VERDICT r2 item 2)."""
+    _one_step_losses(2, 2, 1, 2, stage=2, steps=2, accum=1)
+    err = capfd.readouterr().err
+    assert "Involuntary full rematerialization" not in err
+
+
+def test_no_resharding_warnings_pp(capfd):
+    _one_step_losses(2, 2, 2, 1, steps=2)
+    err = capfd.readouterr().err
+    assert "Involuntary full rematerialization" not in err
+
+
+def test_spmd_pipeline_multilayer_stage():
+    """8 layers over 4 stages: each stage scans 2 layers."""
+    from paddle_tpu.distributed.pipeline import spmd_pipeline
+
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(4, 1), ("pp", "dp"))
+    key = jax.random.key(0)
+    L, d = 8, 16
+    Ws = jax.random.normal(key, (L, d, d)) * 0.2
+    x = jax.random.normal(jax.random.fold_in(key, 1), (6, 4, d))
+
+    def layer_fn(W, h):
+        return jnp.tanh(h @ W)
+
+    out = spmd_pipeline(layer_fn, Ws, x, mesh, axis="pp")
+    ref = x
+    for i in range(L):
+        ref = jnp.tanh(ref @ Ws[i])
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_pipeline_layer_actually_pipelines():
+    """PipelineLayer with a homogeneous trunk executes via spmd_pipeline under
+    a pp mesh and matches the sequential result."""
+    from paddle_tpu import nn
+    from paddle_tpu.distributed.pipeline import LayerDesc, PipelineLayer
+
+    paddle.seed(11)
+    _init_fleet(pp=4, accum=2)
+    descs = [LayerDesc(nn.Linear, 16, 16) for _ in range(4)]
+    pl = PipelineLayer(layers=descs, num_stages=4)
+    assert pl._homo == (0, 4)
+    x = paddle.to_tensor(np.random.default_rng(2).normal(size=(8, 16)).astype("float32"))
+    out = pl(x)
+    ref = x
+    for l in pl.built:
+        ref = l(ref)
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-5, atol=1e-5)
+
+
+def test_pipeline_layer_grads_flow():
+    from paddle_tpu import nn
+    from paddle_tpu.distributed.pipeline import LayerDesc, PipelineLayer
+    from paddle_tpu.tensor.math import mean
+
+    paddle.seed(12)
+    _init_fleet(pp=2, accum=2)
+    pl = PipelineLayer(layers=[LayerDesc(nn.Linear, 8, 8) for _ in range(2)], num_stages=2)
+    x = paddle.to_tensor(np.random.default_rng(3).normal(size=(4, 8)).astype("float32"))
+    loss = mean(pl(x) ** 2)
+    loss.backward()
+    for p in pl.parameters():
+        assert p.grad is not None
+        assert np.isfinite(np.asarray(p.grad._value)).all()
+
+
+def test_gradient_accumulation_matches_full_batch():
+    """k-microbatch accumulation == one full-batch step (same update)."""
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.models.lenet import LeNet
+
+    def build():
+        paddle.seed(21)
+        m = LeNet()
+        opt = paddle.optimizer.Momentum(learning_rate=0.1, parameters=m.parameters())
+        return m, opt
+
+    x = np.random.default_rng(5).normal(size=(8, 1, 28, 28)).astype("float32")
+    y = np.random.default_rng(6).integers(0, 10, (8,)).astype("int64")
+    loss_fn = paddle.nn.CrossEntropyLoss()
+
+    m1, o1 = build()
+    s1 = TrainStep(m1, o1, loss_fn)
+    l1 = s1(paddle.to_tensor(x), paddle.to_tensor(y))["loss"]
+
+    m2, o2 = build()
+    s2 = TrainStep(m2, o2, loss_fn, accumulate_steps=4)
+    l2 = s2(paddle.to_tensor(x), paddle.to_tensor(y))["loss"]
+
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
+    for (n1, p1), (n2, p2) in zip(sorted(s1.state["params"].items()), sorted(s2.state["params"].items())):
+        np.testing.assert_allclose(np.asarray(p1), np.asarray(p2), rtol=1e-4, atol=1e-5)
+
+
+def test_fleet_consumes_amp_and_accumulate():
+    """strategy.amp_configs and pipeline accumulate_steps reach TrainStep."""
+    paddle.seed(22)
+    strat = _init_fleet(dp=2, accum=2)
+    strat.amp = True
+    strat.amp_configs = {"level": "O2", "dtype": "bfloat16"}
+    fleet.init(is_collective=True, strategy=strat)
+    cfg = GPTConfig.tiny()
+    m = GPTForPretraining(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3, parameters=m.parameters())
+    step = fleet.distributed_step(m, opt, GPTPretrainingCriterion())
+    assert step.amp_level == "O2"
+    assert step.accumulate_steps == 2
+    ids = fleet.shard_batch(paddle.to_tensor(
+        np.random.default_rng(1).integers(0, cfg.vocab_size, (8, 16)).astype("int32")))
+    losses = [float(step(ids, ids)["loss"]) for _ in range(4)]
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0]
