@@ -125,13 +125,15 @@ class GradScaler:
         if self._unscaled:
             raise RuntimeError("unscale_() has already been called on this optimizer since the last update()")
         inv = 1.0 / self._scale
-        found = False
+        # one fused any-nonfinite reduction on device, ONE host sync at the
+        # end — the per-parameter bool() loop synced the pipeline per tensor
+        flags = []
         for p in optimizer._params:
             if p.grad is not None:
                 g = p.grad._value * inv
-                found = found or bool(jnp.any(~jnp.isfinite(g)))
+                flags.append(jnp.any(~jnp.isfinite(g)))
                 p.grad._value = g
-        self._found_inf = found
+        self._found_inf = bool(jnp.any(jnp.stack(flags))) if flags else False
         self._unscaled = True
 
     def step(self, optimizer):

@@ -1,0 +1,109 @@
+"""Device memory observability (parity: paddle.device.cuda
+max_memory_allocated/max_memory_reserved/memory_allocated/memory_reserved,
+backed by memory/stats.h DEVICE_MEMORY_STAT_* in the reference).
+
+TPU-first: numbers come straight from PJRT's per-device allocator
+(``Device.memory_stats()``), so they are live HBM figures, not a shadow
+counter. All APIs accept a device ordinal / "tpu:N" string / None (current
+device).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+
+def _device(device=None):
+    devs = jax.devices()
+    if device is None:
+        return devs[0]
+    if isinstance(device, int):
+        return devs[device]
+    if isinstance(device, str):
+        idx = int(device.split(":")[1]) if ":" in device else 0
+        return devs[idx]
+    return device  # already a jax Device
+
+
+_PEAK_FALLBACK: dict = {}  # device id -> watermark for the live-buffer fallback
+
+
+def _live_buffer_bytes(d) -> int:
+    """Sum of live jax.Array bytes resident on ``d`` — the fallback
+    accounting when PJRT does not forward allocator stats (e.g. through the
+    axon tunnel or on CPU)."""
+    total = 0
+    for arr in jax.live_arrays():
+        try:
+            if any(dev == d for dev in arr.devices()):
+                total += arr.nbytes // max(len(arr.devices()), 1)
+        except Exception:
+            continue
+    return total
+
+
+def memory_stats(device=None) -> dict:
+    """Raw PJRT allocator stats (bytes_in_use, peak_bytes_in_use,
+    bytes_limit, largest_alloc_size, ...). When the backend exposes none
+    (CPU, tunneled TPU), falls back to live-buffer accounting with a
+    process-local peak watermark."""
+    d = _device(device)
+    try:
+        stats = d.memory_stats()
+    except Exception:
+        stats = None
+    if stats:
+        return dict(stats)
+    in_use = _live_buffer_bytes(d)
+    peak = max(_PEAK_FALLBACK.get(d.id, 0), in_use)
+    _PEAK_FALLBACK[d.id] = peak
+    return {"bytes_in_use": in_use, "peak_bytes_in_use": peak, "bytes_limit": 0, "source": "live_arrays"}
+
+
+def memory_allocated(device=None) -> int:
+    """Live HBM bytes currently allocated on the device."""
+    return int(memory_stats(device).get("bytes_in_use", 0))
+
+
+def max_memory_allocated(device=None) -> int:
+    """Peak HBM bytes allocated since device initialization."""
+    return int(memory_stats(device).get("peak_bytes_in_use", 0))
+
+
+def memory_reserved(device=None) -> int:
+    """Bytes reserved by the allocator pool (== in_use under PJRT's BFC
+    accounting when no pool stat is exposed)."""
+    s = memory_stats(device)
+    return int(s.get("pool_bytes", s.get("bytes_in_use", 0)))
+
+
+def max_memory_reserved(device=None) -> int:
+    s = memory_stats(device)
+    return int(s.get("peak_pool_bytes", s.get("peak_bytes_in_use", 0)))
+
+
+def device_memory_limit(device=None) -> int:
+    """Total usable HBM on the device (bytes_limit)."""
+    return int(memory_stats(device).get("bytes_limit", 0))
+
+
+def empty_cache():
+    """Parity no-op: PJRT owns the HBM pool; there is no user-drainable
+    cache. Kept so monitoring code ports cleanly."""
+    return None
+
+
+def device_count() -> int:
+    return jax.device_count()
+
+
+def get_device_properties(device=None):
+    d = _device(device)
+    return {
+        "name": getattr(d, "device_kind", d.platform),
+        "platform": d.platform,
+        "id": d.id,
+        "process_index": d.process_index,
+        "total_memory": device_memory_limit(d),
+    }

@@ -1,0 +1,164 @@
+"""Auto-parallel user surface (parity:
+python/paddle/distributed/auto_parallel/interface.py shard_tensor/shard_op,
+ProcessMesh, and a minimal Engine — auto_parallel/engine.py:50 Engine,
+:255 fit).
+
+TPU-first: the reference's Completer/Partitioner/Resharder pipeline (dist-
+attr propagation over a serial program) is exactly what XLA's GSPMD
+partitioner does from sharding annotations, so the user surface lowers to:
+
+* ``ProcessMesh``        -> ``jax.sharding.Mesh``
+* ``shard_tensor``       -> ``dist_spec`` on parameters (consumed by the
+                            TrainStep in/out shardings) or an immediate
+                            ``with_sharding_constraint`` on activations
+* ``shard_op``           -> constraint on the op's outputs
+* ``Engine``             -> a sharded ``jit.TrainStep`` over the mesh
+
+Everything after the annotations — propagation, resharding, collective
+insertion — is GSPMD's job.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..framework.core import Tensor, unwrap
+
+
+class ProcessMesh:
+    """Parity: auto_parallel ProcessMesh. ``mesh`` is an int array of device
+    ordinals (shape = mesh topology); ``dim_names`` name the axes."""
+
+    def __init__(self, mesh: Sequence, dim_names: Optional[List[str]] = None):
+        arr = np.asarray(mesh)
+        self.shape = list(arr.shape)
+        self.process_ids = arr.ravel().tolist()
+        self.dim_names = list(dim_names) if dim_names else [f"d{i}" for i in range(arr.ndim)]
+        devs = jax.devices()
+        grid = np.array([devs[i] for i in self.process_ids]).reshape(arr.shape)
+        self.jax_mesh = Mesh(grid, tuple(self.dim_names))
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self.shape}, dim_names={self.dim_names})"
+
+
+def _spec_from_dims_mapping(pm: ProcessMesh, dims_mapping: Sequence[int]) -> P:
+    """Reference dist-attr encoding: dims_mapping[i] = mesh dim for tensor
+    dim i, or -1 for replicated."""
+    entries = [None if m == -1 else pm.dim_names[m] for m in dims_mapping]
+    while entries and entries[-1] is None:
+        entries.pop()
+    return P(*entries)
+
+
+def shard_tensor(x, process_mesh: ProcessMesh = None, shard_spec: Sequence = None, dist_attr: dict = None):
+    """Annotate a tensor's sharding (interface.py shard_tensor).
+
+    Accepts either the 2.x ``dist_attr={"process_mesh": .., "dims_mapping":
+    [..]}`` or the newer ``shard_spec=[axis_name|None, ...]``. Parameters
+    keep the spec as ``dist_spec`` (picked up by fleet/TrainStep input
+    shardings); non-parameter tensors get an immediate sharding constraint
+    (under jit) / device_put (eager).
+    """
+    if dist_attr is not None:
+        process_mesh = dist_attr.get("process_mesh", process_mesh)
+        spec = _spec_from_dims_mapping(process_mesh, dist_attr["dims_mapping"])
+    else:
+        entries = [s for s in (shard_spec or [])]
+        while entries and entries[-1] is None:
+            entries.pop()
+        spec = P(*entries)
+    assert process_mesh is not None, "shard_tensor needs a ProcessMesh"
+    x = x if isinstance(x, Tensor) else Tensor(x)
+    x.dist_spec = spec
+    x.process_mesh = process_mesh
+    x.is_distributed = True
+    sharding = NamedSharding(process_mesh.jax_mesh, spec)
+    if getattr(x, "trainable", False) or not x.stop_gradient:
+        return x  # parameter: spec consumed at TrainStep build time
+    try:
+        x._value = jax.lax.with_sharding_constraint(x._value, sharding)
+    except (ValueError, TypeError):
+        x._value = jax.device_put(x._value, sharding)
+    return x
+
+
+def shard_op(op_fn, process_mesh: ProcessMesh = None, in_shard_specs=None, out_shard_specs=None, dist_attr: dict = None):
+    """Wrap a callable so its tensor outputs carry a sharding constraint
+    (interface.py shard_op)."""
+
+    def wrapped(*args, **kwargs):
+        out = op_fn(*args, **kwargs)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        specs = out_shard_specs or [None] * len(outs)
+        for o, s in zip(outs, specs):
+            if s is None or not isinstance(o, Tensor):
+                continue
+            entries = list(s)
+            while entries and entries[-1] is None:
+                entries.pop()
+            sharding = NamedSharding(process_mesh.jax_mesh, P(*entries))
+            try:
+                o._value = jax.lax.with_sharding_constraint(o._value, sharding)
+            except (ValueError, TypeError):
+                pass
+        return out
+
+    return wrapped
+
+
+class Engine:
+    """Minimal auto-parallel Engine (engine.py:50): prepare() builds one
+    sharded TrainStep from the model's shard_tensor annotations; fit()
+    drives it."""
+
+    def __init__(self, model, loss=None, optimizer=None, metrics=None, strategy=None, process_mesh: ProcessMesh = None):
+        self.model = model
+        self.loss = loss
+        self.optimizer = optimizer
+        self.metrics = metrics or []
+        self.strategy = strategy
+        self.process_mesh = process_mesh
+        self._step = None
+
+    def prepare(self, inputs_spec=None, labels_spec=None, mode="train"):
+        from ..distributed.sharding import state_shardings
+        from ..jit import TrainStep
+
+        mesh = self.process_mesh.jax_mesh if self.process_mesh else None
+        mp_specs = {n: p.dist_spec for n, p in self.model.named_parameters() if getattr(p, "dist_spec", None) is not None}
+        step = TrainStep(self.model, self.optimizer, self.loss)
+        if mesh is not None:
+            shardings = state_shardings(step.state, mesh, stage=0, mp_specs=mp_specs)
+            step.state = jax.device_put(step.state, shardings)
+            step._jit = jax.jit(step._step, donate_argnums=0, in_shardings=(shardings, None), out_shardings=(shardings, None))
+            step.mesh = mesh
+            step.state_shardings = shardings
+        self._step = step
+        return self
+
+    def fit(self, train_data, epochs=1, batch_size=None, steps_per_epoch=None, log_freq=10, verbose=0):
+        if self._step is None:
+            self.prepare()
+        history = []
+        for _ in range(epochs):
+            losses = []
+            for i, batch in enumerate(train_data):
+                if steps_per_epoch and i >= steps_per_epoch:
+                    break
+                x, y = (batch[0], batch[1]) if isinstance(batch, (list, tuple)) and len(batch) >= 2 else (batch, batch)
+                m = self._step(x, y)
+                losses.append(float(m["loss"]))
+            history.append(float(np.mean(losses)) if losses else 0.0)
+        return history
+
+    @property
+    def main_program(self):  # static-graph accessor kept for API shape
+        return None

@@ -1,0 +1,11 @@
+"""paddle.distributed.launch parity (reference:
+python/paddle/distributed/launch/main.py:18 + controllers/collective.py +
+fleet/elastic/manager.py:131).
+
+``python -m paddle_tpu.distributed.launch --nnodes N train.py`` spawns one
+worker process per node slot, wires the TCPStore/coordinator rendezvous env
+(consumed by distributed/env.py init_parallel_env), watches the fleet, and
+— with ``--elastic_retries`` — restarts the whole job on worker failure
+(the reference ElasticManager's watch/restart loop, minus etcd: the
+membership store is the launcher itself)."""
+from .main import launch, main  # noqa: F401

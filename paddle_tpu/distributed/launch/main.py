@@ -1,0 +1,163 @@
+"""Launcher implementation. See package docstring.
+
+Reference call stack being replaced: launch/main.py:18 ``launch()`` →
+context.Context → CollectiveController.run → watch() (controllers/
+controller.py) and ElasticManager.watch (fleet/elastic/manager.py:577).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+
+class WorkerProc:
+    def __init__(self, rank: int, proc: subprocess.Popen, log_path: Optional[str]):
+        self.rank = rank
+        self.proc = proc
+        self.log_path = log_path
+
+
+class LaunchContext:
+    def __init__(self, args, script_args):
+        self.args = args
+        self.script_args = script_args
+
+
+class CollectiveController:
+    """Spawns + watches the local slice of a collective job (reference
+    controllers/collective.py). One process per local slot; global ranks are
+    node_rank * nproc_per_node + i."""
+
+    def __init__(self, ctx: LaunchContext):
+        self.ctx = ctx
+        self.procs: List[WorkerProc] = []
+
+    def _env_for(self, local_rank: int) -> dict:
+        a = self.ctx.args
+        rank = a.rank * a.nproc_per_node + local_rank
+        world = a.nnodes * a.nproc_per_node
+        env = dict(os.environ)
+        env.update({
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(world),
+            "PADDLE_MASTER": a.master,
+            "PADDLE_LOCAL_RANK": str(local_rank),
+            "PADDLE_NNODES": str(a.nnodes),
+            "FLAGS_selected_devices": str(local_rank),
+        })
+        if a.devices:
+            env["CUDA_VISIBLE_DEVICES"] = a.devices  # accepted for API parity
+        return env
+
+    def spawn(self):
+        a = self.ctx.args
+        self.procs = []
+        for i in range(a.nproc_per_node):
+            log_path = None
+            stdout = None
+            if a.log_dir:
+                os.makedirs(a.log_dir, exist_ok=True)
+                rank = a.rank * a.nproc_per_node + i
+                log_path = os.path.join(a.log_dir, f"worker.{rank}.log")
+                stdout = open(log_path, "ab")
+            cmd = [sys.executable, "-u", self.ctx.args.training_script] + self.ctx.script_args
+            proc = subprocess.Popen(cmd, env=self._env_for(i), stdout=stdout, stderr=subprocess.STDOUT if stdout else None)
+            self.procs.append(WorkerProc(a.rank * a.nproc_per_node + i, proc, log_path))
+
+    def poll(self):
+        """(still_running, failed_ranks, done)"""
+        failed, running = [], 0
+        for w in self.procs:
+            rc = w.proc.poll()
+            if rc is None:
+                running += 1
+            elif rc != 0:
+                failed.append(w.rank)
+        return running, failed, running == 0 and not failed
+
+    def terminate(self, sig=signal.SIGTERM, grace=5.0):
+        for w in self.procs:
+            if w.proc.poll() is None:
+                try:
+                    w.proc.send_signal(sig)
+                except OSError:
+                    pass
+        t0 = time.time()
+        while time.time() - t0 < grace and any(w.proc.poll() is None for w in self.procs):
+            time.sleep(0.1)
+        for w in self.procs:
+            if w.proc.poll() is None:
+                w.proc.kill()
+        for w in self.procs:
+            w.proc.wait()
+
+    def watch(self, interval=0.5) -> int:
+        """Block until all workers exit; on any failure terminate the rest.
+        Returns 0 on success, first failing signal/code otherwise."""
+        while True:
+            running, failed, done = self.poll()
+            if failed:
+                self.terminate()
+                return 1
+            if done:
+                return 0
+            time.sleep(interval)
+
+
+class ElasticManager:
+    """Minimal elastic loop (reference fleet/elastic/manager.py:131,577):
+    when a worker dies, tear the job down and relaunch the whole collective
+    — membership changes restart the world, training resumes from the
+    user's own checkpoints."""
+
+    def __init__(self, controller: CollectiveController, max_restarts: int):
+        self.controller = controller
+        self.max_restarts = max_restarts
+        self.restarts = 0
+
+    def run(self, interval=0.5) -> int:
+        self.controller.spawn()
+        while True:
+            rc = self.controller.watch(interval)
+            if rc == 0:
+                return 0
+            if self.restarts >= self.max_restarts:
+                print(f"[launch] worker failed; restart budget ({self.max_restarts}) exhausted", file=sys.stderr)
+                return rc
+            self.restarts += 1
+            print(f"[launch] worker failed; elastic restart {self.restarts}/{self.max_restarts}", file=sys.stderr)
+            self.controller.terminate()
+            self.controller.spawn()
+
+
+def _parser():
+    p = argparse.ArgumentParser(prog="paddle_tpu.distributed.launch", description="multi-host collective launcher (reference launch/main.py parity)")
+    p.add_argument("--nnodes", type=int, default=1, help="number of nodes (hosts)")
+    p.add_argument("--nproc_per_node", type=int, default=1, help="worker processes per node (1 per TPU host is canonical)")
+    p.add_argument("--rank", type=int, default=int(os.environ.get("PADDLE_NODE_RANK", "0")), help="this node's rank")
+    p.add_argument("--master", type=str, default=os.environ.get("PADDLE_MASTER", "127.0.0.1:49175"), help="coordinator host:port (rank-0 node)")
+    p.add_argument("--log_dir", type=str, default=None, help="per-worker log directory")
+    p.add_argument("--devices", "--gpus", type=str, default=None, help="device selection (parity flag)")
+    p.add_argument("--elastic_retries", type=int, default=0, help="relaunch the collective up to N times on worker failure")
+    p.add_argument("training_script", type=str)
+    return p
+
+
+def launch(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    ns, script_args = _parser().parse_known_args(argv)
+    ctx = LaunchContext(ns, script_args)
+    controller = CollectiveController(ctx)
+    if ns.elastic_retries > 0:
+        return ElasticManager(controller, ns.elastic_retries).run()
+    controller.spawn()
+    return controller.watch()
+
+
+def main():
+    sys.exit(launch())

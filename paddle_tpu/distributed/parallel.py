@@ -15,17 +15,76 @@ from ..nn.layer.base import Layer
 from .env import ParallelEnv, get_rank, get_world_size, init_parallel_env
 
 
+def _cross_process_mean(value):
+    """Eager all-reduce-mean across processes: one device per process forms a
+    1-D mesh, the local value rides in as that process's shard, pmean inside
+    shard_map produces the replicated mean (the eager analog of the
+    reference Reducer's fused NCCL all-reduce, imperative/reducer.cc)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    first_local = {}
+    for d in jax.devices():
+        first_local.setdefault(d.process_index, d)
+    mesh = Mesh(np.array([first_local[i] for i in range(jax.process_count())]), ("ddp",))
+    sh = NamedSharding(mesh, P("ddp"))
+    stacked = jax.make_array_from_process_local_data(sh, np.asarray(value)[None])
+    out = jax.jit(
+        jax.shard_map(lambda x: jax.lax.pmean(x, "ddp"), mesh=mesh, in_specs=P("ddp"), out_specs=P("ddp")),
+        out_shardings=sh,
+    )(stacked)
+    return jnp.asarray(out.addressable_shards[0].data)[0]
+
+
 class DataParallel(Layer):
+    """Parity: python/paddle/fluid/dygraph/parallel.py:419.
+
+    With ``world_size > 1`` (multi-host), every trainable parameter gets a
+    grad hook that all-reduce-means its gradient across processes during
+    ``loss.backward()`` — the reducer semantics (imperative/reducer.cc:127)
+    without bucketing (XLA fuses the per-tensor reduces it can). Single
+    process (one controller driving all local devices) needs no sync: there
+    is exactly one copy of every parameter.
+    """
+
     def __init__(self, layers, strategy=None, comm_buffer_size=25, last_comm_buffer_size=1, find_unused_parameters=False, group=None):
         super().__init__()
         self._layers = layers
         self.find_unused_parameters = find_unused_parameters
+        self._grad_sync = get_world_size() > 1
+        self._sync_enabled = True
+        self._hook_handles = []
+        if self._grad_sync:
+            for p in layers.parameters():
+                if not p.stop_gradient:
+                    self._hook_handles.append(p.register_hook(self._make_hook()))
+
+    def _make_hook(self):
+        def hook(grad):
+            if not self._sync_enabled:
+                return None
+            from ..framework.core import _wrap_value
+
+            return _wrap_value(_cross_process_mean(grad._value))
+
+        return hook
 
     def forward(self, *inputs, **kwargs):
         return self._layers(*inputs, **kwargs)
 
     def scale_loss(self, loss):
-        return loss
+        return loss  # hooks use pmean, so the loss needs no rescaling
+
+    def apply_collective_grads(self):
+        """Manual fallback (reference DataParallel.apply_collective_grads):
+        all-reduce every .grad now — for use with no_sync() accumulation."""
+        if not self._grad_sync:
+            return
+        for p in self._layers.parameters():
+            if p.grad is not None:
+                p.grad._value = _cross_process_mean(p.grad._value)
 
     def state_dict(self, *args, **kwargs):
         return self._layers.state_dict(*args, **kwargs)
@@ -38,9 +97,19 @@ class DataParallel(Layer):
         return self._layers.parameters
 
     def no_sync(self):
+        """Skip grad sync inside the context (gradient accumulation)."""
         import contextlib
 
-        return contextlib.nullcontext()
+        @contextlib.contextmanager
+        def ctx():
+            prev = self._sync_enabled
+            self._sync_enabled = False
+            try:
+                yield
+            finally:
+                self._sync_enabled = prev
+
+        return ctx()
 
 
 def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):

@@ -63,7 +63,7 @@ class TapeNode:
     number of forward outputs (the node's "grad ins").
     """
 
-    __slots__ = ("vjp_fn", "in_tensors", "n_out", "out_shapes", "name", "__weakref__")
+    __slots__ = ("vjp_fn", "in_tensors", "n_out", "out_shapes", "name", "out_refs", "__weakref__")
 
     def __init__(self, vjp_fn, in_tensors: Sequence, n_out: int, out_shapes, name: str = ""):
         self.vjp_fn = vjp_fn
@@ -71,6 +71,7 @@ class TapeNode:
         self.n_out = n_out
         self.out_shapes = out_shapes  # [(shape, dtype)] per forward output
         self.name = name
+        self.out_refs = None  # weakrefs to output tensors (for grad hooks)
 
     def release(self):
         self.vjp_fn = None
@@ -118,6 +119,16 @@ def backward(tensors, grad_tensors=None, retain_graph=False):
         if root._node is not None:
             discover(root._node)
 
+    # leaf tensors with hooks touched this pass: hooks run once on the final
+    # accumulated grad (GradNodeAccumulation hook parity)
+    hooked_leaves = []
+    hooked_ids = set()
+
+    def note_hooked_leaf(t):
+        if getattr(t, "_hooks", None) and id(t) not in hooked_ids:
+            hooked_ids.add(id(t))
+            hooked_leaves.append(t)
+
     # seed cotangents
     for root, g in zip(roots, grad_tensors):
         if g is None:
@@ -128,6 +139,7 @@ def backward(tensors, grad_tensors=None, retain_graph=False):
         if node is None:
             if not root.stop_gradient:
                 _accum_grad(root, gval)
+                note_hooked_leaf(root)
             continue
         if node.vjp_fn is None:
             raise RuntimeError(
@@ -151,12 +163,22 @@ def backward(tensors, grad_tensors=None, retain_graph=False):
                 "Pass retain_graph=True to backward() if you need to backward twice."
             )
         if cots is not None and node.vjp_fn is not None:
+            # fire output-tensor hooks on the fully-accumulated grad-in slots
+            # (GradNodeBase::ApplyGradientHooks parity: once per node run)
+            if node.out_refs is not None:
+                for idx, c in enumerate(cots):
+                    if c is None:
+                        continue
+                    t = node.out_refs[idx]() if node.out_refs[idx] is not None else None
+                    if t is not None and getattr(t, "_hooks", None):
+                        cots[idx] = _apply_hooks(t, c)
             in_cots = _call_vjp(node, cots)
             for t, c in zip(node.in_tensors, in_cots):
                 prod = t._node
                 if prod is None:
                     if not t.stop_gradient:
                         _accum_grad(t, c)
+                        note_hooked_leaf(t)
                 else:
                     pcots = node_cots.setdefault(id(prod), [None] * prod.n_out)
                     idx = t._out_idx
@@ -168,6 +190,11 @@ def backward(tensors, grad_tensors=None, retain_graph=False):
                 indeg[id(prod)] -= 1
                 if indeg[id(prod)] == 0:
                     queue.append(prod)
+
+    # leaf hooks: once, on the final accumulated grad
+    for t in hooked_leaves:
+        if t.grad is not None:
+            t.grad._value = _apply_hooks(t, t.grad._value)
 
     if not retain_graph:
         for node in processed:
@@ -197,6 +224,21 @@ def _call_vjp(node, cots):
             full.append(np.zeros(shape, jax.dtypes.float0))
     out = node.vjp_fn(tuple(full) if node.n_out > 1 else full[0])
     return out
+
+
+def _apply_hooks(tensor, value):
+    """Run the tensor's registered grad hooks (register_hook) on a freshly
+    computed cotangent; a hook returning non-None replaces it."""
+    hooks = getattr(tensor, "_hooks", None)
+    if not hooks:
+        return value
+    from .core import Tensor, _wrap_value
+
+    for hook in list(hooks):
+        out = hook(_wrap_value(value))
+        if out is not None:
+            value = out._value if isinstance(out, Tensor) else out
+    return value
 
 
 def _accum_grad(tensor, value):

@@ -148,7 +148,22 @@ class Tensor:
     clear_gradient = clear_grad
 
     def register_hook(self, hook):
-        raise NotImplementedError("tensor hooks land with the DDP reducer parity work")
+        """Register ``hook(grad) -> grad | None`` fired when this tensor's
+        gradient is computed during backward (parity:
+        varbase_patch_methods.py:202 / the reducer's accumulation hooks).
+        The hook may return a new Tensor to replace the gradient. Returns a
+        removable handle."""
+        if self.stop_gradient:
+            raise RuntimeError("cannot register a hook on a tensor with stop_gradient=True")
+        hooks = self.__dict__.setdefault("_hooks", [])
+        hooks.append(hook)
+
+        class _Handle:
+            def remove(_self):
+                if hook in hooks:
+                    hooks.remove(hook)
+
+        return _Handle()
 
     # -- mutation (leaf-only, used by optimizers / load) ------------------
     def set_value(self, value):
@@ -232,6 +247,33 @@ def unwrap(x):
 
 _FLOAT_KINDS = ("f", "V")  # V covers bfloat16 numpy view
 
+# per-op wall-time stats collected when FLAGS_benchmark is on
+_BENCH_STATS: dict = {}
+
+
+def benchmark_stats():
+    """{op_name: {"count": n, "total_s": t}} accumulated while
+    FLAGS_benchmark is set (reference: the per-op timing the benchmark flag
+    enables in the executor, profiler.cc)."""
+    return dict(_BENCH_STATS)
+
+
+def reset_benchmark_stats():
+    _BENCH_STATS.clear()
+
+
+def _check_outputs(name, outs):
+    """FLAGS_check_nan_inf hook (reference nan_inf_utils_detail.cc:316 runs
+    after every op); host-syncs each eager output and raises on nan/inf."""
+    for v in outs:
+        if isinstance(v, jax.core.Tracer) or not _is_float_array(v):
+            continue
+        arr = np.asarray(v)
+        if not np.isfinite(arr.astype(np.float32)).all():
+            raise FloatingPointError(
+                f"Operator {name or 'op'} output contains Inf/Nan "
+                f"(shape {arr.shape}, dtype {v.dtype}) — FLAGS_check_nan_inf is set")
+
 # installed by paddle_tpu.amp at import (avoids a circular import); called as
 # _amp_hook(op_name, vals) -> vals when an auto_cast scope is active
 _amp_hook = None
@@ -270,8 +312,25 @@ def primitive(fn: Callable, *args, _name: str = "", **kwargs):
             if isinstance(a, Tensor) and not a.stop_gradient and _is_float_array(a._value):
                 diff_idx.append(i)
 
+    from .flags import _REGISTRY as _FLAGS
+
+    check = _FLAGS.get("FLAGS_check_nan_inf", False)
+    bench = _FLAGS.get("FLAGS_benchmark", False)
+
     if not diff_idx:
-        out = fn(*vals, **kwargs)
+        if bench:
+            import time as _time
+
+            t0 = _time.perf_counter()
+            out = fn(*vals, **kwargs)
+            jax.block_until_ready(out)
+            st = _BENCH_STATS.setdefault(_name or getattr(fn, "__name__", "op"), {"count": 0, "total_s": 0.0})
+            st["count"] += 1
+            st["total_s"] += _time.perf_counter() - t0
+        else:
+            out = fn(*vals, **kwargs)
+        if check:
+            _check_outputs(_name, out if isinstance(out, (tuple, list)) else (out,))
         if isinstance(out, (tuple, list)):
             return tuple(_wrap_value(v) for v in out)
         return _wrap_value(out)
@@ -285,8 +344,13 @@ def primitive(fn: Callable, *args, _name: str = "", **kwargs):
     out, vjp_fn = jax.vjp(closed, *[vals[i] for i in diff_idx])
     multi = isinstance(out, (tuple, list))
     outs = tuple(out) if multi else (out,)
+    if check:
+        _check_outputs(_name, outs)
     # only float outputs participate in grad flow; but vjp structure covers all
     out_shapes = [(o.shape, o.dtype) for o in outs]
     node = TapeNode(vjp_fn, [args[i] for i in diff_idx], len(outs), out_shapes, name=_name or getattr(fn, "__name__", "op"))
     wrapped = tuple(_wrap_value(v, stop_gradient=not _is_float_array(v), node=node if _is_float_array(v) else None, out_idx=i) for i, v in enumerate(outs))
+    import weakref
+
+    node.out_refs = [weakref.ref(t) for t in wrapped]
     return wrapped if multi else wrapped[0]

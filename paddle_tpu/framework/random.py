@@ -16,11 +16,25 @@ import jax
 
 
 class _RngState(threading.local):
+    """The root key is created lazily: materializing it at import would
+    initialize the XLA backend, which must not happen before a possible
+    ``init_parallel_env``/``jax.distributed.initialize`` (multi-host)."""
+
     def __init__(self):
-        self.root_key = jax.random.key(0)
+        self._root_key = None
         self.counter = 0
         # stack of (key, [counter]) installed by rng_scope for traced code
         self.scopes = []
+
+    @property
+    def root_key(self):
+        if self._root_key is None:
+            self._root_key = jax.random.key(0)
+        return self._root_key
+
+    @root_key.setter
+    def root_key(self, value):
+        self._root_key = value
 
 
 _STATE = _RngState()

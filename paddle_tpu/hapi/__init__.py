@@ -1,0 +1,3 @@
+"""hapi high-level API (parity: python/paddle/hapi)."""
+from . import callbacks  # noqa: F401
+from .model import Model  # noqa: F401

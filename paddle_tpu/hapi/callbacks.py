@@ -1,0 +1,211 @@
+"""hapi callback protocol (parity: python/paddle/hapi/callbacks.py —
+Callback:180, CallbackList, ProgBarLogger:280, ModelCheckpoint:450,
+LRScheduler:520, EarlyStopping:580)."""
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+
+class Callback:
+    """Base class; subclasses override the hooks they need."""
+
+    def __init__(self):
+        self.model = None
+        self.params = {}
+
+    def set_params(self, params):
+        self.params = params or {}
+
+    def set_model(self, model):
+        self.model = model
+
+    # lifecycle hooks (names match the reference exactly)
+    def on_train_begin(self, logs=None):
+        pass
+
+    def on_train_end(self, logs=None):
+        pass
+
+    def on_eval_begin(self, logs=None):
+        pass
+
+    def on_eval_end(self, logs=None):
+        pass
+
+    def on_predict_begin(self, logs=None):
+        pass
+
+    def on_predict_end(self, logs=None):
+        pass
+
+    def on_epoch_begin(self, epoch, logs=None):
+        pass
+
+    def on_epoch_end(self, epoch, logs=None):
+        pass
+
+    def on_train_batch_begin(self, step, logs=None):
+        pass
+
+    def on_train_batch_end(self, step, logs=None):
+        pass
+
+    def on_eval_batch_begin(self, step, logs=None):
+        pass
+
+    def on_eval_batch_end(self, step, logs=None):
+        pass
+
+    def on_predict_batch_begin(self, step, logs=None):
+        pass
+
+    def on_predict_batch_end(self, step, logs=None):
+        pass
+
+
+class CallbackList:
+    def __init__(self, callbacks=None, model=None, params=None):
+        self.callbacks = list(callbacks or [])
+        for c in self.callbacks:
+            c.set_model(model)
+            c.set_params(params)
+
+    def _call(self, name, *args):
+        for c in self.callbacks:
+            getattr(c, name)(*args)
+
+    def __getattr__(self, name):
+        if name.startswith("on_"):
+            return lambda *args: self._call(name, *args)
+        raise AttributeError(name)
+
+
+class ProgBarLogger(Callback):
+    """Per-epoch progress + steps/sec (reference ProgBarLogger, without the
+    terminal progress bar widget — one line per log_freq steps)."""
+
+    def __init__(self, log_freq=1, verbose=2):
+        super().__init__()
+        self.log_freq = log_freq
+        self.verbose = verbose
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._epoch = epoch
+        self._step = 0
+        self._t0 = time.time()
+
+    def on_train_batch_end(self, step, logs=None):
+        self._step += 1
+        if self.verbose and self.log_freq and self._step % self.log_freq == 0:
+            ips = self._step / max(time.time() - self._t0, 1e-9)
+            items = " - ".join(f"{k}: {float(np.asarray(v)):.4f}" for k, v in (logs or {}).items() if np.ndim(v) == 0)
+            total = self.params.get("steps")
+            print(f"step {self._step}/{total or '?'} - {items} - {ips:.1f} step/s")
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.verbose:
+            items = " - ".join(f"{k}: {float(np.asarray(v)):.4f}" for k, v in (logs or {}).items() if np.ndim(v) == 0)
+            print(f"Epoch {epoch + 1}/{self.params.get('epochs', '?')} - {items}")
+
+    def on_eval_end(self, logs=None):
+        if self.verbose:
+            items = " - ".join(f"{k}: {float(np.asarray(v)):.4f}" for k, v in (logs or {}).items() if np.ndim(v) == 0)
+            print(f"Eval - {items}")
+
+
+class ModelCheckpoint(Callback):
+    """Save `<save_dir>/{epoch}` every save_freq epochs + `<save_dir>/final`
+    (reference ModelCheckpoint semantics)."""
+
+    def __init__(self, save_freq=1, save_dir=None):
+        super().__init__()
+        self.save_freq = save_freq
+        self.save_dir = save_dir
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.save_dir and self.model and (epoch + 1) % self.save_freq == 0:
+            self.model.save(f"{self.save_dir}/{epoch}")
+
+    def on_train_end(self, logs=None):
+        if self.save_dir and self.model:
+            self.model.save(f"{self.save_dir}/final")
+
+
+class LRScheduler(Callback):
+    """Steps the optimizer's LRScheduler (by_step or by_epoch)."""
+
+    def __init__(self, by_step=True, by_epoch=False):
+        super().__init__()
+        assert by_step != by_epoch, "exactly one of by_step/by_epoch"
+        self.by_step = by_step
+
+    def _sched(self):
+        opt = getattr(self.model, "_optimizer", None)
+        lr = getattr(opt, "_learning_rate", None)
+        return lr if hasattr(lr, "step") else None
+
+    def on_train_batch_end(self, step, logs=None):
+        s = self._sched()
+        if self.by_step and s is not None:
+            s.step()
+
+    def on_epoch_end(self, epoch, logs=None):
+        s = self._sched()
+        if not self.by_step and s is not None:
+            s.step()
+
+
+class EarlyStopping(Callback):
+    """Stop fit() when the monitored eval metric stops improving."""
+
+    def __init__(self, monitor="loss", mode="auto", patience=0, verbose=1, min_delta=0, baseline=None, save_best_model=True):
+        super().__init__()
+        self.monitor = monitor
+        self.patience = patience
+        self.min_delta = abs(min_delta)
+        self.baseline = baseline
+        self.verbose = verbose
+        if mode == "auto":
+            mode = "max" if "acc" in monitor.lower() else "min"
+        self.mode = mode
+        self.best = None
+        self.wait = 0
+
+    def _improved(self, value):
+        if self.best is None:
+            return True
+        if self.mode == "min":
+            return value < self.best - self.min_delta
+        return value > self.best + self.min_delta
+
+    def on_train_begin(self, logs=None):
+        self.best = self.baseline
+        self.wait = 0
+
+    def on_eval_end(self, logs=None):
+        value = (logs or {}).get(self.monitor)
+        if value is None:
+            return
+        value = float(np.asarray(value))
+        if self._improved(value):
+            self.best = value
+            self.wait = 0
+        else:
+            self.wait += 1
+            if self.wait > self.patience:
+                if self.model is not None:
+                    self.model.stop_training = True
+                if self.verbose:
+                    print(f"EarlyStopping: no {self.monitor} improvement for {self.wait} evals")
+
+
+def config_callbacks(callbacks=None, model=None, epochs=None, steps=None, log_freq=10, verbose=2, metrics=None, mode="train"):
+    """Parity: hapi/callbacks.py config_callbacks — ensure a ProgBarLogger
+    is present and bind model/params."""
+    cbks = list(callbacks or [])
+    if verbose and not any(isinstance(c, ProgBarLogger) for c in cbks):
+        cbks.append(ProgBarLogger(log_freq, verbose=verbose))
+    params = {"epochs": epochs, "steps": steps, "verbose": verbose, "metrics": metrics or []}
+    return CallbackList(cbks, model=model, params=params)

@@ -1,5 +1,13 @@
 """High-level Model API (parity: python/paddle/hapi/model.py —
-Model.fit/evaluate/predict/save/load with prepare(optimizer, loss, metrics))."""
+Model.fit/evaluate/predict/save/load with prepare(optimizer, loss, metrics)).
+
+TPU-first: ``fit`` trains through one compiled ``jit.TrainStep`` (forward +
+backward + update as a single XLA computation) instead of the reference's
+per-op dygraph loop; ``evaluate``/``predict`` run a compiled ``EvalStep``.
+The callback protocol (hapi/callbacks.py parity) fires around the compiled
+steps. ``batch_size`` is honored by wrapping map-style datasets in a
+DataLoader.
+"""
 from __future__ import annotations
 
 import numpy as np
@@ -7,6 +15,13 @@ import numpy as np
 from ..framework.core import Tensor
 from ..framework.io import load as _load
 from ..framework.io import save as _save
+from .callbacks import config_callbacks
+
+
+def _as_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
 
 
 class Model:
@@ -15,85 +30,194 @@ class Model:
         self._optimizer = None
         self._loss = None
         self._metrics = []
+        self._amp_level = None
+        self._train_step = None
+        self._eval_step = None
+        self.stop_training = False
 
     def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
         self._optimizer = optimizer
         self._loss = loss
         self._metrics = metrics if isinstance(metrics, (list, tuple)) else ([metrics] if metrics else [])
+        if isinstance(amp_configs, str):
+            self._amp_level = amp_configs
+        elif isinstance(amp_configs, dict):
+            self._amp_level = amp_configs.get("level", "O1")
+        self._train_step = None  # invalidate any compiled step
+        self._eval_step = None
         return self
 
+    # -- compiled steps ----------------------------------------------------
+    def _loss_adapter(self):
+        loss = self._loss
+
+        def fn(outputs, *labels):
+            outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+            return loss(*outs, *labels)
+
+        return fn
+
+    def _get_train_step(self):
+        if self._train_step is None:
+            from ..jit import TrainStep
+
+            self._train_step = TrainStep(
+                self.network, self._optimizer, self._loss_adapter(),
+                amp_level=self._amp_level, return_outputs=bool(self._metrics))
+        return self._train_step
+
+    def _get_eval_step(self):
+        if self._eval_step is None:
+            from ..jit import EvalStep
+
+            if self._train_step is not None:
+                self._train_step.sync_to_model()
+            self._eval_step = EvalStep(self.network)
+        return self._eval_step
+
+    # -- single-batch eager APIs (reference parity) ------------------------
     def train_batch(self, inputs, labels=None):
         self.network.train()
-        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
-        outputs = self.network(*inputs)
-        losses = self._loss(outputs, *(labels if isinstance(labels, (list, tuple)) else [labels]))
+        outputs = self.network(*_as_list(inputs))
+        losses = self._loss_adapter()(outputs, *_as_list(labels))
         losses.backward()
         self._optimizer.step()
         self._optimizer.clear_grad()
+        self._train_step = None  # eager updates invalidate the compiled state
         return losses.numpy()
 
     def eval_batch(self, inputs, labels=None):
         from ..framework.autograd import no_grad
 
         self.network.eval()
-        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        inputs = _as_list(inputs)
         with no_grad():
             outputs = self.network(*inputs)
-            losses = self._loss(outputs, *(labels if isinstance(labels, (list, tuple)) else [labels]))
+            losses = self._loss_adapter()(outputs, *_as_list(labels))
         return losses.numpy(), outputs
 
     def predict_batch(self, inputs):
         from ..framework.autograd import no_grad
 
         self.network.eval()
-        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
         with no_grad():
-            return self.network(*inputs)
+            return self.network(*_as_list(inputs))
 
-    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1, eval_freq=1, log_freq=10, callbacks=None, verbose=1, shuffle=True, drop_last=False, num_workers=0):
+    # -- data plumbing -----------------------------------------------------
+    def _to_loader(self, data, batch_size, shuffle=False, drop_last=False, num_workers=0):
+        if data is None:
+            return None
+        if hasattr(data, "__getitem__") and not hasattr(data, "batch_size") and not isinstance(data, (list, tuple)):
+            from ..io import DataLoader
+
+            return DataLoader(data, batch_size=batch_size, shuffle=shuffle, drop_last=drop_last, num_workers=num_workers)
+        return data  # already an iterable of batches (DataLoader, generator…)
+
+    @staticmethod
+    def _split_batch(batch):
+        if isinstance(batch, (list, tuple)) and len(batch) >= 2:
+            return batch[0], list(batch[1:])
+        return batch, []
+
+    # -- main loops --------------------------------------------------------
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1, eval_freq=1, log_freq=10, save_dir=None, save_freq=1, callbacks=None, verbose=1, shuffle=True, drop_last=False, num_workers=0):
+        loader = self._to_loader(train_data, batch_size, shuffle, drop_last, num_workers)
+        steps = len(loader) if hasattr(loader, "__len__") else None
+        cbks = config_callbacks(callbacks, model=self, epochs=epochs, steps=steps, log_freq=log_freq, verbose=verbose, metrics=[m.name() for m in self._metrics])
+        if save_dir is not None:
+            from .callbacks import ModelCheckpoint
+
+            cbks.callbacks.append(ModelCheckpoint(save_freq, save_dir))
+            cbks.callbacks[-1].set_model(self)
+            cbks.callbacks[-1].set_params({})
+        step_fn = self._get_train_step()
+        self.network.train()
+        self.stop_training = False
         history = []
+        cbks.on_train_begin()
         for epoch in range(epochs):
+            cbks.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
             losses = []
-            for batch in train_data:
-                if isinstance(batch, (list, tuple)) and len(batch) >= 2:
-                    x, y = batch[0], batch[1]
-                else:
-                    x, y = batch, None
-                loss = self.train_batch(x, y)
-                losses.append(float(np.asarray(loss)))
-            avg = float(np.mean(losses)) if losses else 0.0
-            history.append(avg)
-            if verbose:
-                print(f"Epoch {epoch + 1}/{epochs} - loss: {avg:.4f}")
+            for i, batch in enumerate(loader):
+                cbks.on_train_batch_begin(i)
+                x, ys = self._split_batch(batch)
+                metrics = step_fn(_as_list(x), ys)
+                logs = {"loss": float(metrics["loss"]), "lr": float(metrics["lr"])}
+                losses.append(logs["loss"])
+                if self._metrics and "outputs" in metrics:
+                    outs = metrics["outputs"]
+                    for m in self._metrics:
+                        m.update(*m.compute(outs, *ys))
+                        logs[m.name()] = m.accumulate()
+                cbks.on_train_batch_end(i, logs)
+            epoch_logs = {"loss": float(np.mean(losses)) if losses else 0.0}
+            for m in self._metrics:
+                epoch_logs[m.name()] = m.accumulate()
+            history.append(epoch_logs["loss"])
+            cbks.on_epoch_end(epoch, epoch_logs)
             if eval_data is not None and (epoch + 1) % eval_freq == 0:
-                self.evaluate(eval_data, verbose=verbose)
+                eval_logs = self.evaluate(eval_data, batch_size=batch_size, verbose=0, num_workers=num_workers)
+                cbks.on_eval_end(eval_logs)
+            if self.stop_training:
+                break
+        step_fn.sync_to_model()  # expose trained weights to save()/eager use
+        self._eval_step = None
+        cbks.on_train_end({"loss": history[-1] if history else 0.0})
         return history
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=1, num_workers=0, callbacks=None):
+        loader = self._to_loader(eval_data, batch_size, num_workers=num_workers)
+        cbks = config_callbacks(callbacks, model=self, steps=len(loader) if hasattr(loader, "__len__") else None, log_freq=log_freq, verbose=verbose)
+        if self._train_step is not None:
+            self._train_step.sync_to_model()
+        eval_step = self._get_eval_step()
+        self.network.eval()
         for m in self._metrics:
             m.reset()
         losses = []
-        for batch in eval_data:
-            x, y = (batch[0], batch[1]) if isinstance(batch, (list, tuple)) else (batch, None)
-            loss, outputs = self.eval_batch(x, y)
-            losses.append(float(np.asarray(loss)))
+        cbks.on_eval_begin()
+        for i, batch in enumerate(loader):
+            cbks.on_eval_batch_begin(i)
+            x, ys = self._split_batch(batch)
+            outputs = eval_step(*_as_list(x))
+            loss = self._loss_adapter()(outputs, *ys) if self._loss is not None else None
+            if loss is not None:
+                losses.append(float(loss))
             for m in self._metrics:
-                m.update(*m.compute(outputs, y))
+                m.update(*m.compute(outputs, *ys))
+            cbks.on_eval_batch_end(i, {"loss": losses[-1] if losses else 0.0})
         result = {"loss": float(np.mean(losses)) if losses else 0.0}
         for m in self._metrics:
             result[m.name()] = m.accumulate()
-        if verbose:
-            print("Eval -", result)
+        cbks.on_eval_end(result)
         return result
 
     def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False, callbacks=None, verbose=1):
+        loader = self._to_loader(test_data, batch_size, num_workers=num_workers)
+        cbks = config_callbacks(callbacks, model=self, verbose=0)
+        if self._train_step is not None:
+            self._train_step.sync_to_model()
+        eval_step = self._get_eval_step()
+        self.network.eval()
         outs = []
-        for batch in test_data:
-            x = batch[0] if isinstance(batch, (list, tuple)) else batch
-            outs.append(self.predict_batch(x))
+        cbks.on_predict_begin()
+        for i, batch in enumerate(loader):
+            cbks.on_predict_batch_begin(i)
+            x, _ = self._split_batch(batch)
+            outs.append(eval_step(*_as_list(x)))
+            cbks.on_predict_batch_end(i)
+        cbks.on_predict_end()
+        if stack_outputs:
+            flat = [o.numpy() if isinstance(o, Tensor) else o for o in outs]
+            return [np.concatenate(flat, axis=0)]
         return outs
 
+    # -- persistence -------------------------------------------------------
     def save(self, path, training=True):
+        if self._train_step is not None:
+            self._train_step.sync_to_model()
         _save(self.network.state_dict(), path + ".pdparams")
         if training and self._optimizer is not None and hasattr(self._optimizer, "state_dict"):
             _save(self._optimizer.state_dict(), path + ".pdopt")
@@ -103,6 +227,8 @@ class Model:
 
         state = _load(path + ".pdparams") if not path.endswith(".pdparams") else _load(path)
         self.network.set_state_dict(state)
+        self._train_step = None
+        self._eval_step = None
         opt_path = path + ".pdopt"
         if not reset_optimizer and self._optimizer is not None and os.path.exists(opt_path):
             self._optimizer.set_state_dict(_load(opt_path))

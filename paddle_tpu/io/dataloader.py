@@ -42,6 +42,7 @@ class DataLoader:
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
         self.prefetch_factor = max(prefetch_factor, 1)
+        self.use_buffer_reader = use_buffer_reader
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
             self.batch_sampler = None
@@ -62,13 +63,38 @@ class DataLoader:
 
     def __iter__(self):
         if self._iterable_mode:
-            yield from self._iter_iterable()
-            return
-        if self.num_workers == 0:
-            for indices in self.batch_sampler:
-                yield self._fetch(indices)
-            return
-        yield from self._iter_threaded()
+            it = self._iter_iterable()
+        elif self.num_workers == 0:
+            it = (self._fetch(indices) for indices in self.batch_sampler)
+        else:
+            it = self._iter_threaded()
+        if self._prefetch_to_device():
+            it = self._iter_device_prefetch(it)
+        yield from it
+
+    def _prefetch_to_device(self):
+        """use_buffer_reader parity (reader.py:275): feed batches to the
+        accelerator asynchronously, one batch ahead."""
+        if not self.use_buffer_reader:
+            return False
+        import jax
+
+        return jax.default_backend() != "cpu"
+
+    def _iter_device_prefetch(self, it):
+        """Yield batch N while batch N+1's host→HBM transfer is in flight
+        (device_put is async under PJRT)."""
+        import jax
+
+        put = lambda b: jax.tree_util.tree_map(jax.device_put, b)
+        prev = None
+        for batch in it:
+            nxt = put(batch)
+            if prev is not None:
+                yield prev
+            prev = nxt
+        if prev is not None:
+            yield prev
 
     def _iter_iterable(self):
         batch = []

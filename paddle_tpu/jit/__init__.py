@@ -56,12 +56,13 @@ class TrainStep:
     inplace/vars GC in interpretercore; here it's XLA buffer donation).
     """
 
-    def __init__(self, model, optimizer, loss_fn, mesh=None, state_shardings=None, batch_shardings=None, remat=False, seed=0, amp_level=None, amp_dtype="bfloat16", accumulate_steps=1):
+    def __init__(self, model, optimizer, loss_fn, mesh=None, state_shardings=None, batch_shardings=None, remat=False, seed=0, amp_level=None, amp_dtype="bfloat16", accumulate_steps=1, return_outputs=False):
         self.model = model
         self.optimizer = optimizer
         self.loss_fn = loss_fn
         self.mesh = mesh
         self.accumulate_steps = int(accumulate_steps)
+        self.return_outputs = return_outputs  # include model outputs in metrics (hapi train-metric path)
         # AMP (reference amp.decorate semantics, bf16-first for TPU).
         # O2: master params stay f32 in state; compute casts params+inputs to
         #     amp_dtype so matmuls hit the MXU at bf16; loss input back to f32.
@@ -75,6 +76,10 @@ class TrainStep:
                 "float16 in the fused TrainStep has no loss-scaling hook and "
                 "gradients underflow silently; use bfloat16 (TPU-native) or "
                 "the eager path with amp.GradScaler")
+        from ..framework.flags import flag
+
+        if not remat and flag("FLAGS_remat_policy") != "none":
+            remat = True
         params = model.param_arrays()
         buffers = model.buffer_arrays()
         self.state = {
@@ -180,7 +185,10 @@ class TrainStep:
                 "step": state["step"] + 1,
                 "rng": state["rng"],
             }
-            return new_state, {"loss": loss, "lr": lr}
+            metrics = {"loss": loss, "lr": lr}
+            if self.return_outputs and k <= 1:
+                metrics["outputs"] = out
+            return new_state, metrics
 
         self._step = _step
 
@@ -188,7 +196,7 @@ class TrainStep:
         inputs = tuple(unwrap(x) if isinstance(x, Tensor) else jnp.asarray(x) for x in (inputs if isinstance(inputs, (list, tuple)) else [inputs]))
         labels = tuple(unwrap(y) if isinstance(y, Tensor) else jnp.asarray(y) for y in (labels if isinstance(labels, (list, tuple)) else [labels]))
         self.state, metrics = self._jit(self.state, (inputs, labels))
-        return {k: _wrap_value(v) for k, v in metrics.items()}
+        return {k: _wrap_tree(v) for k, v in metrics.items()}
 
     # -- interop -----------------------------------------------------------
     def sync_to_model(self):
@@ -215,20 +223,43 @@ class TrainStep:
 
 
 class EvalStep:
-    """Compiled forward-only step."""
+    """Compiled forward-only step.
 
-    def __init__(self, model, mesh=None):
+    With ``mesh``, parameters are placed per their ``dist_spec`` annotations
+    and inputs are batch-sharded over dp×sdp — sharded evaluation, the
+    counterpart of fleet.distributed_step for inference/eval loops.
+    """
+
+    def __init__(self, model, mesh=None, batch_sharding=None):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
         self.model = model
+        self.mesh = mesh
 
         def _fwd(params, buffers, inputs):
             out, _ = _pure_model_call(model, {**params, **buffers}, inputs, {}, False, None)
             return out
 
-        self._jit = jax.jit(_fwd)
+        if mesh is not None:
+            param_shardings = {
+                name: NamedSharding(mesh, p.dist_spec if getattr(p, "dist_spec", None) is not None else P())
+                for name, p in model.named_parameters()
+            }
+            buf_shardings = {name: NamedSharding(mesh, P()) for name, _ in model.named_buffers()}
+            if batch_sharding is None:
+                batch_sharding = NamedSharding(mesh, P(("dp", "sdp")))
+            self._param_shardings = param_shardings
+            self._jit = jax.jit(_fwd, in_shardings=(param_shardings, buf_shardings, batch_sharding))
+        else:
+            self._param_shardings = None
+            self._jit = jax.jit(_fwd)
 
     def __call__(self, *inputs):
         arrays = tuple(unwrap(x) if isinstance(x, Tensor) else jnp.asarray(x) for x in inputs)
-        out = self._jit(self.model.param_arrays(), self.model.buffer_arrays(), arrays)
+        params = self.model.param_arrays()
+        if self._param_shardings is not None:
+            params = {k: jax.device_put(v, self._param_shardings[k]) for k, v in params.items()}
+        out = self._jit(params, self.model.buffer_arrays(), arrays)
         return _wrap_tree(out)
 
 

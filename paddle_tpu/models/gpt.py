@@ -107,14 +107,40 @@ class GPTAttention(nn.Layer):
         self.out_proj = RowParallelLinear(cfg.hidden_size, cfg.hidden_size, weight_attr=init, input_is_parallel=True)
         self.attn_dropout = cfg.attn_dropout
 
-    def forward(self, x):
+    def gen_cache(self, x):
+        from ..nn.layer.transformer import MultiHeadAttention
+        from ..tensor.creation import zeros
+
+        empty = lambda: zeros([x.shape[0], 0, self.num_heads, self.head_dim], dtype=x.dtype)
+        return MultiHeadAttention.Cache(empty(), empty())
+
+    def forward(self, x, cache=None):
+        from ..nn.layer.transformer import MultiHeadAttention
+
         b, s = x.shape[0], x.shape[1]
         qkv = self.qkv_proj(x)
         qkv = M.reshape(qkv, [b, s, 3, self.num_heads, self.head_dim])
         q, k, v = (M.squeeze(t, 2) for t in M.split(qkv, 3, axis=2))
-        out = F.scaled_dot_product_attention(q, k, v, is_causal=True, dropout_p=self.attn_dropout, training=self.training)
+        if cache is not None:
+            if cache.k.shape[1] > 0:
+                k = M.concat([cache.k, k], axis=1)
+                v = M.concat([cache.v, v], axis=1)
+            cache = MultiHeadAttention.Cache(k, v)
+            # new queries attend to all cached keys + causally within the block
+            import jax.numpy as jnp
+
+            from ..framework.core import _wrap_value
+
+            past = k.shape[1] - s
+            mask = jnp.tril(jnp.ones((s, k.shape[1]), bool), k=past)
+            out = F.scaled_dot_product_attention(q, k, v, attn_mask=_wrap_value(mask), dropout_p=self.attn_dropout, training=self.training)
+        else:
+            out = F.scaled_dot_product_attention(q, k, v, is_causal=True, dropout_p=self.attn_dropout, training=self.training)
         out = M.reshape(out, [b, s, self.num_heads * self.head_dim])
-        return self.out_proj(out)
+        out = self.out_proj(out)
+        if cache is not None:
+            return out, cache
+        return out
 
 
 class GPTBlock(nn.Layer):
@@ -130,9 +156,18 @@ class GPTBlock(nn.Layer):
         self.ffn2 = RowParallelLinear(cfg.ffn_hidden_size, cfg.hidden_size, weight_attr=init, input_is_parallel=True)
         self.dropout = nn.Dropout(cfg.dropout)
 
-    def forward(self, x):
-        x = x + self.dropout(self.attn(self.norm1(x)))
+    def gen_cache(self, x):
+        return self.attn.gen_cache(x)
+
+    def forward(self, x, cache=None):
+        if cache is not None:
+            att, cache = self.attn(self.norm1(x), cache=cache)
+            x = x + self.dropout(att)
+        else:
+            x = x + self.dropout(self.attn(self.norm1(x)))
         x = x + self.dropout(self.ffn2(F.gelu(self.ffn1(self.norm2(x)), approximate=True)))
+        if cache is not None:
+            return x, cache
         return x
 
 
@@ -332,6 +367,114 @@ class GPTBlockStack(nn.Layer):
         )
 
 
+def _cache_block(lp, h, ck, cv, start_pos, *, num_heads, epsilon=1e-5):
+    """One decoder block with a fixed-size KV cache.
+
+    h [b, s, d] (s = prompt len at prefill, 1 at decode); ck/cv [b, S, H, dh]
+    hold keys/values for positions < start_pos and are updated in place at
+    [start_pos, start_pos+s). Attention masks cache positions beyond
+    start_pos+row. Returns (h, ck, cv). Parity: the per-layer decode of
+    fused_multi_transformer_op.cu, as lax ops on a static-shape cache.
+    """
+    (n1w, n1b, qkvw, qkvb, ow, ob, n2w, n2b, f1w, f1b, f2w, f2b), _ = lp
+
+    def ln(v, w, bb):
+        mean = jnp.mean(v, axis=-1, keepdims=True)
+        var = jnp.var(v, axis=-1, keepdims=True)
+        return (v - mean) / jnp.sqrt(var + epsilon) * w + bb
+
+    b, s, d = h.shape
+    S = ck.shape[1]
+    hd = d // num_heads
+    x1 = ln(h, n1w, n1b)
+    qkv = (x1 @ qkvw + qkvb).reshape(b, s, 3, num_heads, hd)
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    ck = jax.lax.dynamic_update_slice(ck, k, (0, start_pos, 0, 0))
+    cv = jax.lax.dynamic_update_slice(cv, v, (0, start_pos, 0, 0))
+    scale = 1.0 / (hd ** 0.5)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, ck).astype(jnp.float32) * scale
+    q_pos = start_pos + jax.lax.broadcasted_iota(jnp.int32, (s, S), 0)
+    k_pos = jax.lax.broadcasted_iota(jnp.int32, (s, S), 1)
+    scores = jnp.where((k_pos <= q_pos)[None, None], scores, -jnp.inf)
+    p = jax.nn.softmax(scores, axis=-1).astype(cv.dtype)
+    att = jnp.einsum("bhqk,bkhd->bqhd", p, cv).reshape(b, s, d)
+    h = h + att @ ow + ob
+    x2 = ln(h, n2w, n2b)
+    y = jax.nn.gelu(x2 @ f1w + f1b, approximate=True)
+    h = h + y @ f2w + f2b
+    return h, ck, cv
+
+
+def _cache_forward(stacked, wte, wpe, fnw, fnb, ids, cache_k, cache_v, start_pos, *, num_heads):
+    """Trunk forward over a fixed cache; returns (logits, cache_k, cache_v).
+
+    cache_k/v: [L, b, S, H, dh]. ids [b, s]; positions start at start_pos.
+    """
+    params, idx = stacked
+    num_layers = params[0].shape[0]
+    b, s = ids.shape
+    pos = start_pos + jnp.arange(s, dtype=jnp.int32)
+    h = jnp.take(wte, ids, axis=0) + jnp.take(wpe, pos, axis=0)[None]
+    h = h.astype(wte.dtype)
+    new_k, new_v = [], []
+    for i in range(num_layers):
+        lp = (tuple(p[i] for p in params), idx[i])
+        h, ck, cv = _cache_block(lp, h, cache_k[i], cache_v[i], start_pos, num_heads=num_heads)
+        new_k.append(ck)
+        new_v.append(cv)
+    mean = jnp.mean(h, axis=-1, keepdims=True)
+    var = jnp.var(h, axis=-1, keepdims=True)
+    h = (h - mean) / jnp.sqrt(var + 1e-5) * fnw + fnb
+    logits = jnp.einsum("bsd,vd->bsv", h, wte)
+    return logits, jnp.stack(new_k), jnp.stack(new_v)
+
+
+def _select_token(logits, key, do_sample, temperature, top_k, top_p):
+    """Greedy or temperature/top-k/top-p sampling over [b, V] logits."""
+    if not do_sample:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits.astype(jnp.float32) / jnp.maximum(temperature, 1e-6)
+    if top_k and top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[..., -int(top_k)][..., None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p < 1.0:
+        sl = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sl, axis=-1)
+        keep = jnp.cumsum(probs, axis=-1) - probs < top_p  # always keep top-1
+        threshold = jnp.min(jnp.where(keep, sl, jnp.inf), axis=-1, keepdims=True)
+        logits = jnp.where(logits < threshold, -jnp.inf, logits)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("num_heads", "num_layers", "head_dim", "max_new", "do_sample", "temperature", "top_k", "top_p", "eos"))
+def _generate_jit(params, ids, key, *, num_heads, num_layers, head_dim, max_new, do_sample, temperature, top_k, top_p, eos):
+    """Prefill + lax.scan single-token decode loop, one XLA computation."""
+    stacked_tree, wte, wpe, fnw, fnb = params
+    b, s0 = ids.shape
+    S = s0 + max_new
+    dt = wte.dtype
+    cache_k = jnp.zeros((num_layers, b, S, num_heads, head_dim), dt)
+    cache_v = jnp.zeros((num_layers, b, S, num_heads, head_dim), dt)
+    logits, cache_k, cache_v = _cache_forward(
+        stacked_tree, wte, wpe, fnw, fnb, ids, cache_k, cache_v, jnp.int32(0), num_heads=num_heads)
+    first = _select_token(logits[:, -1].astype(jnp.float32), key, do_sample, temperature, top_k, top_p)
+    done0 = jnp.zeros((b,), bool) if eos is None else (first == eos)
+
+    def step(carry, i):
+        tok, ck, cv, done, key = carry
+        key, sub = jax.random.split(key)
+        lg, ck, cv = _cache_forward(
+            stacked_tree, wte, wpe, fnw, fnb, tok[:, None], ck, cv, s0 + i, num_heads=num_heads)
+        nxt = _select_token(lg[:, -1].astype(jnp.float32), sub, do_sample, temperature, top_k, top_p)
+        if eos is not None:
+            nxt = jnp.where(done, jnp.int32(eos), nxt)
+            done = done | (nxt == eos)
+        return (nxt, ck, cv, done, key), nxt
+
+    (_, _, _, _, _), rest = jax.lax.scan(step, (first, cache_k, cache_v, done0, key), jnp.arange(max_new - 1, dtype=jnp.int32))
+    return jnp.concatenate([ids, first[:, None], rest.T.astype(jnp.int32)], axis=1)
+
+
 class GPTEmbeddings(nn.Layer):
     def __init__(self, cfg: GPTConfig):
         super().__init__()
@@ -384,6 +527,47 @@ class GPTForPretraining(nn.Layer):
         # tied head: h @ wte^T; vocab axis stays mp-sharded for the
         # vocab-parallel loss (c_softmax_with_cross_entropy parity)
         return matmul(h, self.gpt.embeddings.word_embeddings.weight, transpose_y=True)
+
+    def generate(self, input_ids, max_new_tokens=32, do_sample=False, temperature=1.0, top_k=0, top_p=1.0, seed=0, eos_token_id=None):
+        """Autoregressive decoding over a fixed-size KV cache, compiled as
+        one XLA computation (prefill + lax.scan token loop).
+
+        Parity: the reference decodes through gen_cache/Cache plumbing
+        (python/paddle/nn/layer/transformer.py:284) or the fused decoder
+        (fused_multi_transformer_op.cu); here the cache has a static
+        [L, b, s0+max_new, H, dh] shape so the whole loop jits once.
+        Greedy by default; ``do_sample`` enables temperature / top-k /
+        top-p sampling. Returns [b, s0 + max_new_tokens] token ids.
+        """
+        from ..framework.core import _wrap_value, unwrap
+        from ..tensor._helpers import ensure_tensor
+
+        cfg = self.gpt.cfg
+        if not isinstance(self.gpt.layers, GPTBlockStack):
+            raise NotImplementedError("generate() requires the stacked trunk (GPTConfig(stacked=True))")
+        ids = unwrap(ensure_tensor(input_ids)).astype(jnp.int32)
+        if ids.ndim == 1:
+            ids = ids[None]
+        if ids.shape[1] + max_new_tokens > cfg.max_seq_len:
+            raise ValueError(f"prompt {ids.shape[1]} + max_new_tokens {max_new_tokens} exceeds max_seq_len {cfg.max_seq_len}")
+        stack = self.gpt.layers
+        stacked = (tuple(unwrap(getattr(stack, n)) for n in stack._order),
+                   jnp.arange(cfg.num_layers, dtype=jnp.int32))
+        params = (
+            stacked,
+            unwrap(self.gpt.embeddings.word_embeddings.weight),
+            unwrap(self.gpt.embeddings.position_embeddings.weight),
+            unwrap(self.gpt.final_norm.weight),
+            unwrap(self.gpt.final_norm.bias),
+        )
+        out = _generate_jit(
+            params, ids, jax.random.key(seed),
+            num_heads=cfg.num_heads, num_layers=cfg.num_layers,
+            head_dim=cfg.hidden_size // cfg.num_heads,
+            max_new=int(max_new_tokens), do_sample=bool(do_sample),
+            temperature=float(temperature), top_k=int(top_k), top_p=float(top_p),
+            eos=None if eos_token_id is None else int(eos_token_id))
+        return _wrap_value(out)
 
 
 class GPTPretrainingCriterion(nn.Layer):

@@ -5,8 +5,17 @@ dispatches to the Pallas flash kernel on TPU — this subsumes the reference's
 fused CUDA attention (paddle/fluid/operators/fused/fused_attention_op.cu) and
 the incubate FusedMultiHeadAttention wrapper
 (python/paddle/incubate/nn/layer/fused_transformer.py:136).
+
+Incremental decoding (reference transformer.py:284 ``gen_cache`` /
+``Cache``/``StaticCache``): every layer accepts ``cache=`` and, when given
+one, returns ``(output, updated_cache)`` with the newly projected K/V
+concatenated on the sequence axis — the reference's fused_multi_transformer
+decode semantics. For a jit-compiled fixed-shape decode loop see
+``models/gpt.py GPTForPretraining.generate``.
 """
 from __future__ import annotations
+
+import collections
 
 from ...tensor import manipulation as M
 from .. import functional as F
@@ -19,6 +28,9 @@ from .norm import LayerNorm
 
 class MultiHeadAttention(Layer):
     """Parity: paddle.nn.MultiHeadAttention (transformer.py:77)."""
+
+    Cache = collections.namedtuple("Cache", ["k", "v"])
+    StaticCache = collections.namedtuple("StaticCache", ["k", "v"])
 
     def __init__(self, embed_dim, num_heads, dropout=0.0, kdim=None, vdim=None, need_weights=False, weight_attr=None, bias_attr=None):
         super().__init__()
@@ -34,16 +46,47 @@ class MultiHeadAttention(Layer):
         self.v_proj = Linear(vdim, embed_dim, weight_attr, bias_attr)
         self.out_proj = Linear(embed_dim, embed_dim, weight_attr, bias_attr)
 
+    def _proj_kv(self, key, value):
+        b = key.shape[0]
+        k = M.reshape(self.k_proj(key), [b, -1, self.num_heads, self.head_dim])
+        v = M.reshape(self.v_proj(value), [b, -1, self.num_heads, self.head_dim])
+        return k, v
+
+    def gen_cache(self, key, value=None, type=None):
+        """Parity: transformer.py:284. ``type=StaticCache`` precomputes the
+        cross-attention K/V from ``key``/``value``; ``type=Cache`` (default)
+        starts an empty incremental self-attention cache."""
+        type = type or self.Cache
+        if type is self.StaticCache:
+            k, v = self._proj_kv(key, value if value is not None else key)
+            return self.StaticCache(k, v)
+        b = key.shape[0]
+        from ...tensor.creation import zeros
+
+        dt = key.dtype
+        empty = lambda: zeros([b, 0, self.num_heads, self.head_dim], dtype=dt)
+        return self.Cache(empty(), empty())
+
     def forward(self, query, key=None, value=None, attn_mask=None, cache=None):
         key = query if key is None else key
         value = key if value is None else value
         b = query.shape[0]
         q = M.reshape(self.q_proj(query), [b, -1, self.num_heads, self.head_dim])
-        k = M.reshape(self.k_proj(key), [b, -1, self.num_heads, self.head_dim])
-        v = M.reshape(self.v_proj(value), [b, -1, self.num_heads, self.head_dim])
+        if isinstance(cache, self.StaticCache):
+            k, v = cache.k, cache.v
+        else:
+            k, v = self._proj_kv(key, value)
+            if isinstance(cache, self.Cache):
+                if cache.k.shape[1] > 0:
+                    k = M.concat([cache.k, k], axis=1)
+                    v = M.concat([cache.v, v], axis=1)
+                cache = self.Cache(k, v)
         out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask, dropout_p=self.dropout, training=self.training)
         out = M.reshape(out, [b, -1, self.embed_dim])
-        return self.out_proj(out)
+        out = self.out_proj(out)
+        if cache is not None:
+            return out, cache
+        return out
 
 
 class TransformerEncoderLayer(Layer):
@@ -60,11 +103,17 @@ class TransformerEncoderLayer(Layer):
         self.dropout_act = Dropout(act_dropout if act_dropout is not None else dropout)
         self.activation = activation
 
+    def gen_cache(self, src):
+        return self.self_attn.gen_cache(src)
+
     def forward(self, src, src_mask=None, cache=None):
         residual = src
         if self.normalize_before:
             src = self.norm1(src)
-        src = self.self_attn(src, src, src, attn_mask=src_mask)
+        if cache is None:
+            src = self.self_attn(src, src, src, attn_mask=src_mask)
+        else:
+            src, cache = self.self_attn(src, src, src, attn_mask=src_mask, cache=cache)
         src = residual + self.dropout1(src)
         if not self.normalize_before:
             src = self.norm1(src)
@@ -76,6 +125,8 @@ class TransformerEncoderLayer(Layer):
         src = residual + self.dropout2(src)
         if not self.normalize_before:
             src = self.norm2(src)
+        if cache is not None:
+            return src, cache
         return src
 
 
@@ -88,12 +139,22 @@ class TransformerEncoder(Layer):
         self.num_layers = num_layers
         self.norm = norm
 
+    def gen_cache(self, src):
+        return [layer.gen_cache(src) for layer in self.layers]
+
     def forward(self, src, src_mask=None, cache=None):
         out = src
-        for layer in self.layers:
-            out = layer(out, src_mask=src_mask)
+        new_caches = []
+        for i, layer in enumerate(self.layers):
+            if cache is None:
+                out = layer(out, src_mask=src_mask)
+            else:
+                out, c = layer(out, src_mask=src_mask, cache=cache[i])
+                new_caches.append(c)
         if self.norm is not None:
             out = self.norm(out)
+        if cache is not None:
+            return out, new_caches
         return out
 
 
@@ -114,18 +175,32 @@ class TransformerDecoderLayer(Layer):
         self.dropout_act = Dropout(act_dropout if act_dropout is not None else dropout)
         self.activation = activation
 
+    def gen_cache(self, memory):
+        """Parity: transformer.py:610 — (incremental self-attn cache,
+        static cross-attn cache built from the encoder memory)."""
+        incremental = self.self_attn.gen_cache(memory)
+        static = self.cross_attn.gen_cache(memory, memory, type=MultiHeadAttention.StaticCache)
+        return incremental, static
+
     def forward(self, tgt, memory, tgt_mask=None, memory_mask=None, cache=None):
+        inc_cache, static_cache = cache if cache is not None else (None, None)
         residual = tgt
         if self.normalize_before:
             tgt = self.norm1(tgt)
-        tgt = self.self_attn(tgt, tgt, tgt, attn_mask=tgt_mask)
+        if inc_cache is None:
+            tgt = self.self_attn(tgt, tgt, tgt, attn_mask=tgt_mask)
+        else:
+            tgt, inc_cache = self.self_attn(tgt, tgt, tgt, attn_mask=tgt_mask, cache=inc_cache)
         tgt = residual + self.dropout1(tgt)
         if not self.normalize_before:
             tgt = self.norm1(tgt)
         residual = tgt
         if self.normalize_before:
             tgt = self.norm2(tgt)
-        tgt = self.cross_attn(tgt, memory, memory, attn_mask=memory_mask)
+        if static_cache is None:
+            tgt = self.cross_attn(tgt, memory, memory, attn_mask=memory_mask)
+        else:
+            tgt, _ = self.cross_attn(tgt, memory, memory, attn_mask=memory_mask, cache=static_cache)
         tgt = residual + self.dropout2(tgt)
         if not self.normalize_before:
             tgt = self.norm2(tgt)
@@ -137,6 +212,8 @@ class TransformerDecoderLayer(Layer):
         tgt = residual + self.dropout3(tgt)
         if not self.normalize_before:
             tgt = self.norm3(tgt)
+        if cache is not None:
+            return tgt, (inc_cache, static_cache)
         return tgt
 
 
@@ -149,12 +226,27 @@ class TransformerDecoder(Layer):
         self.num_layers = num_layers
         self.norm = norm
 
+    def gen_cache(self, memory, do_zip=False):
+        """Parity: transformer.py:721. ``do_zip`` transposes the per-layer
+        (incremental, static) pairs for the reference's decoding loop."""
+        cache = [layer.gen_cache(memory) for layer in self.layers]
+        if do_zip:
+            return list(zip(*cache))
+        return cache
+
     def forward(self, tgt, memory, tgt_mask=None, memory_mask=None, cache=None):
         out = tgt
-        for layer in self.layers:
-            out = layer(out, memory, tgt_mask=tgt_mask, memory_mask=memory_mask)
+        new_caches = []
+        for i, layer in enumerate(self.layers):
+            if cache is None:
+                out = layer(out, memory, tgt_mask=tgt_mask, memory_mask=memory_mask)
+            else:
+                out, c = layer(out, memory, tgt_mask=tgt_mask, memory_mask=memory_mask, cache=cache[i])
+                new_caches.append(c)
         if self.norm is not None:
             out = self.norm(out)
+        if cache is not None:
+            return out, new_caches
         return out
 
 

@@ -163,9 +163,17 @@ class Executor:
     _ExecutorCache; parameter/optimizer state round-trips through the concrete
     Tensors so eager code observes static updates and vice versa)."""
 
+    # compiled programs kept per executor; beyond this LRU bound the oldest
+    # recompiles on next use (varying feed shapes would otherwise accumulate
+    # jitted programs without bound — reference _ExecutorCache is similarly
+    # bounded by program identity)
+    _CACHE_CAPACITY = 64
+
     def __init__(self, place=None):
+        import collections
+
         self.place = place
-        self._cache: Dict[tuple, Any] = {}
+        self._cache: "collections.OrderedDict[tuple, Any]" = collections.OrderedDict()
         # keyed (prog.id, param-identity tuple); at most one live entry per
         # program — growing a program evicts its stale state
         self._opt_states: Dict[tuple, Any] = {}
@@ -227,6 +235,10 @@ class Executor:
         if key not in self._cache:
             self._cache[key] = self._build(prog, tuple(sorted(feed_arrays)), fetch_names,
                                            params, others, train)
+            while len(self._cache) > self._CACHE_CAPACITY:
+                self._cache.popitem(last=False)  # LRU eviction
+        else:
+            self._cache.move_to_end(key)
         fn = self._cache[key]
 
         opt = prog.optimizer

@@ -1,0 +1,101 @@
+"""hapi Model tests: jit-path fit, callbacks, checkpointing, metrics.
+
+Parity: python/paddle/hapi/model.py + hapi/callbacks.py.
+"""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.hapi.callbacks import Callback, EarlyStopping, LRScheduler, ModelCheckpoint
+from paddle_tpu.io import Dataset
+from paddle_tpu.metric import Accuracy
+from paddle_tpu.models.lenet import LeNet
+
+
+class RandomMNIST(Dataset):
+    def __init__(self, n=48):
+        rng = np.random.default_rng(0)
+        self.x = rng.normal(size=(n, 1, 28, 28)).astype("float32")
+        self.y = rng.integers(0, 10, (n, 1)).astype("int64")
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+
+def _prepared_model():
+    model = paddle.Model(LeNet())
+    opt = paddle.optimizer.Adam(learning_rate=1e-3, parameters=model.parameters())
+    model.prepare(opt, paddle.nn.CrossEntropyLoss(), Accuracy())
+    return model
+
+
+def test_fit_trains_on_jit_path_and_batch_size_honored():
+    paddle.seed(3)
+    model = _prepared_model()
+    ds = RandomMNIST()
+    seen = []
+
+    class CountSteps(Callback):
+        def on_train_batch_end(self, step, logs=None):
+            seen.append(step)
+
+    hist = model.fit(ds, batch_size=16, epochs=2, verbose=0, callbacks=[CountSteps()])
+    assert hist[-1] < hist[0]
+    assert max(seen) == 2  # 48 / 16 = 3 steps per epoch
+    assert model._train_step is not None  # trained through the compiled step
+
+
+def test_fit_checkpoint_and_restore():
+    paddle.seed(4)
+    model = _prepared_model()
+    ds = RandomMNIST()
+    with tempfile.TemporaryDirectory() as d:
+        model.fit(ds, batch_size=16, epochs=2, verbose=0, callbacks=[ModelCheckpoint(save_freq=1, save_dir=d)])
+        assert os.path.exists(f"{d}/0.pdparams")
+        assert os.path.exists(f"{d}/final.pdparams")
+        res = model.evaluate(ds, batch_size=16, verbose=0)
+        m2 = _prepared_model()
+        m2.load(f"{d}/final")
+        r2 = m2.evaluate(ds, batch_size=16, verbose=0)
+        np.testing.assert_allclose(r2["loss"], res["loss"], rtol=1e-4)
+        np.testing.assert_allclose(r2["acc"], res["acc"], rtol=1e-6)
+
+
+def test_lr_scheduler_callback_steps():
+    paddle.seed(5)
+    model = paddle.Model(LeNet())
+    sched = paddle.optimizer.lr.StepDecay(learning_rate=0.1, step_size=1, gamma=0.5)
+    opt = paddle.optimizer.SGD(learning_rate=sched, parameters=model.parameters())
+    model.prepare(opt, paddle.nn.CrossEntropyLoss())
+    ds = RandomMNIST(32)
+    model.fit(ds, batch_size=16, epochs=1, verbose=0, callbacks=[LRScheduler(by_step=True)])
+    assert sched.last_epoch == 2  # stepped once per train batch
+
+
+def test_early_stopping_stops():
+    paddle.seed(6)
+    model = _prepared_model()
+    ds = RandomMNIST(32)
+
+    class ConstantEval(Callback):
+        pass
+
+    es = EarlyStopping(monitor="loss", patience=0, verbose=0, mode="min", baseline=0.0)
+    hist = model.fit(ds, eval_data=ds, batch_size=16, epochs=5, verbose=0, callbacks=[es])
+    # baseline 0 is never beaten -> stops after first eval
+    assert len(hist) == 1
+    assert model.stop_training
+
+
+def test_predict_stack_outputs():
+    paddle.seed(7)
+    model = _prepared_model()
+    ds = RandomMNIST(32)
+    preds = model.predict(ds, batch_size=16, stack_outputs=True, verbose=0)
+    assert preds[0].shape == (32, 10)

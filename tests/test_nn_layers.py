@@ -262,3 +262,49 @@ class TestEmbedDropout:
         assert abs((out == 0).mean() - 0.5) < 0.1
         # upscale keeps expectation
         assert abs(out.mean() - 1.0) < 0.15
+
+
+class TestTransformerCache:
+    def test_mha_incremental_cache_matches_full(self):
+        import paddle_tpu as paddle
+        from paddle_tpu import nn
+
+        mha = nn.MultiHeadAttention(32, 4)
+        mha.eval()
+        x = paddle.to_tensor(np.random.default_rng(0).normal(size=(2, 5, 32)).astype("float32"))
+        import jax.numpy as jnp
+        # full causal pass
+        from paddle_tpu.framework.core import _wrap_value
+        mask = _wrap_value(jnp.tril(jnp.ones((5, 5), bool)))
+        full = mha(x, x, x, attn_mask=mask).numpy()
+        cache = mha.gen_cache(x)
+        outs = []
+        for t in range(5):
+            o, cache = mha(x[:, t:t + 1], x[:, t:t + 1], x[:, t:t + 1], cache=cache)
+            outs.append(o.numpy())
+        np.testing.assert_allclose(np.concatenate(outs, axis=1), full, rtol=2e-5, atol=2e-5)
+
+    def test_decoder_static_cache_cross_attention(self):
+        import paddle_tpu as paddle
+        from paddle_tpu import nn
+
+        layer = nn.TransformerDecoderLayer(32, 4, 64, dropout=0.0)
+        layer.eval()
+        dec = nn.TransformerDecoder(layer, 2)
+        dec.eval()
+        rng = np.random.default_rng(2)
+        memory = paddle.to_tensor(rng.normal(size=(2, 7, 32)).astype("float32"))
+        tgt = paddle.to_tensor(rng.normal(size=(2, 4, 32)).astype("float32"))
+        caches = dec.gen_cache(memory)
+        outs = []
+        cur = caches
+        for t in range(4):
+            o, cur = dec(tgt[:, t:t + 1], memory, cache=cur)
+            outs.append(o.numpy())
+        inc = np.concatenate(outs, axis=1)
+        # full causal pass for comparison
+        import jax.numpy as jnp
+        from paddle_tpu.framework.core import _wrap_value
+        mask = _wrap_value(jnp.tril(jnp.ones((4, 4), bool)))
+        full = dec(tgt, memory, tgt_mask=mask).numpy()
+        np.testing.assert_allclose(inc, full, rtol=2e-5, atol=2e-5)
