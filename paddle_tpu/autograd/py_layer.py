@@ -77,6 +77,17 @@ class PyLayer(metaclass=PyLayerMeta):
 
     @classmethod
     def apply(cls, *args, **kwargs):
+        # Traced execution (inside jit.TrainStep / to_static / jax.grad over
+        # raw arrays): the eager tape is absent, and letting jax.grad
+        # differentiate *through* forward would silently ignore the user's
+        # backward. Bridge to jax.custom_vjp instead so the custom backward
+        # is honored in compiled graphs (reference parity: PyLayer grads are
+        # part of the program, custom_operator.cc grad-op registration).
+        import jax as _jax
+
+        if any(isinstance(a, Tensor) and isinstance(a._value, _jax.core.Tracer)
+               for a in list(args) + list(kwargs.values())):
+            return cls._apply_traced(*args, **kwargs)
         ctx = PyLayerContext()
         # inputs that participate in grad flow: positional first, then kwargs
         # in insertion order (reference packs kwarg tensors into the graph too)
@@ -158,3 +169,79 @@ class PyLayer(metaclass=PyLayerMeta):
             for i, o in enumerate(outs)
         )
         return wrapped if multi else wrapped[0]
+
+    @classmethod
+    def _apply_traced(cls, *args, **kwargs):
+        """custom_vjp form used when inputs carry jax tracers. ctx python
+        attributes set in forward are smuggled to backward via a closure cell
+        (they are trace-time constants); saved tensors ride the residuals so
+        they re-bind to the backward trace. Tensor kwargs are routed through
+        the custom_vjp alongside positional tensors (the eager path includes
+        them in grad flow too)."""
+        import jax as _jax
+        import jax.numpy as jnp
+
+        tensor_idx = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
+        kw_keys = [k for k, v in kwargs.items() if isinstance(v, Tensor)]
+        xs = tuple(args[i]._value for i in tensor_idx) + tuple(kwargs[k]._value for k in kw_keys)
+        cell = {}
+
+        def rebuild(vals):
+            rebuilt = list(args)
+            for i, v in zip(tensor_idx, vals):
+                rebuilt[i] = _wrap_value(v, stop_gradient=args[i].stop_gradient)
+            kw = dict(kwargs)
+            for k, v in zip(kw_keys, vals[len(tensor_idx):]):
+                kw[k] = _wrap_value(v, stop_gradient=kwargs[k].stop_gradient)
+            return rebuilt, kw
+
+        def run_forward(vals):
+            ctx = PyLayerContext()
+            pos, kw = rebuild(vals)
+            with no_grad():
+                out = cls.forward(ctx, *pos, **kw)
+            multi = isinstance(out, (tuple, list))
+            outs = tuple(out) if multi else (out,)
+            return ctx, tuple(o._value for o in outs), multi
+
+        @_jax.custom_vjp
+        def f(*vals):
+            _, out_vals, multi = run_forward(vals)
+            return out_vals if multi else out_vals[0]
+
+        def f_fwd(*vals):
+            ctx, out_vals, multi = run_forward(vals)
+            cell["ctx"], cell["multi"] = ctx, multi
+            saved = tuple(t._value for t in ctx._saved)
+            return (out_vals if multi else out_vals[0]), (vals, saved)
+
+        def f_bwd(res, g):
+            import numpy as np
+
+            vals, saved = res
+            ctx = cell["ctx"]
+            ctx._saved = [_wrap_value(s) for s in saved]
+            gs = tuple(g) if isinstance(g, (tuple, list)) else (g,)
+            with no_grad():
+                gin = cls.backward(ctx, *[_wrap_value(x) for x in gs])
+            gin = list(gin) if isinstance(gin, (tuple, list)) else [gin]
+            if len(gin) != len(vals):
+                raise ValueError(
+                    f"{cls.__name__}.backward returned {len(gin)} grads for "
+                    f"{len(vals)} tensor inputs (traced PyLayer needs one per "
+                    "tensor input)")
+
+            def cot(t, v):
+                if not _is_float_array(v):  # integer/bool primal: float0
+                    return np.zeros(v.shape, _jax.dtypes.float0)
+                if t is None:
+                    return jnp.zeros(v.shape, v.dtype)
+                return (t._value if isinstance(t, Tensor) else jnp.asarray(t)).astype(v.dtype).reshape(v.shape)
+
+            return tuple(cot(t, v) for t, v in zip(gin, vals))
+
+        f.defvjp(f_fwd, f_bwd)
+        out = f(*xs)
+        if cell.get("multi", isinstance(out, (tuple, list))):
+            return tuple(_wrap_value(o) for o in out)
+        return _wrap_value(out)

@@ -33,15 +33,26 @@ class Fleet:
     def init(self, role_maker=None, is_collective=True, strategy=None, devices=None):
         self._strategy = strategy or DistributedStrategy()
         hc = self._strategy.hybrid_configs
+        tp = self._strategy.tensor_parallel_configs
+        # tensor_parallel_configs is the non-hybrid way to ask for TP
+        # (reference distributed_strategy.py tensor_parallel:1406): honor its
+        # degree when hybrid_configs doesn't set one
+        mp_degree = hc.mp_degree if hc.mp_degree > 1 else int(tp.tensor_parallel_degree)
         init_parallel_env()
         self._hcg = HybridCommunicateGroup(
             dp_degree=hc.dp_degree,
-            mp_degree=hc.mp_degree,
+            mp_degree=mp_degree,
             pp_degree=hc.pp_degree,
             sharding_degree=hc.sharding_degree,
             sep_degree=hc.sep_degree,
             devices=devices,
         )
+        if int(tp.tensor_init_seed) >= 0:
+            # model-parallel RNG determinism (reference parallel_layers/
+            # random.py RNGStatesTracker seeding)
+            from .mp_layers import get_rng_state_tracker
+
+            get_rng_state_tracker().add("model_parallel_rng", int(tp.tensor_init_seed))
         self._is_initialized = True
         return self
 
@@ -92,9 +103,26 @@ class Fleet:
         mesh = self._hcg.mesh
         strat = self._strategy
         stage = strat.sharding_configs.sharding_stage if (strat.sharding or strat.hybrid_configs.sharding_degree > 1) else 0
+        offload = bool(strat.sharding_configs.offload) and stage >= 1
+        if not strat.sharding_configs.comm_overlap:
+            import warnings
+
+            warnings.warn(
+                "sharding_configs.comm_overlap=False has no effect: XLA's "
+                "latency-hiding scheduler always overlaps collectives with "
+                "compute (the reference's manual comm/calc stream overlap is "
+                "subsumed)")
         remat = strat.recompute or strat.recompute_configs.enable
         amp_level = strat.amp_configs.level if (strat.amp or strat.amp_configs.enable) else None
         amp_dtype = strat.amp_configs.dtype if amp_level else "bfloat16"
+        if amp_level and str(amp_dtype) in ("float16", "fp16"):
+            raise ValueError(
+                "strategy amp with float16 needs loss scaling "
+                f"(init_loss_scaling={strat.amp_configs.init_loss_scaling}, "
+                f"dynamic={strat.amp_configs.use_dynamic_loss_scaling}) which "
+                "the fused TrainStep does not implement — use bfloat16 "
+                "(TPU-native, no scaling needed) or the eager amp.GradScaler "
+                "path")
         accumulate = 1
         if strat.gradient_merge:
             accumulate = int(strat.gradient_merge_configs.get("k_steps", 1))
@@ -108,7 +136,7 @@ class Fleet:
 
         step = TrainStep(model, optimizer, loss_fn, remat=remat, seed=seed,
                          amp_level=amp_level, amp_dtype=amp_dtype, accumulate_steps=accumulate)
-        shardings = state_shardings(step.state, mesh, stage=stage, mp_specs=mp_specs)
+        shardings = state_shardings(step.state, mesh, stage=stage, mp_specs=mp_specs, offload=offload)
         if batch_sharding is None:
             # default: every batch leaf sharded on dim0 over the data axes
             batch_sharding = NamedSharding(mesh, P(("dp", "sdp")))

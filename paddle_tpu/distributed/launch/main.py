@@ -37,37 +37,39 @@ class CollectiveController:
         self.ctx = ctx
         self.procs: List[WorkerProc] = []
 
-    def _env_for(self, local_rank: int) -> dict:
+    def _env_for(self, local_rank: int, nnodes=None, node_rank=None) -> dict:
         a = self.ctx.args
-        rank = a.rank * a.nproc_per_node + local_rank
-        world = a.nnodes * a.nproc_per_node
+        nnodes = a.nnodes if nnodes is None else nnodes
+        node_rank = a.rank if node_rank is None else node_rank
+        rank = node_rank * a.nproc_per_node + local_rank
+        world = nnodes * a.nproc_per_node
         env = dict(os.environ)
         env.update({
             "PADDLE_TRAINER_ID": str(rank),
             "PADDLE_TRAINERS_NUM": str(world),
             "PADDLE_MASTER": a.master,
             "PADDLE_LOCAL_RANK": str(local_rank),
-            "PADDLE_NNODES": str(a.nnodes),
+            "PADDLE_NNODES": str(nnodes),
             "FLAGS_selected_devices": str(local_rank),
         })
         if a.devices:
             env["CUDA_VISIBLE_DEVICES"] = a.devices  # accepted for API parity
         return env
 
-    def spawn(self):
+    def spawn(self, nnodes=None, node_rank=None):
         a = self.ctx.args
+        base = (a.rank if node_rank is None else node_rank) * a.nproc_per_node
         self.procs = []
         for i in range(a.nproc_per_node):
             log_path = None
             stdout = None
             if a.log_dir:
                 os.makedirs(a.log_dir, exist_ok=True)
-                rank = a.rank * a.nproc_per_node + i
-                log_path = os.path.join(a.log_dir, f"worker.{rank}.log")
+                log_path = os.path.join(a.log_dir, f"worker.{base + i}.log")
                 stdout = open(log_path, "ab")
             cmd = [sys.executable, "-u", self.ctx.args.training_script] + self.ctx.script_args
-            proc = subprocess.Popen(cmd, env=self._env_for(i), stdout=stdout, stderr=subprocess.STDOUT if stdout else None)
-            self.procs.append(WorkerProc(a.rank * a.nproc_per_node + i, proc, log_path))
+            proc = subprocess.Popen(cmd, env=self._env_for(i, nnodes, node_rank), stdout=stdout, stderr=subprocess.STDOUT if stdout else None)
+            self.procs.append(WorkerProc(base + i, proc, log_path))
 
     def poll(self):
         """(still_running, failed_ranks, done)"""
@@ -96,9 +98,11 @@ class CollectiveController:
         for w in self.procs:
             w.proc.wait()
 
-    def watch(self, interval=0.5) -> int:
+    def watch(self, interval=0.5, tick=None) -> int:
         """Block until all workers exit; on any failure terminate the rest.
-        Returns 0 on success, first failing signal/code otherwise."""
+        Returns 0 on success, first failing signal/code otherwise. ``tick``
+        (if given) is called each poll; if it returns a non-None value the
+        watch stops and returns it (elastic membership interrupts)."""
         while True:
             running, failed, done = self.poll()
             if failed:
@@ -106,11 +110,15 @@ class CollectiveController:
                 return 1
             if done:
                 return 0
+            if tick is not None:
+                r = tick()
+                if r is not None:
+                    return r
             time.sleep(interval)
 
 
 class ElasticManager:
-    """Minimal elastic loop (reference fleet/elastic/manager.py:131,577):
+    """Fixed-world elastic loop (reference fleet/elastic/manager.py:131):
     when a worker dies, tear the job down and relaunch the whole collective
     — membership changes restart the world, training resumes from the
     user's own checkpoints."""
@@ -135,6 +143,68 @@ class ElasticManager:
             self.controller.spawn()
 
 
+class ElasticMembershipManager:
+    """True elasticity (reference ElasticManager watch loop,
+    fleet/elastic/manager.py:577): TCPStore-heartbeat membership, HOLD on
+    join/leave, RESTART with rescaled node ranks when the alive set settles
+    inside the allowed np range. Training scripts resume from their own
+    checkpoints (the reference contract)."""
+
+    def __init__(self, controller: CollectiveController, np_range, max_restarts=10,
+                 heartbeat_interval=0.5, node_timeout=3.0):
+        from ..elastic import ElasticNode
+        from ..store import TCPStore
+
+        self.controller = controller
+        self.min_np, self.max_np = np_range
+        self.max_restarts = max_restarts
+        a = controller.ctx.args
+        host, port = a.master.rsplit(":", 1)
+        # port map: <master> itself is the workers' jax.distributed
+        # coordinator, +1 is init_parallel_env's bootstrap store (env.py) —
+        # the membership registry takes +2 to collide with neither.
+        # The node with --rank 0 hosts it; others connect (reference: etcd).
+        self.store = TCPStore(host=host, port=int(port) + 2, is_master=(a.rank == 0),
+                              world_size=a.nnodes, timeout=60.0)
+        self.node = ElasticNode(self.store, heartbeat_interval, node_timeout)
+        self.restarts = 0
+
+    def run(self, interval=0.3) -> int:
+        members = self.node.wait_for(self.min_np, self.max_np)
+        while True:
+            if self.node.node_id not in members:
+                members = self.node.wait_for(self.min_np, self.max_np)
+                continue
+            nnodes = len(members)
+            node_rank = members.index(self.node.node_id)
+            print(f"[launch][elastic] membership={members} -> nnodes={nnodes} "
+                  f"node_rank={node_rank}", file=sys.stderr, flush=True)
+            self.controller.spawn(nnodes=nnodes, node_rank=node_rank)
+
+            cur = members
+
+            def membership_tick():
+                # any membership change → HOLD (terminate + settle + respawn;
+                # below-min worlds simply keep waiting inside wait_for)
+                if self.node.alive_nodes() != cur:
+                    return 100
+                return None
+
+            rc = self.controller.watch(interval, tick=membership_tick)
+            if rc == 0:
+                self.node.leave()
+                return 0
+            self.controller.terminate()
+            if rc != 100:  # genuine worker failure, not a membership event
+                if self.restarts >= self.max_restarts:
+                    print(f"[launch][elastic] restart budget ({self.max_restarts}) exhausted", file=sys.stderr)
+                    self.node.leave()
+                    return rc
+                self.restarts += 1
+            # HOLD → settle → RESTART with rescaled ranks
+            members = self.node.wait_for(self.min_np, self.max_np)
+
+
 def _parser():
     p = argparse.ArgumentParser(prog="paddle_tpu.distributed.launch", description="multi-host collective launcher (reference launch/main.py parity)")
     p.add_argument("--nnodes", type=int, default=1, help="number of nodes (hosts)")
@@ -144,6 +214,8 @@ def _parser():
     p.add_argument("--log_dir", type=str, default=None, help="per-worker log directory")
     p.add_argument("--devices", "--gpus", type=str, default=None, help="device selection (parity flag)")
     p.add_argument("--elastic_retries", type=int, default=0, help="relaunch the collective up to N times on worker failure")
+    p.add_argument("--elastic_np", type=str, default=os.environ.get("PADDLE_ELASTIC_NP"), help="elastic node range 'min:max' (or 'n'): membership-managed launch with rescaling")
+    p.add_argument("--elastic_timeout", type=float, default=3.0, help="heartbeat staleness (s) before a node is considered gone")
     p.add_argument("training_script", type=str)
     return p
 
@@ -153,6 +225,13 @@ def launch(argv=None):
     ns, script_args = _parser().parse_known_args(argv)
     ctx = LaunchContext(ns, script_args)
     controller = CollectiveController(ctx)
+    if ns.elastic_np:
+        from ..elastic import parse_np_range
+
+        return ElasticMembershipManager(
+            controller, parse_np_range(ns.elastic_np),
+            max_restarts=ns.elastic_retries or 10,
+            node_timeout=ns.elastic_timeout).run()
     if ns.elastic_retries > 0:
         return ElasticManager(controller, ns.elastic_retries).run()
     controller.spawn()

@@ -140,18 +140,47 @@ class TensorParallel(Layer):
         return self._layers(*args, **kwargs)
 
 
+class _RNGStatesTracker:
+    """Named RNG streams (parity: parallel_layers/random.py RNGStatesTracker).
+
+    ``rng_state(name)`` is a context under which dropout draws come from the
+    named stream's own JAX key chain — the reference keeps per-name CUDA RNG
+    states so mp-sharded dropout is identical across tensor-parallel ranks
+    while local dropout differs; with explicit JAX keys a stream is just a
+    seeded key we fold a call-counter into."""
+
+    def __init__(self):
+        import jax
+
+        self._jax = jax
+        self._seeds = {}
+        self._counts = {}
+
+    def add(self, name, seed):
+        if name in self._seeds and self._seeds[name] != int(seed):
+            raise ValueError(f"RNG stream {name!r} already added with a different seed")
+        self._seeds[name] = int(seed)
+        self._counts.setdefault(name, 0)
+
+    def get_states_tracker(self):
+        return dict(self._seeds)
+
+    def rng_state(self, name="model_parallel_rng"):
+        from ..framework import random as _random
+
+        if name not in self._seeds:
+            raise ValueError(f"unknown RNG stream {name!r}; call add(name, seed) first")
+        self._counts[name] += 1
+        key = self._jax.random.fold_in(
+            self._jax.random.key(self._seeds[name]), self._counts[name])
+        return _random.rng_scope(key)
+
+
+_RNG_TRACKER = None
+
+
 def get_rng_state_tracker():
-    """Parity shim for parallel_layers/random.py RNG tracker: JAX keys are
-    explicit, so 'local' vs 'global' dropout seeds are just different fold-in
-    constants; provided for API compat."""
-
-    class _Tracker:
-        def add(self, name, seed):
-            pass
-
-        def rng_state(self, name="global_seed"):
-            import contextlib
-
-            return contextlib.nullcontext()
-
-    return _Tracker()
+    global _RNG_TRACKER
+    if _RNG_TRACKER is None:
+        _RNG_TRACKER = _RNGStatesTracker()
+    return _RNG_TRACKER

@@ -8,6 +8,15 @@ compat and simply scales the loss / passes through; the real DP path is
 ``fleet.distributed_step`` (grad all-reduce fused by XLA over 'dp').
 Multi-host "launch" = one process per host with jax.distributed.initialize
 (env.py), not one per device.
+
+Multi-host eager DDP is the reference Reducer redesigned for XLA
+(imperative/reducer.cc:127): gradients are coalesced into ≤comm_buffer_size
+MB flat buckets per dtype (bucket plan fixed at construction, so bucket
+shapes — and therefore compiled collectives — are stable), each bucket is
+all-reduce-meaned by ONE jitted shard_map over a process mesh built once in
+``__init__``, and the flush runs from an end-of-backward callback rather
+than per-parameter hooks (no per-grad dispatch, ≤ a couple of compiled
+functions total).
 """
 from __future__ import annotations
 
@@ -15,38 +24,106 @@ from ..nn.layer.base import Layer
 from .env import ParallelEnv, get_rank, get_world_size, init_parallel_env
 
 
-def _cross_process_mean(value):
-    """Eager all-reduce-mean across processes: one device per process forms a
-    1-D mesh, the local value rides in as that process's shard, pmean inside
-    shard_map produces the replicated mean (the eager analog of the
-    reference Reducer's fused NCCL all-reduce, imperative/reducer.cc)."""
+def _process_mesh():
+    """1-D mesh with one (first) device per process."""
     import jax
-    import jax.numpy as jnp
     import numpy as np
-    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from jax.sharding import Mesh
 
     first_local = {}
     for d in jax.devices():
         first_local.setdefault(d.process_index, d)
-    mesh = Mesh(np.array([first_local[i] for i in range(jax.process_count())]), ("ddp",))
-    sh = NamedSharding(mesh, P("ddp"))
-    stacked = jax.make_array_from_process_local_data(sh, np.asarray(value)[None])
-    out = jax.jit(
-        jax.shard_map(lambda x: jax.lax.pmean(x, "ddp"), mesh=mesh, in_specs=P("ddp"), out_specs=P("ddp")),
-        out_shardings=sh,
-    )(stacked)
-    return jnp.asarray(out.addressable_shards[0].data)[0]
+    return Mesh(np.array([first_local[i] for i in range(jax.process_count())]), ("ddp",))
+
+
+class _BucketReducer:
+    """Coalesced cross-process grad averaging over a fixed bucket plan."""
+
+    def __init__(self, params, comm_buffer_mb=25):
+        import numpy as np
+
+        self.mesh = _process_mesh()
+        self._pmean = {}  # (n_elems, dtype) -> jitted shard_map
+        # fixed bucket plan: group by dtype, fill to the byte budget
+        budget = int(comm_buffer_mb * 1024 * 1024)
+        by_dtype = {}
+        for p in params:
+            by_dtype.setdefault(str(p._value.dtype), []).append(p)
+        self.buckets = []  # list of (dtype, [params])
+        for dt, ps in by_dtype.items():
+            cur, cur_bytes = [], 0
+            for p in ps:
+                nbytes = int(np.prod(p._value.shape or (1,))) * p._value.dtype.itemsize
+                if cur and cur_bytes + nbytes > budget:
+                    self.buckets.append((dt, cur))
+                    cur, cur_bytes = [], 0
+                cur.append(p)
+                cur_bytes += nbytes
+            if cur:
+                self.buckets.append((dt, cur))
+
+    def _pmean_fn(self, n, dtype):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        key = (n, dtype)
+        if key not in self._pmean:
+            sh = NamedSharding(self.mesh, P("ddp"))
+            self._pmean[key] = (
+                jax.jit(
+                    jax.shard_map(lambda x: jax.lax.pmean(x, "ddp"),
+                                  mesh=self.mesh, in_specs=P("ddp"), out_specs=P("ddp")),
+                    out_shardings=sh,
+                ),
+                sh,
+            )
+        return self._pmean[key]
+
+    def reduce(self, find_unused_parameters=False):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ..framework.core import _wrap_value
+
+        all_ps = [p for _, ps in self.buckets for p in ps]
+        have = [p for p in all_ps if p.grad is not None]
+        if not have:
+            return
+        if not find_unused_parameters and len(have) < len(all_ps):
+            missing = [p.name or "<unnamed>" for p in all_ps if p.grad is None]
+            raise RuntimeError(
+                "DataParallel: these parameters produced no gradient: "
+                f"{missing}. Pass find_unused_parameters=True if parts of "
+                "the model are intentionally unused (reference reducer "
+                "semantics).")
+        for dt, ps in self.buckets:
+            grads = [p.grad for p in ps]
+            if not any(g is not None for g in grads):
+                continue  # whole bucket untouched this pass
+            flat = jnp.concatenate([
+                jnp.zeros(int(np.prod(p._value.shape or (1,))), p._value.dtype) if g is None
+                else jnp.asarray(g._value).reshape(-1)
+                for p, g in zip(ps, grads)
+            ])
+            fn, sh = self._pmean_fn(int(flat.shape[0]), dt)
+            stacked = jax.make_array_from_process_local_data(sh, np.asarray(flat)[None])
+            out = jnp.asarray(fn(stacked).addressable_shards[0].data)[0]
+            off = 0
+            for p, g in zip(ps, grads):
+                n = int(np.prod(p._value.shape or (1,)))
+                if g is not None:
+                    g._value = out[off:off + n].reshape(p._value.shape)
+                off += n
 
 
 class DataParallel(Layer):
     """Parity: python/paddle/fluid/dygraph/parallel.py:419.
 
-    With ``world_size > 1`` (multi-host), every trainable parameter gets a
-    grad hook that all-reduce-means its gradient across processes during
-    ``loss.backward()`` — the reducer semantics (imperative/reducer.cc:127)
-    without bucketing (XLA fuses the per-tensor reduces it can). Single
-    process (one controller driving all local devices) needs no sync: there
-    is exactly one copy of every parameter.
+    With ``world_size > 1`` (multi-host), gradients are averaged across
+    processes at the end of ``loss.backward()`` via the bucketed reducer
+    above. Single process (one controller driving all local devices) needs
+    no sync: there is exactly one copy of every parameter.
     """
 
     def __init__(self, layers, strategy=None, comm_buffer_size=25, last_comm_buffer_size=1, find_unused_parameters=False, group=None):
@@ -57,34 +134,51 @@ class DataParallel(Layer):
         self._sync_enabled = True
         self._hook_handles = []
         if self._grad_sync:
-            for p in layers.parameters():
-                if not p.stop_gradient:
-                    self._hook_handles.append(p.register_hook(self._make_hook()))
+            import weakref
 
-    def _make_hook(self):
-        def hook(grad):
-            if not self._sync_enabled:
-                return None
-            from ..framework.core import _wrap_value
+            from ..framework.autograd import register_post_backward_callback
 
-            return _wrap_value(_cross_process_mean(grad._value))
+            tracked = [p for p in layers.parameters() if not p.stop_gradient]
+            self._reducer = _BucketReducer(tracked, comm_buffer_mb=comm_buffer_size)
+            # grad hooks mark which params participated in THIS backward pass
+            # (persisted grads from prior passes are already process-identical
+            # after their own sync; re-averaging them is the identity, so the
+            # pending set only gates cost/which-model, not correctness)
+            self._pending = set()
+            for p in tracked:
+                pid = id(p)
+                self._hook_handles.append(
+                    p.register_hook(lambda g, _pid=pid, _s=self: _s._pending.add(_pid) or None))
 
-        return hook
+            ref = weakref.ref(self)
+            handle_cell = []
+
+            def flush():
+                dp = ref()
+                if dp is None:  # wrapper discarded: self-deregister
+                    if handle_cell:
+                        handle_cell[0].remove()
+                    return
+                if not dp._sync_enabled or not dp._pending:
+                    return
+                dp._pending.clear()
+                dp._reducer.reduce(dp.find_unused_parameters)
+
+            handle_cell.append(register_post_backward_callback(flush))
+            self._hook_handles.append(handle_cell[0])
 
     def forward(self, *inputs, **kwargs):
         return self._layers(*inputs, **kwargs)
 
     def scale_loss(self, loss):
-        return loss  # hooks use pmean, so the loss needs no rescaling
+        return loss  # reducer uses pmean, so the loss needs no rescaling
 
     def apply_collective_grads(self):
         """Manual fallback (reference DataParallel.apply_collective_grads):
         all-reduce every .grad now — for use with no_sync() accumulation."""
         if not self._grad_sync:
             return
-        for p in self._layers.parameters():
-            if p.grad is not None:
-                p.grad._value = _cross_process_mean(p.grad._value)
+        self._reducer.reduce(find_unused_parameters=True)
 
     def state_dict(self, *args, **kwargs):
         return self._layers.state_dict(*args, **kwargs)

@@ -24,7 +24,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
-def spmd_pipeline(stage_fn: Callable, stacked_params: Any, x_mb: jnp.ndarray, mesh: Mesh, axis: str = "pp", remat: bool = False, extras: Tuple = (), mb_index: bool = False):
+def spmd_pipeline(stage_fn: Callable, stacked_params: Any, x_mb: jnp.ndarray, mesh: Mesh, axis: str = "pp", remat: bool = False, extras: Tuple = (), mb_index: bool = False, schedule: str = "gpipe"):
     """Run ``stage_fn`` as an ``n_stages``-deep pipeline over microbatches.
 
     stage_fn(layer_params, x, *extras) -> y applies ONE layer; y.shape == x.shape.
@@ -38,14 +38,25 @@ def spmd_pipeline(stage_fn: Callable, stacked_params: Any, x_mb: jnp.ndarray, me
         stage_fn(layer_params, x, mb_idx, *extras) with the scalar microbatch
         index being processed — needed e.g. to draw distinct dropout masks
         per microbatch.
+    schedule: the *memory* schedule (reference pipeline_parallel.py:154
+        startup/steady/cooldown 1F1B). In a single-SPMD-program pipeline the
+        XLA scheduler owns op ordering, so the honest analog of 1F1B is its
+        memory bound: ``"1f1b"`` rematerializes every stage application, so
+        only the O(n_micro) stage-BOUNDARY activations are stored and the
+        per-layer residual footprint is O(1) microbatches — at or below the
+        reference 1F1B's O(pp) in-flight activations (measured: test_pipeline
+        ``test_1f1b_memory_bound`` via compiled.memory_analysis()).
+        ``"gpipe"`` keeps all residuals (fastest when memory allows).
     returns [n_micro, micro_batch, ...] outputs of the final stage.
     """
+    if schedule not in ("gpipe", "1f1b"):
+        raise ValueError(f"pipeline schedule must be 'gpipe' or '1f1b', got {schedule!r}")
     n_stages = mesh.shape[axis]
     n_micro = x_mb.shape[0]
     leaves = jax.tree_util.tree_leaves(stacked_params)
     n_layers = leaves[0].shape[0]
     assert n_layers % n_stages == 0, f"{n_layers} layers not divisible by {n_stages} stages"
-    body = jax.checkpoint(stage_fn) if remat else stage_fn
+    body = jax.checkpoint(stage_fn) if (remat or schedule == "1f1b") else stage_fn
 
     def apply_stage(params_local, h, mb, extra):
         def scan_body(hh, lp):
@@ -141,6 +152,15 @@ def active_pipeline_plan():
     if n_micro <= 1:
         n_micro = 2 * pp  # default: enough microbatches to keep bubbles ~1/3
     return mesh, n_micro
+
+
+def active_pipeline_schedule() -> str:
+    """The live strategy's pipeline memory schedule ('gpipe' | '1f1b')."""
+    from .fleet import fleet
+
+    if fleet._strategy is not None:
+        return fleet._strategy.pipeline_configs.schedule
+    return "gpipe"
 
 
 class LayerDesc:
@@ -274,7 +294,9 @@ class PipelineLayer:
                 return unwrap(out)
 
             xm = microbatch(xx, n_micro, mesh)
-            out = spmd_pipeline(stage_fn, tuple(stacks), xm, mesh, remat=self.recompute_interval > 0)
+            out = spmd_pipeline(stage_fn, tuple(stacks), xm, mesh,
+                                remat=self.recompute_interval > 0,
+                                schedule=active_pipeline_schedule())
             return unmicrobatch(out, mesh)
 
         flat = [p for group in stacked_tensors for p in group]

@@ -95,18 +95,37 @@ def build_state_specs(params: Dict[str, np.ndarray], mesh: Mesh, stage: int = 1,
     return param_specs, opt_specs
 
 
-def state_shardings(state, mesh: Mesh, stage: int = 1, mp_specs=None):
-    """Shardings pytree matching a TrainStep state dict."""
+def state_shardings(state, mesh: Mesh, stage: int = 1, mp_specs=None, offload=False):
+    """Shardings pytree matching a TrainStep state dict.
+
+    ``offload=True`` is ZeRO-offload parity (reference
+    group_sharded_optimizer_stage2.py ``offload=True`` keeps optimizer state
+    in host memory): optimizer-state shardings get
+    ``memory_kind='pinned_host'`` — XLA stages the m/v tensors in host RAM
+    and streams them through the fused update. Falls back to device memory
+    (with a warning) on backends without host memory spaces."""
     params = state["params"]
     param_specs, opt_specs = build_state_specs(params, mesh, stage, mp_specs)
 
     def ns(spec):
         return NamedSharding(mesh, spec)
 
+    def ns_opt(spec):
+        if offload:
+            try:
+                return NamedSharding(mesh, spec, memory_kind="pinned_host")
+            except (ValueError, TypeError):
+                import warnings
+
+                warnings.warn("sharding offload=True: backend has no pinned_host "
+                              "memory space; optimizer state stays in device memory")
+                return NamedSharding(mesh, spec)
+        return NamedSharding(mesh, spec)
+
     # opt state: dict of moment-name -> {param-name: array}
     opt_shard = {}
     for moment_name, tree in state["opt"].items():
-        opt_shard[moment_name] = {k: ns(opt_specs.get(k, P())) for k in tree}
+        opt_shard[moment_name] = {k: ns_opt(opt_specs.get(k, P())) for k in tree}
     return {
         "params": {k: ns(s) for k, s in param_specs.items()},
         "buffers": {k: ns(P()) for k in state["buffers"]},
@@ -123,4 +142,5 @@ def group_sharded_parallel(model, optimizer, level="os_g", scaler=None, group=No
     stage = {"os": 1, "os_g": 2, "p_g_os": 3}[level]
     model._sharding_stage = stage
     optimizer._sharding_stage = stage
+    model._sharding_offload = optimizer._sharding_offload = bool(offload)
     return model, optimizer, scaler

@@ -200,6 +200,30 @@ def backward(tensors, grad_tensors=None, retain_graph=False):
         for node in processed:
             node.release()
 
+    # end-of-backward callbacks (reference: Reducer finalize_backward /
+    # queued callbacks in backward.cc) — e.g. DataParallel's bucketed
+    # all-reduce flush runs here, after every leaf grad has accumulated
+    for cb in list(_POST_BACKWARD_CALLBACKS):
+        cb()
+
+
+_POST_BACKWARD_CALLBACKS: List = []
+
+
+def register_post_backward_callback(fn):
+    """Register ``fn()`` to run at the end of every ``backward()`` pass.
+    Returns a handle with ``.remove()``."""
+
+    class _Handle:
+        def remove(self):
+            try:
+                _POST_BACKWARD_CALLBACKS.remove(fn)
+            except ValueError:
+                pass
+
+    _POST_BACKWARD_CALLBACKS.append(fn)
+    return _Handle()
+
 
 def _call_vjp(node, cots):
     import jax

@@ -341,7 +341,17 @@ def primitive(fn: Callable, *args, _name: str = "", **kwargs):
             v[i] = dv
         return fn(*v, **kwargs)
 
-    out, vjp_fn = jax.vjp(closed, *[vals[i] for i in diff_idx])
+    if bench:
+        import time as _time
+
+        t0 = _time.perf_counter()
+        out, vjp_fn = jax.vjp(closed, *[vals[i] for i in diff_idx])
+        jax.block_until_ready(out)
+        st = _BENCH_STATS.setdefault(_name or getattr(fn, "__name__", "op"), {"count": 0, "total_s": 0.0})
+        st["count"] += 1
+        st["total_s"] += _time.perf_counter() - t0
+    else:
+        out, vjp_fn = jax.vjp(closed, *[vals[i] for i in diff_idx])
     multi = isinstance(out, (tuple, list))
     outs = tuple(out) if multi else (out,)
     if check:

@@ -50,6 +50,7 @@ def flag(name: str):
 define_flag("FLAGS_check_nan_inf", False, "check outputs for nan/inf after each eager op")
 define_flag("FLAGS_benchmark", False, "synchronize after each op for timing")
 define_flag("FLAGS_use_flash_attention", True, "use the Pallas flash-attention kernel when on TPU")
+define_flag("FLAGS_flash_flat", False, "use the flat-lane (zero-relayout) flash kernels for packed qkv attention (opt-in until benchmarked)")
 define_flag("FLAGS_eager_delete_tensor_gb", 0.0, "compat no-op: XLA/PJRT manages buffers")
 define_flag("FLAGS_allocator_strategy", "auto_growth", "compat no-op: PJRT BFC allocator is used")
 define_flag("FLAGS_remat_policy", "none", "default rematerialization policy for jit steps")

@@ -18,6 +18,10 @@ from ..framework.io import save as _save
 from .callbacks import config_callbacks
 
 
+def _np(o):
+    return o.numpy() if isinstance(o, Tensor) else np.asarray(o)
+
+
 def _as_list(x):
     if x is None:
         return []
@@ -210,8 +214,15 @@ class Model:
             cbks.on_predict_batch_end(i)
         cbks.on_predict_end()
         if stack_outputs:
-            flat = [o.numpy() if isinstance(o, Tensor) else o for o in outs]
-            return [np.concatenate(flat, axis=0)]
+            # multi-output networks: concatenate per output field (reference
+            # hapi stacks each fetch separately)
+            if outs and isinstance(outs[0], (tuple, list)):
+                n_fields = len(outs[0])
+                return [
+                    np.concatenate([_np(o[j]) for o in outs], axis=0)
+                    for j in range(n_fields)
+                ]
+            return [np.concatenate([_np(o) for o in outs], axis=0)]
         return outs
 
     # -- persistence -------------------------------------------------------

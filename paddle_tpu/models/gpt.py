@@ -184,6 +184,21 @@ def _attn_core(q, k, v, attn_dropout=0.0, key=None):
     return _sdpa_reference(q, k, v, None, True, attn_dropout, key)
 
 
+def _attn_core_packed(qkv, attn_dropout=0.0, key=None):
+    """Same dispatch over the packed [b, s, 3, h, d] qkv-projection output:
+    the flash kernels read q/k/v via index maps and return the packed d(qkv)
+    in backward — avoids the slice/relayout copies of the split form."""
+    from ..framework.flags import flag
+    from ..nn.functional.attention import _sdpa_reference
+    from ..ops.flash_attention import flash_attention_available, flash_attention_qkv
+
+    b, s, _, h, d = qkv.shape
+    if attn_dropout == 0.0 and flag("FLAGS_use_flash_attention") and flash_attention_available((b, s, h, d)):
+        return flash_attention_qkv(qkv, causal=True)
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    return _sdpa_reference(q, k, v, None, True, attn_dropout, key)
+
+
 def _block_apply(lp, h, key, *, num_heads, dropout=0.0, attn_dropout=0.0, epsilon=1e-5):
     """One pre-LN decoder block on raw arrays. ``lp`` = (12 stacked-param
     slices, layer index); ``key`` = dropout PRNG key or None."""
@@ -209,8 +224,7 @@ def _block_apply(lp, h, key, *, num_heads, dropout=0.0, attn_dropout=0.0, epsilo
     hd = d // num_heads
     x1 = ln(h, n1w, n1b)
     qkv = (x1 @ qkvw + qkvb).reshape(b, s, 3, num_heads, hd)
-    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-    att = _attn_core(q, k, v, attn_dropout, k_attn).reshape(b, s, d)
+    att = _attn_core_packed(qkv, attn_dropout, k_attn).reshape(b, s, d)
     h = h + drop(att @ ow + ob, dropout, k_res1)
     x2 = ln(h, n2w, n2b)
     y = jax.nn.gelu(x2 @ f1w + f1b, approximate=True)
@@ -223,7 +237,7 @@ def _stack_forward(x, *rest, num_layers, num_heads, dropout, attn_dropout, recom
     spmd_pipeline over the 'pp' mesh axis (pp>1)."""
     from jax.sharding import NamedSharding
 
-    from ..distributed.pipeline import microbatch, spmd_pipeline, unmicrobatch
+    from ..distributed.pipeline import active_pipeline_schedule, microbatch, spmd_pipeline, unmicrobatch
 
     if has_key:
         params, key = rest[:-1], rest[-1]
@@ -256,7 +270,7 @@ def _stack_forward(x, *rest, num_layers, num_heads, dropout, attn_dropout, recom
             stage_fn = lambda lp, h, mb: block(lp, h, None)
             extras = ()
         xm = microbatch(x, n_micro, mesh)
-        out = spmd_pipeline(stage_fn, stacked, xm, mesh, axis="pp", remat=bool(recompute), extras=extras, mb_index=True)
+        out = spmd_pipeline(stage_fn, stacked, xm, mesh, axis="pp", remat=bool(recompute), extras=extras, mb_index=True, schedule=active_pipeline_schedule())
         return unmicrobatch(out, mesh)
 
     # statically-unrolled layer loop: XLA schedules/fuses across layers and
@@ -370,10 +384,14 @@ class GPTBlockStack(nn.Layer):
 def _cache_block(lp, h, ck, cv, start_pos, *, num_heads, epsilon=1e-5):
     """One decoder block with a fixed-size KV cache.
 
-    h [b, s, d] (s = prompt len at prefill, 1 at decode); ck/cv [b, S, H, dh]
-    hold keys/values for positions < start_pos and are updated in place at
-    [start_pos, start_pos+s). Attention masks cache positions beyond
-    start_pos+row. Returns (h, ck, cv). Parity: the per-layer decode of
+    h [b, s, d] (s = prompt len at prefill, 1 at decode); ck/cv
+    [b, H, S, dh] (head-major so per-step attention reads the cache
+    contiguously per head — the [b, S, H, dh] layout forced XLA to relayout
+    the whole cache every decode step) hold keys/values for positions
+    < start_pos and are updated in place at [start_pos, start_pos+s).
+    Attention masks cache positions beyond start_pos+row. Scores run as
+    bf16×bf16→f32 MXU dots (preferred_element_type) — no f32 cache
+    materialization. Returns (h, ck, cv). Parity: the per-layer decode of
     fused_multi_transformer_op.cu, as lax ops on a static-shape cache.
     """
     (n1w, n1b, qkvw, qkvb, ow, ob, n2w, n2b, f1w, f1b, f2w, f2b), _ = lp
@@ -384,20 +402,24 @@ def _cache_block(lp, h, ck, cv, start_pos, *, num_heads, epsilon=1e-5):
         return (v - mean) / jnp.sqrt(var + epsilon) * w + bb
 
     b, s, d = h.shape
-    S = ck.shape[1]
+    S = ck.shape[2]
     hd = d // num_heads
     x1 = ln(h, n1w, n1b)
     qkv = (x1 @ qkvw + qkvb).reshape(b, s, 3, num_heads, hd)
-    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-    ck = jax.lax.dynamic_update_slice(ck, k, (0, start_pos, 0, 0))
-    cv = jax.lax.dynamic_update_slice(cv, v, (0, start_pos, 0, 0))
-    scale = 1.0 / (hd ** 0.5)
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, ck).astype(jnp.float32) * scale
+    q = jnp.swapaxes(qkv[:, :, 0], 1, 2)  # [b, H, s, dh]
+    k = jnp.swapaxes(qkv[:, :, 1], 1, 2)
+    v = jnp.swapaxes(qkv[:, :, 2], 1, 2)
+    ck = jax.lax.dynamic_update_slice(ck, k, (0, 0, start_pos, 0))
+    cv = jax.lax.dynamic_update_slice(cv, v, (0, 0, start_pos, 0))
+    scale = jnp.asarray(1.0 / (hd ** 0.5), q.dtype)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q * scale, ck,
+                        preferred_element_type=jnp.float32)
     q_pos = start_pos + jax.lax.broadcasted_iota(jnp.int32, (s, S), 0)
     k_pos = jax.lax.broadcasted_iota(jnp.int32, (s, S), 1)
     scores = jnp.where((k_pos <= q_pos)[None, None], scores, -jnp.inf)
     p = jax.nn.softmax(scores, axis=-1).astype(cv.dtype)
-    att = jnp.einsum("bhqk,bkhd->bqhd", p, cv).reshape(b, s, d)
+    att = jnp.einsum("bhqk,bhkd->bhqd", p, cv, preferred_element_type=jnp.float32)
+    att = jnp.swapaxes(att.astype(h.dtype), 1, 2).reshape(b, s, d)
     h = h + att @ ow + ob
     x2 = ln(h, n2w, n2b)
     y = jax.nn.gelu(x2 @ f1w + f1b, approximate=True)
@@ -405,14 +427,25 @@ def _cache_block(lp, h, ck, cv, start_pos, *, num_heads, epsilon=1e-5):
     return h, ck, cv
 
 
-def _cache_forward(stacked, wte, wpe, fnw, fnb, ids, cache_k, cache_v, start_pos, *, num_heads):
+def _cache_forward(stacked, wte, wpe, fnw, fnb, ids, cache_k, cache_v, start_pos, *, num_heads, mesh=None):
     """Trunk forward over a fixed cache; returns (logits, cache_k, cache_v).
 
-    cache_k/v: [L, b, S, H, dh]. ids [b, s]; positions start at start_pos.
+    cache_k/v: [L, b, H, S, dh]. ids [b, s]; positions start at start_pos.
+    With ``mesh``, caches/activations carry mp (heads / vocab) sharding
+    constraints so decode runs tensor-parallel (reference: the mp-sharded
+    fused_multi_transformer decode path).
     """
     params, idx = stacked
     num_layers = params[0].shape[0]
     b, s = ids.shape
+
+    def mpc(x, *spec):
+        if mesh is None or mesh.shape.get("mp", 1) <= 1:
+            return x
+        from jax.sharding import NamedSharding
+
+        return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*spec)))
+
     pos = start_pos + jnp.arange(s, dtype=jnp.int32)
     h = jnp.take(wte, ids, axis=0) + jnp.take(wpe, pos, axis=0)[None]
     h = h.astype(wte.dtype)
@@ -420,12 +453,12 @@ def _cache_forward(stacked, wte, wpe, fnw, fnb, ids, cache_k, cache_v, start_pos
     for i in range(num_layers):
         lp = (tuple(p[i] for p in params), idx[i])
         h, ck, cv = _cache_block(lp, h, cache_k[i], cache_v[i], start_pos, num_heads=num_heads)
-        new_k.append(ck)
-        new_v.append(cv)
+        new_k.append(mpc(ck, None, "mp"))
+        new_v.append(mpc(cv, None, "mp"))
     mean = jnp.mean(h, axis=-1, keepdims=True)
     var = jnp.var(h, axis=-1, keepdims=True)
     h = (h - mean) / jnp.sqrt(var + 1e-5) * fnw + fnb
-    logits = jnp.einsum("bsd,vd->bsv", h, wte)
+    logits = mpc(jnp.einsum("bsd,vd->bsv", h, wte), None, None, "mp")
     return logits, jnp.stack(new_k), jnp.stack(new_v)
 
 
@@ -435,7 +468,8 @@ def _select_token(logits, key, do_sample, temperature, top_k, top_p):
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     logits = logits.astype(jnp.float32) / jnp.maximum(temperature, 1e-6)
     if top_k and top_k > 0:
-        kth = jnp.sort(logits, axis=-1)[..., -int(top_k)][..., None]
+        k_eff = min(int(top_k), logits.shape[-1])  # top_k > vocab = keep all
+        kth = jnp.sort(logits, axis=-1)[..., -k_eff][..., None]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
     if top_p < 1.0:
         sl = jnp.sort(logits, axis=-1)[..., ::-1]
@@ -446,17 +480,23 @@ def _select_token(logits, key, do_sample, temperature, top_k, top_p):
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
 
-@functools.partial(jax.jit, static_argnames=("num_heads", "num_layers", "head_dim", "max_new", "do_sample", "temperature", "top_k", "top_p", "eos"))
-def _generate_jit(params, ids, key, *, num_heads, num_layers, head_dim, max_new, do_sample, temperature, top_k, top_p, eos):
+@functools.partial(jax.jit, static_argnames=("num_heads", "num_layers", "head_dim", "max_new", "do_sample", "temperature", "top_k", "top_p", "eos", "mesh"))
+def _generate_jit(params, ids, key, *, num_heads, num_layers, head_dim, max_new, do_sample, temperature, top_k, top_p, eos, mesh=None):
     """Prefill + lax.scan single-token decode loop, one XLA computation."""
     stacked_tree, wte, wpe, fnw, fnb = params
     b, s0 = ids.shape
     S = s0 + max_new
     dt = wte.dtype
-    cache_k = jnp.zeros((num_layers, b, S, num_heads, head_dim), dt)
-    cache_v = jnp.zeros((num_layers, b, S, num_heads, head_dim), dt)
+    cache_k = jnp.zeros((num_layers, b, num_heads, S, head_dim), dt)
+    cache_v = jnp.zeros((num_layers, b, num_heads, S, head_dim), dt)
+    if mesh is not None and mesh.shape.get("mp", 1) > 1:
+        from jax.sharding import NamedSharding
+
+        csh = NamedSharding(mesh, P(None, None, "mp"))
+        cache_k = jax.lax.with_sharding_constraint(cache_k, csh)
+        cache_v = jax.lax.with_sharding_constraint(cache_v, csh)
     logits, cache_k, cache_v = _cache_forward(
-        stacked_tree, wte, wpe, fnw, fnb, ids, cache_k, cache_v, jnp.int32(0), num_heads=num_heads)
+        stacked_tree, wte, wpe, fnw, fnb, ids, cache_k, cache_v, jnp.int32(0), num_heads=num_heads, mesh=mesh)
     first = _select_token(logits[:, -1].astype(jnp.float32), key, do_sample, temperature, top_k, top_p)
     done0 = jnp.zeros((b,), bool) if eos is None else (first == eos)
 
@@ -464,7 +504,7 @@ def _generate_jit(params, ids, key, *, num_heads, num_layers, head_dim, max_new,
         tok, ck, cv, done, key = carry
         key, sub = jax.random.split(key)
         lg, ck, cv = _cache_forward(
-            stacked_tree, wte, wpe, fnw, fnb, tok[:, None], ck, cv, s0 + i, num_heads=num_heads)
+            stacked_tree, wte, wpe, fnw, fnb, tok[:, None], ck, cv, s0 + i, num_heads=num_heads, mesh=mesh)
         nxt = _select_token(lg[:, -1].astype(jnp.float32), sub, do_sample, temperature, top_k, top_p)
         if eos is not None:
             nxt = jnp.where(done, jnp.int32(eos), nxt)
@@ -511,6 +551,68 @@ class GPTModel(nn.Layer):
             for blk in self.layers:
                 h = blk(h)
         return self.final_norm(h)
+
+    # per-layer GPTBlock param path <-> stacked GPTBlockStack param name
+    _PER_LAYER_TO_STACKED = {
+        "norm1.weight": "norm1_w", "norm1.bias": "norm1_b",
+        "attn.qkv_proj.weight": "qkv_w", "attn.qkv_proj.bias": "qkv_b",
+        "attn.out_proj.weight": "out_w", "attn.out_proj.bias": "out_b",
+        "norm2.weight": "norm2_w", "norm2.bias": "norm2_b",
+        "ffn1.weight": "ffn1_w", "ffn1.bias": "ffn1_b",
+        "ffn2.weight": "ffn2_w", "ffn2.bias": "ffn2_b",
+    }
+
+    def set_state_dict(self, state_dict, use_structured_name=True):
+        """Accepts both trunk layouts: ``layers.N.attn.qkv_proj.weight``
+        (per-layer GPTBlock checkpoints, incl. ones converted from the
+        reference's auto_parallel_gpt_model naming) and ``layers.qkv_w``
+        ([L, ...]-stacked). Mismatched layouts are converted by
+        stacking/unstacking along the layer axis."""
+        import re
+
+        import numpy as np
+
+        from ..framework.core import Tensor as _T
+
+        def val(v):
+            return np.asarray(v._value) if isinstance(v, _T) else np.asarray(v)
+
+        L = self.cfg.num_layers
+        if isinstance(self.layers, GPTBlockStack):
+            groups, rest = {}, {}
+            for k, v in state_dict.items():
+                m = re.match(r"layers\.(\d+)\.(.+)$", k)
+                if m and m.group(2) in self._PER_LAYER_TO_STACKED:
+                    groups.setdefault(self._PER_LAYER_TO_STACKED[m.group(2)], {})[int(m.group(1))] = v
+                else:
+                    rest[k] = v
+            if groups:
+                state_dict = rest
+                inv = {v: k for k, v in self._PER_LAYER_TO_STACKED.items()}
+                for stacked_name, per in groups.items():
+                    if len(per) == L and sorted(per) == list(range(L)):
+                        state_dict[f"layers.{stacked_name}"] = np.stack([val(per[i]) for i in range(L)])
+                    else:
+                        # incomplete group: restore the original keys so the
+                        # base class reports them as unexpected (no silent drop)
+                        for i, v in per.items():
+                            state_dict[f"layers.{i}.{inv[stacked_name]}"] = v
+        else:
+            inv = {v: k for k, v in self._PER_LAYER_TO_STACKED.items()}
+            converted = {}
+            for k, v in state_dict.items():
+                m = re.match(r"layers\.([a-z0-9_]+)$", k)
+                if m and m.group(1) in inv:
+                    arr = val(v)
+                    if arr.shape[0] != L:
+                        converted[k] = v  # wrong layer count: surface as unexpected
+                        continue
+                    for i in range(L):
+                        converted[f"layers.{i}.{inv[m.group(1)]}"] = arr[i]
+                else:
+                    converted[k] = v
+            state_dict = converted
+        return super().set_state_dict(state_dict, use_structured_name)
 
 
 class GPTForPretraining(nn.Layer):
@@ -560,13 +662,37 @@ class GPTForPretraining(nn.Layer):
             unwrap(self.gpt.final_norm.weight),
             unwrap(self.gpt.final_norm.bias),
         )
+        # tensor-parallel decode: when the fleet mesh has mp>1 (and no pp),
+        # place the trunk stack per its dist_spec annotations and thread the
+        # mesh so caches/logits stay mp-sharded through the token loop
+        from ..distributed.fleet import fleet as _fleet
+
+        mesh = None
+        if _fleet._hcg is not None:
+            fm = _fleet.mesh
+            if fm is not None and fm.shape.get("mp", 1) > 1 and fm.shape.get("pp", 1) == 1:
+                from jax.sharding import NamedSharding
+
+                mesh = fm
+                specs = [getattr(getattr(stack, n), "dist_spec", None) for n in stack._order]
+                placed = tuple(
+                    jax.device_put(arr, NamedSharding(mesh, sp if sp is not None else P()))
+                    for arr, sp in zip(params[0][0], specs))
+                wte_spec = getattr(self.gpt.embeddings.word_embeddings.weight, "dist_spec", None)
+                params = (
+                    (placed, params[0][1]),
+                    jax.device_put(params[1], NamedSharding(mesh, wte_spec if wte_spec is not None else P())),
+                    jax.device_put(params[2], NamedSharding(mesh, P())),
+                    jax.device_put(params[3], NamedSharding(mesh, P())),
+                    jax.device_put(params[4], NamedSharding(mesh, P())),
+                )
         out = _generate_jit(
             params, ids, jax.random.key(seed),
             num_heads=cfg.num_heads, num_layers=cfg.num_layers,
             head_dim=cfg.hidden_size // cfg.num_heads,
             max_new=int(max_new_tokens), do_sample=bool(do_sample),
             temperature=float(temperature), top_k=int(top_k), top_p=float(top_p),
-            eos=None if eos_token_id is None else int(eos_token_id))
+            eos=None if eos_token_id is None else int(eos_token_id), mesh=mesh)
         return _wrap_value(out)
 
 

@@ -336,3 +336,17 @@ def flash_attention(q, k, v, causal=False, impl="auto"):
         except (ImportError, AttributeError, TypeError):  # jax API drift only
             pass
     return _flash(q, k, v, causal)
+
+
+def flash_attention_qkv(qkv, causal=False):
+    """Packed-projection form: ``qkv`` is [batch, seq, 3, heads, head_dim]
+    (the qkv-matmul output reshaped, un-sliced). Dispatches to the packed
+    flat-lane kernels (flash_attention_flat) when enabled, else slices and
+    uses the classic kernel pair."""
+    if qkv.ndim != 5 or qkv.shape[2] != 3:
+        raise ValueError(f"flash_attention_qkv expects [b, s, 3, h, d]; got {tuple(qkv.shape)}")
+    from . import flash_attention_flat as _flat
+
+    if _flat.enabled(qkv.shape):
+        return _flat.flash_packed(qkv, causal)
+    return _flash(qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2], causal)

@@ -1,0 +1,342 @@
+"""Flat-lane flash attention kernels: zero-relayout attention for the GPT
+trunk.
+
+Motivation (round-4 profile, v5 lite, b=8 s=1024 h=16 d=64): the classic
+kernels in flash_attention.py take [b, h, s, d] operands, so XLA inserts
+~6-9ms/step of relayout copies between the qkv projection (whose natural
+output is [b, s, 3·h·d]) and every kernel call. These kernels instead read
+the projection output's layout directly:
+
+- Operands stay [b, s, H] (H = h·d) or packed [b, s, 3H]; BlockSpecs carve
+  the lane (H) dimension into head-groups of hg·d lanes (hg = 8, or all
+  heads when h < 8 or h % 8 != 0 — Mosaic requires 128-aligned or
+  full-dimension lane blocks), and the kernel statically slices each head's
+  d columns. No transposes anywhere in the attention path.
+- The backward is ONE fused kernel (grid over k-blocks, inner loop over
+  q-blocks): s and dp computed once (5 MXU dots vs 7 for a split dq/dkv
+  pair), one exp instead of two. dq accumulates in f32 in a VMEM-resident
+  [s, hg·d] output block across the sequential k-block grid steps; dk/dv
+  are per-block. Backward block_k is 256 to stay inside the ~16MB VMEM.
+- lse/di live as [b, h//hg, s, hg] f32 so each head-group's stats are one
+  full-lane block; the kernel selects a head's column with a one-hot
+  multiply (dynamic lane slicing is not portable Mosaic).
+- The softmax scale is folded into q (and k for the dq dot) tiles — 1/8th
+  the VPU work of scaling the [block_q, block_k] logits tile; the causal
+  mask (iota+compare+select) only runs on diagonal-intersecting tiles.
+
+Parity anchor: same as flash_attention.py (fused_attention_op.cu).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+_BLOCK_Q = 512
+_BLOCK_K_FWD = 512
+_BLOCK_K_BWD = 256
+_MAX_SEQ = 4096
+
+
+def _head_group(h):
+    return h if (h < 8 or h % 8 != 0) else 8
+
+
+def enabled(qkv_shape=None) -> bool:
+    """Gate for dispatch from flash_attention_qkv. On TPU backends only;
+    FLAGS_flash_flat (default on) allows forcing the classic path."""
+    from ..framework.flags import flag
+
+    if jax.default_backend() not in ("tpu", "axon"):
+        return False
+    if not flag("FLAGS_flash_flat"):
+        return False
+    if qkv_shape is not None:
+        b, s, three, h, d = qkv_shape
+        block = min(_BLOCK_Q, s)
+        if not (s >= 256 and s % block == 0 and s <= _MAX_SEQ and 64 <= d <= 128 and d % 8 == 0):
+            return False
+        hg = _head_group(h)
+        # VMEM residency bound for the bwd kernel (q, do bf16 + dq f32)
+        if s * hg * d * (2 + 2 + 4) > 10 * 1024 * 1024:
+            return False
+    return True
+
+
+def _dot(a, b, dims):
+    return jax.lax.dot_general(a, b, (dims, ((), ())), preferred_element_type=jnp.float32)
+
+
+_NT = ((1,), (1,))
+_NN = ((1,), (0,))
+_TN = ((0,), (0,))
+
+
+# -- kernels ----------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, block_k, seq_len, scale, hg, d):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(2)
+    block_q = q_ref.shape[0]
+    nkb = seq_len // block_k
+    lse_cols = []
+    for hi in range(hg):
+        c0 = hi * d
+        q = q_ref[:, c0:c0 + d] * jnp.asarray(scale, q_ref.dtype)
+        m = jnp.full((block_q,), -jnp.inf, jnp.float32)
+        l = jnp.zeros((block_q,), jnp.float32)
+        acc = jnp.zeros((block_q, d), jnp.float32)
+
+        def body(kb, carry, masked):
+            m, l, acc = carry
+            kt = k_ref[pl.dslice(kb * block_k, block_k), c0:c0 + d]
+            vt = v_ref[pl.dslice(kb * block_k, block_k), c0:c0 + d]
+            s = _dot(q, kt, _NT)  # scale pre-applied via q
+            if masked:
+                qp = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+                kp = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+                s = jnp.where(qp >= kp, s, -jnp.inf)
+            mn = jnp.maximum(m, jnp.max(s, axis=-1))
+            p = jnp.exp(s - mn[:, None])
+            al = jnp.exp(m - mn)
+            ln = al * l + jnp.sum(p, axis=-1)
+            accn = acc * al[:, None] + _dot(p.astype(vt.dtype), vt, _NN)
+            return mn, ln, accn
+
+        if causal:
+            n_full = (qi * block_q) // block_k  # strictly below the diagonal
+            n_live = n_full + (block_q + block_k - 1) // block_k
+            m, l, acc = jax.lax.fori_loop(0, n_full, lambda kb, c: body(kb, c, False), (m, l, acc))
+            m, l, acc = jax.lax.fori_loop(n_full, n_live, lambda kb, c: body(kb, c, True), (m, l, acc))
+        else:
+            m, l, acc = jax.lax.fori_loop(0, nkb, lambda kb, c: body(kb, c, False), (m, l, acc))
+
+        o_ref[:, c0:c0 + d] = (acc / l[:, None]).astype(o_ref.dtype)
+        oh = (jax.lax.broadcasted_iota(jnp.int32, (1, hg), 1) == hi).astype(jnp.float32)
+        lse_cols.append((m + jnp.log(l))[:, None] * oh)
+    lse_ref[...] = sum(lse_cols)
+
+
+def _bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref, dq_ref, dk_ref, dv_ref,
+                *, causal, block_q, block_k, seq_len, scale, hg, d):
+    from jax.experimental import pallas as pl
+
+    ki = pl.program_id(2)
+    nq = seq_len // block_q
+    for hi in range(hg):
+        c0 = hi * d
+        onehot = (jax.lax.broadcasted_iota(jnp.int32, (1, hg), 1) == hi).astype(jnp.float32)
+        k = k_ref[:, c0:c0 + d]
+        v = v_ref[:, c0:c0 + d]
+        ks = k * jnp.asarray(scale, k.dtype)
+        dk = jnp.zeros((block_k, d), jnp.float32)
+        dv = jnp.zeros((block_k, d), jnp.float32)
+
+        def body(qb, carry, masked):
+            dk, dv = carry
+            sl = pl.dslice(qb * block_q, block_q)
+            qt = q_ref[sl, c0:c0 + d] * jnp.asarray(scale, k.dtype)
+            dot_ = do_ref[sl, c0:c0 + d]
+            lse = jnp.sum(lse_ref[sl, :] * onehot, axis=1, keepdims=True)
+            di = jnp.sum(di_ref[sl, :] * onehot, axis=1, keepdims=True)
+            s = _dot(qt, k, _NT)  # scale pre-applied via qt
+            p = jnp.exp(s - lse)
+            if masked:
+                qp = qb * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+                kp = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+                p = jnp.where(qp >= kp, p, 0.0)
+            pc = p.astype(dot_.dtype)
+            dv = dv + _dot(pc, dot_, _TN)
+            dp = _dot(dot_, v, _NT)
+            ds = (p * (dp - di)).astype(k.dtype)
+            dk = dk + _dot(ds, qt, _TN)       # scale carried by qt
+            contrib = _dot(ds, ks, _NN)       # scale carried by ks
+            prev = dq_ref[sl, c0:c0 + d]
+            dq_ref[sl, c0:c0 + d] = jnp.where(ki == 0, contrib, prev + contrib)
+            return dk, dv
+
+        if causal:
+            q_start = (ki * block_k) // block_q
+            n_diag_end = ((ki + 1) * block_k + block_q - 1) // block_q
+            dk, dv = jax.lax.fori_loop(q_start, jnp.minimum(n_diag_end, nq),
+                                       lambda qb, c: body(qb, c, True), (dk, dv))
+            dk, dv = jax.lax.fori_loop(n_diag_end, nq,
+                                       lambda qb, c: body(qb, c, False), (dk, dv))
+        else:
+            dk, dv = jax.lax.fori_loop(0, nq, lambda qb, c: body(qb, c, False), (dk, dv))
+
+        dk_ref[:, c0:c0 + d] = dk.astype(dk_ref.dtype)
+        dv_ref[:, c0:c0 + d] = dv.astype(dv_ref.dtype)
+
+
+# -- pallas_call wrappers ---------------------------------------------------
+# Packed operands: qkv [b, s, 3H]; q/k/v column-block index g is offset by
+# h//hg per tensor. Separate operands: three [b, s, H].
+
+
+def _fwd_call(operands, b, s, h, d, dtype, causal, packed):
+    from jax.experimental import pallas as pl
+
+    hg = _head_group(h)
+    hd = hg * d
+    G = h // hg  # column blocks per tensor
+    block_q = min(_BLOCK_Q, s)
+    block_k = min(_BLOCK_K_FWD, s)
+    scale = 1.0 / (d ** 0.5)
+
+    if packed:
+        in_specs = [
+            pl.BlockSpec((None, block_q, hd), lambda bi, gi, qi: (bi, qi, gi)),
+            pl.BlockSpec((None, s, hd), lambda bi, gi, qi: (bi, 0, G + gi)),
+            pl.BlockSpec((None, s, hd), lambda bi, gi, qi: (bi, 0, 2 * G + gi)),
+        ]
+    else:
+        in_specs = [
+            pl.BlockSpec((None, block_q, hd), lambda bi, gi, qi: (bi, qi, gi)),
+            pl.BlockSpec((None, s, hd), lambda bi, gi, qi: (bi, 0, gi)),
+            pl.BlockSpec((None, s, hd), lambda bi, gi, qi: (bi, 0, gi)),
+        ]
+
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, causal=causal, block_k=block_k, seq_len=s,
+                          scale=scale, hg=hg, d=d),
+        grid=(b, G, s // block_q),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((None, block_q, hd), lambda bi, gi, qi: (bi, qi, gi)),
+            pl.BlockSpec((None, None, block_q, hg), lambda bi, gi, qi: (bi, gi, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, s, h * d), dtype),
+            jax.ShapeDtypeStruct((b, G, s, hg), jnp.float32),
+        ],
+    )(*operands)
+    return out, lse
+
+
+def _bwd_call(operands, b, s, h, d, dtype, o, lse, do, causal, packed):
+    from jax.experimental import pallas as pl
+
+    hg = _head_group(h)
+    hd = hg * d
+    G = h // hg
+    block_q = min(_BLOCK_Q, s)
+    block_k = min(_BLOCK_K_BWD, s)
+    scale = 1.0 / (d ** 0.5)
+
+    # di = rowsum(dO ∘ O) reshaped to the [b, G, s, hg] stat layout
+    di = jnp.sum(do.astype(jnp.float32).reshape(b, s, h, d)
+                 * o.astype(jnp.float32).reshape(b, s, h, d), axis=-1)
+    di = jnp.swapaxes(di.reshape(b, s, G, hg), 1, 2)  # [b, G, s, hg]
+
+    fullH = lambda bi, gi, ki: (bi, 0, gi)
+    blkH = lambda bi, gi, ki: (bi, ki, gi)
+    stat = lambda bi, gi, ki: (bi, gi, 0, 0)
+    if packed:
+        qkv_specs = [
+            pl.BlockSpec((None, s, hd), fullH),
+            pl.BlockSpec((None, block_k, hd), lambda bi, gi, ki: (bi, ki, G + gi)),
+            pl.BlockSpec((None, block_k, hd), lambda bi, gi, ki: (bi, ki, 2 * G + gi)),
+        ]
+    else:
+        qkv_specs = [
+            pl.BlockSpec((None, s, hd), fullH),
+            pl.BlockSpec((None, block_k, hd), blkH),
+            pl.BlockSpec((None, block_k, hd), blkH),
+        ]
+
+    dq, dk, dv = pl.pallas_call(
+        functools.partial(_bwd_kernel, causal=causal, block_q=block_q, block_k=block_k,
+                          seq_len=s, scale=scale, hg=hg, d=d),
+        grid=(b, G, s // block_k),
+        in_specs=qkv_specs + [
+            pl.BlockSpec((None, s, hd), fullH),           # do
+            pl.BlockSpec((None, None, s, hg), stat),      # lse
+            pl.BlockSpec((None, None, s, hg), stat),      # di
+        ],
+        out_specs=[
+            pl.BlockSpec((None, s, hd), fullH),           # dq (f32 accumulator)
+            pl.BlockSpec((None, block_k, hd), blkH),
+            pl.BlockSpec((None, block_k, hd), blkH),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, s, h * d), jnp.float32),
+            jax.ShapeDtypeStruct((b, s, h * d), dtype),
+            jax.ShapeDtypeStruct((b, s, h * d), dtype),
+        ],
+    )(*operands, do, lse, di)
+    return dq.astype(dtype), dk, dv
+
+
+# -- custom-vjp entries -----------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def _flat_packed(qkv, hd_shape, causal):
+    b, s, _ = qkv.shape
+    h, d = hd_shape
+    out, _ = _fwd_call((qkv,), b, s, h, d, qkv.dtype, causal, packed=True)
+    return out
+
+
+def _flat_packed_fwd(qkv, hd_shape, causal):
+    b, s, _ = qkv.shape
+    h, d = hd_shape
+    out, lse = _fwd_call((qkv,), b, s, h, d, qkv.dtype, causal, packed=True)
+    return out, (qkv, out, lse)
+
+
+def _flat_packed_bwd(hd_shape, causal, res, g):
+    qkv, o, lse = res
+    b, s, _ = qkv.shape
+    h, d = hd_shape
+    dq, dk, dv = _bwd_call((qkv,), b, s, h, d, qkv.dtype, o, lse, g, causal, packed=True)
+    return (jnp.concatenate([dq, dk, dv], axis=-1),)
+
+
+_flat_packed.defvjp(_flat_packed_fwd, _flat_packed_bwd)
+
+
+def flash_packed(qkv, causal=False):
+    """qkv: [b, s, 3, h, d] (or [b, s, 3H] with heads given) — returns
+    [b, s, h, d] to match flash_attention_qkv's contract."""
+    b, s, three, h, d = qkv.shape
+    flat = qkv.reshape(b, s, 3 * h * d)  # no-op relayout: d is already minor
+    out = _flat_packed(flat, (h, d), causal)
+    return out.reshape(b, s, h, d)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flat(q, k, v, hd_shape, causal):
+    b, s, _ = q.shape
+    h, d = hd_shape
+    out, _ = _fwd_call((q, k, v), b, s, h, d, q.dtype, causal, packed=False)
+    return out
+
+
+def _flat_fwd(q, k, v, hd_shape, causal):
+    b, s, _ = q.shape
+    h, d = hd_shape
+    out, lse = _fwd_call((q, k, v), b, s, h, d, q.dtype, causal, packed=False)
+    return out, (q, k, v, out, lse)
+
+
+def _flat_bwd(hd_shape, causal, res, g):
+    q, k, v, o, lse = res
+    b, s, _ = q.shape
+    h, d = hd_shape
+    return _bwd_call((q, k, v), b, s, h, d, q.dtype, o, lse, g, causal, packed=False)
+
+
+_flat.defvjp(_flat_fwd, _flat_bwd)
+
+
+def flash_flat(q, k, v, causal=False):
+    """q/k/v: [b, s, h, d]; flat-lane kernel path, returns [b, s, h, d]."""
+    b, s, h, d = q.shape
+    out = _flat(q.reshape(b, s, h * d), k.reshape(b, s, h * d), v.reshape(b, s, h * d),
+                (h, d), causal)
+    return out.reshape(b, s, h, d)

@@ -1,0 +1,225 @@
+"""Int8 quantization: post-training quantization + fake-quant layers.
+
+Parity targets: python/paddle/fluid/contrib/slim/quantization/
+post_training_quantization.py (PTQ: observer passes over a calibration
+loader, per-channel ``channel_wise_abs_max`` weights + per-tensor ``abs_max``
+activations), imperative/ptq.py (ImperativePTQ), and paddle.nn.quant's fake
+quant layers.
+
+TPU-first: quantization is a graph transform, not a kernel swap. A
+quantized layer stores int8 weights + f32 scales; at call time the weight
+dequantizes (``w_int8 * scale``) into the matmul — XLA folds the dequant
+into the convolution/dot epilogue, and the int8 constants are what lands in
+the exported StableHLO artifact (verifiable by scanning the serialized
+bytes for the i8 weight tensors). Activation scales (collected by forward
+hooks during ``quantize()``'s calibration pass) drive optional fake-quant
+of inputs — the numerics contract of the reference's QDQ pairs.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, _wrap_value, unwrap
+from ..nn.layer.base import Layer
+from ..nn.layer.common import Linear
+from ..nn.layer.conv import Conv2D
+from ..tensor._helpers import ensure_tensor, op
+
+__all__ = [
+    "PostTrainingQuantization", "ImperativePTQ", "QuantizedLinear",
+    "QuantizedConv2D", "quant_abs_max", "dequant", "fake_quant",
+]
+
+
+def quant_abs_max(w: np.ndarray, channel_axis: Optional[int] = None):
+    """int8 symmetric quantization. Per-channel when ``channel_axis`` given
+    (reference channel_wise_abs_max), else per-tensor abs_max.
+    Returns (int8 array, f32 scale broadcastable against w)."""
+    w = np.asarray(w, np.float32)
+    if channel_axis is None:
+        scale = np.maximum(np.abs(w).max(), 1e-8) / 127.0
+        scale = np.asarray(scale, np.float32)
+    else:
+        axes = tuple(i for i in range(w.ndim) if i != channel_axis)
+        scale = np.maximum(np.abs(w).max(axis=axes, keepdims=True), 1e-8) / 127.0
+    q = np.clip(np.round(w / scale), -127, 127).astype(np.int8)
+    return q, scale.astype(np.float32)
+
+
+def dequant(q, scale):
+    return jnp.asarray(q, jnp.float32) * jnp.asarray(scale)
+
+
+def fake_quant(x, scale):
+    """Simulated activation quantization (QDQ pair, reference
+    quantization_pass.py insert_quant_dequant): round(x/s)·s clipped to
+    int8 range. Straight-through in backward (it's used at inference)."""
+    s = jnp.asarray(scale, jnp.float32)
+    return jnp.clip(jnp.round(x / s), -127, 127) * s
+
+
+class _QuantizedBase(Layer):
+    quant_bits = 8
+
+    def _store_weight(self, weight, channel_axis):
+        q, scale = quant_abs_max(np.asarray(unwrap(weight)), channel_axis)
+        # int8 payload + f32 scale are buffers: they export as constants and
+        # round-trip through state_dict
+        self.register_buffer("weight_int8", _wrap_value(jnp.asarray(q)))
+        self.register_buffer("weight_scale", _wrap_value(jnp.asarray(scale)))
+
+    def _dequant_weight(self, dtype):
+        def fn(q, s):
+            return (q.astype(jnp.float32) * s).astype(dtype)
+
+        return op(fn, self.weight_int8, self.weight_scale, _name="dequantize_weight")
+
+
+class QuantizedLinear(_QuantizedBase):
+    """Linear with int8 weight [in, out], per-output-channel scales."""
+
+    def __init__(self, src: Linear, act_scale: Optional[float] = None):
+        super().__init__()
+        self._store_weight(src.weight, channel_axis=1)
+        self.bias = src.bias
+        self.act_scale = act_scale
+        self._dtype = src.weight._value.dtype
+
+    def forward(self, x):
+        from ..nn import functional as F
+
+        x = ensure_tensor(x)
+        if self.act_scale is not None:
+            x = op(lambda v: fake_quant(v, self.act_scale).astype(v.dtype), x, _name="fake_quant")
+        return F.linear(x, self._dequant_weight(self._dtype), self.bias)
+
+
+class QuantizedConv2D(_QuantizedBase):
+    """Conv2D with int8 weight [out, in, kh, kw], per-out-channel scales."""
+
+    def __init__(self, src: Conv2D, act_scale: Optional[float] = None):
+        super().__init__()
+        self._store_weight(src.weight, channel_axis=0)
+        self.bias = src.bias
+        self.act_scale = act_scale
+        self._dtype = src.weight._value.dtype
+        self._stride, self._padding = src.stride, src.padding
+        self._dilation, self._groups = src.dilation, src.groups
+        self._data_format = src.data_format
+
+    def forward(self, x):
+        from ..nn import functional as F
+
+        x = ensure_tensor(x)
+        if self.act_scale is not None:
+            x = op(lambda v: fake_quant(v, self.act_scale).astype(v.dtype), x, _name="fake_quant")
+        return F.conv2d(x, self._dequant_weight(self._dtype), self.bias,
+                        self._stride, self._padding, self._dilation, self._groups,
+                        self._data_format)
+
+
+_QUANTIZABLE = {Linear: QuantizedLinear, Conv2D: QuantizedConv2D}
+
+
+class PostTrainingQuantization:
+    """Imperative PTQ (reference post_training_quantization.py:117 API shape,
+    imperative flow of slim/quantization/imperative/ptq.py).
+
+    1. calibration: run ``batch_nums`` batches from ``data_loader`` through
+       the model with observers (forward hooks) recording per-layer
+       activation abs_max;
+    2. quantize: swap every quantizable sublayer for its int8 twin;
+    3. ``save_quantized_model``: export through jit.save so
+       ``paddle.inference.create_predictor`` serves the int8 artifact.
+    """
+
+    def __init__(self, model: Layer = None, data_loader=None, batch_nums=8,
+                 algo="abs_max", weight_quantize_type="channel_wise_abs_max",
+                 quantizable_op_type=("conv2d", "linear"), activation_quantize=False,
+                 executor=None, **compat_kwargs):
+        if model is None:
+            raise ValueError("pass the Layer to quantize as model=")
+        if algo not in ("abs_max", "avg"):
+            raise NotImplementedError(f"activation algo {algo!r}; use 'abs_max' or 'avg'")
+        if weight_quantize_type not in ("channel_wise_abs_max", "abs_max"):
+            raise NotImplementedError(weight_quantize_type)
+        self.model = model
+        self.loader = data_loader
+        self.batch_nums = batch_nums
+        self.algo = algo
+        self.weight_quantize_type = weight_quantize_type
+        self.op_types = set(quantizable_op_type)
+        self.activation_quantize = activation_quantize
+        self._act_stats: Dict[int, List[float]] = {}
+        self._quantized = None
+
+    # -- calibration -------------------------------------------------------
+    def _observe(self):
+        handles = []
+        targets = self._targets()
+        for lid, (name, layer) in targets.items():
+            def mk(lid):
+                def hook(layer, inputs, output=None):
+                    x = inputs[0] if isinstance(inputs, (tuple, list)) else inputs
+                    self._act_stats.setdefault(lid, []).append(
+                        float(jnp.abs(unwrap(ensure_tensor(x))).max()))
+                return hook
+
+            handles.append(layer.register_forward_pre_hook(mk(lid)))
+        return handles
+
+    def _targets(self):
+        out = {}
+        for name, layer in self.model.named_sublayers():
+            if isinstance(layer, Linear) and "linear" in self.op_types:
+                out[id(layer)] = (name, layer)
+            elif isinstance(layer, Conv2D) and "conv2d" in self.op_types:
+                out[id(layer)] = (name, layer)
+        return out
+
+    def quantize(self) -> Layer:
+        was_training = self.model.training
+        self.model.eval()
+        if self.loader is not None:
+            handles = self._observe()
+            for i, batch in enumerate(self.loader):
+                if i >= self.batch_nums:
+                    break
+                x = batch[0] if isinstance(batch, (tuple, list)) else batch
+                self.model(ensure_tensor(x))
+            for h in handles:
+                h.remove()
+        if was_training:
+            self.model.train()
+
+        # swap quantizable sublayers in place on a reference-holding walk
+        def swap(parent):
+            for cname, child in list(parent._sub_layers.items()):
+                if isinstance(child, (Linear, Conv2D)) and id(child) in self._targets():
+                    stats = self._act_stats.get(id(child))
+                    act_scale = None
+                    if self.activation_quantize and stats:
+                        amax = (np.mean(stats) if self.algo == "avg" else np.max(stats))
+                        act_scale = float(max(amax, 1e-8) / 127.0)
+                    qcls = QuantizedLinear if isinstance(child, Linear) else QuantizedConv2D
+                    parent._sub_layers[cname] = qcls(child, act_scale)
+                else:
+                    swap(child)
+
+        swap(self.model)
+        self._quantized = self.model
+        return self.model
+
+    def save_quantized_model(self, path, input_spec=None, **kwargs):
+        from ..jit import save as jit_save
+
+        if self._quantized is None:
+            self.quantize()
+        return jit_save(self._quantized, path, input_spec=input_spec)
+
+
+class ImperativePTQ(PostTrainingQuantization):
+    """Name parity with slim/quantization/imperative/ptq.py — same flow."""

@@ -388,9 +388,54 @@ def load_inference_model(path_prefix: str, executor: Optional[Executor] = None):
 
 
 def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
-    raise NotImplementedError(
-        "py_func embeds arbitrary Python in the graph, which cannot compile "
-        "to XLA; use jax.pure_callback via a custom primitive instead")
+    """Embed a host (numpy) function in the program (reference:
+    python/paddle/fluid/layers/nn.py py_func / py_func_op.cc). The op lowers
+    to a ``jax.pure_callback`` node — the Executor's compiled program calls
+    back to the host for this op — with ``backward_func`` attached via
+    ``jax.custom_vjp`` so append_backward/minimize differentiate through it.
+
+    ``out`` supplies the output spec(s): Tensor(s)/placeholder(s) whose
+    shape+dtype describe the result (their values are not read). Returns the
+    result Tensor (or list, mirroring ``out``'s structure).
+
+    ``backward_func(*inputs, *outputs, *out_grads)`` returns one grad per
+    input; ``skip_vars_in_backward_input`` drops the given forward
+    inputs/outputs from its argument list (reference semantics).
+    """
+    from ..utils.custom_op import make_callback_op
+
+    xs = list(x) if isinstance(x, (list, tuple)) else [x]
+    outs_spec = list(out) if isinstance(out, (list, tuple)) else [out]
+    multi_out = isinstance(out, (list, tuple))
+
+    specs = [jax.ShapeDtypeStruct(tuple(int(d) for d in o.shape), to_jax_dtype(o.dtype)) for o in outs_spec]
+
+    def infer_spec(*_):
+        return specs[0] if not multi_out else tuple(specs)
+
+    skipped = set()
+    if skip_vars_in_backward_input:
+        sk = skip_vars_in_backward_input if isinstance(skip_vars_in_backward_input, (list, tuple)) else [skip_vars_in_backward_input]
+        skipped = {id(v) for v in sk}
+    # positions (within inputs+outputs) passed to backward_func
+    keep_in = [i for i, v in enumerate(xs) if id(v) not in skipped]
+    keep_out = [i for i, v in enumerate(outs_spec) if id(v) not in skipped]
+
+    bwd = None
+    if backward_func is not None:
+        def bwd(*args):
+            ins = args[:len(xs)]
+            outs = args[len(xs):len(xs) + len(specs)]
+            gouts = args[len(xs) + len(specs):]
+            picked = [ins[i] for i in keep_in] + [outs[i] for i in keep_out] + list(gouts)
+            g = backward_func(*picked)
+            return tuple(g) if isinstance(g, (list, tuple)) else g
+
+    raw = make_callback_op(func, bwd, infer_spec, name=getattr(func, "__name__", "py_func"))
+    from ..tensor._helpers import ensure_tensor, op as _op
+
+    result = _op(raw, *[ensure_tensor(t) for t in xs], _name="py_func")
+    return list(result) if multi_out and isinstance(result, (tuple, list)) else result
 
 
 # ------------------------------------------------------------- static.nn

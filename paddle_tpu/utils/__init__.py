@@ -1,4 +1,6 @@
 from . import unique_name  # noqa: F401
+from . import custom_op as cpp_extension  # noqa: F401 — host-callback stand-in
+from .custom_op import CustomOp, make_callback_op  # noqa: F401
 
 
 def try_import(name):

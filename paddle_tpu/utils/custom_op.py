@@ -1,0 +1,118 @@
+"""Custom-op seam: host-implemented (numpy) ops inside eager, jit and static
+graphs.
+
+Parity: the reference's custom-operator machinery —
+paddle/fluid/framework/custom_operator.cc (dlopen'd kernels registered into
+the op registry) and python/paddle/utils/cpp_extension/cpp_extension.py
+(build+load). TPU-first: a compiled XLA program cannot call into arbitrary
+user code on-device, so the seam is ``jax.pure_callback`` — the op becomes
+an opaque host-callback node in the XLA graph (PJRT handles the
+device↔host transfers) — paired with ``jax.custom_vjp`` so a user-supplied
+backward participates in autodiff under eager tape, ``jax.grad``, jit
+TrainStep and static Executor programs alike.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _as_spec_tree(spec):
+    """Normalize to a tuple of ShapeDtypeStruct."""
+    if isinstance(spec, jax.ShapeDtypeStruct):
+        return (spec,)
+    return tuple(spec)
+
+
+def make_callback_op(forward: Callable, backward: Optional[Callable] = None,
+                     infer_spec: Optional[Callable] = None, name: str = "custom_op"):
+    """Build a raw-array op from numpy-level ``forward``/``backward``.
+
+    - ``forward(*np_arrays) -> np array | tuple`` runs on the host.
+    - ``backward(*np_inputs, *np_outputs, *np_out_grads) -> grad per input``
+      (the reference py_func backward contract, custom_operator.cc grad-op
+      ordering). Omit it for a non-differentiable op.
+    - ``infer_spec(*ShapeDtypeStruct) -> ShapeDtypeStruct | tuple`` gives
+      output shapes; defaults to "same as first input".
+
+    The result is a plain jnp-level function: usable directly, under
+    ``jax.jit``/``jax.grad``, and through :func:`paddle_tpu.tensor._helpers.op`
+    on Tensors.
+    """
+    if infer_spec is None:
+        infer_spec = lambda *xs: jax.ShapeDtypeStruct(xs[0].shape, xs[0].dtype)
+
+    def _call_fwd(*xs):
+        specs = infer_spec(*(jax.ShapeDtypeStruct(x.shape, x.dtype) for x in xs))
+        multi = not isinstance(specs, jax.ShapeDtypeStruct)
+        out = jax.pure_callback(
+            lambda *a: jax.tree_util.tree_map(np.asarray, forward(*a)),
+            specs, *xs, vmap_method="sequential")
+        return out, multi
+
+    if backward is None:
+        def fn(*xs):
+            out, _ = _call_fwd(*xs)
+            return out
+        fn.__name__ = name
+        return fn
+
+    @jax.custom_vjp
+    def fn(*xs):
+        out, _ = _call_fwd(*xs)
+        return out
+
+    def fn_fwd(*xs):
+        out, multi = _call_fwd(*xs)
+        outs = tuple(out) if multi else (out,)
+        return out, (xs, outs)
+
+    def fn_bwd(res, g):
+        xs, outs = res
+        gs = tuple(g) if isinstance(g, (tuple, list)) else (g,)
+        in_specs = tuple(jax.ShapeDtypeStruct(x.shape, x.dtype) for x in xs)
+        if len(in_specs) == 1:
+            in_specs = in_specs[0]
+        grads = jax.pure_callback(
+            lambda *a: jax.tree_util.tree_map(np.asarray, backward(*a)),
+            in_specs, *xs, *outs, *gs, vmap_method="sequential")
+        return tuple(grads) if isinstance(grads, (tuple, list)) else (grads,)
+
+    fn.defvjp(fn_fwd, fn_bwd)
+    return fn
+
+
+class CustomOp:
+    """Tensor-level custom op (the ``paddle.utils.cpp_extension.load`` stand-in:
+    returns a callable module-like object whose ``__call__`` works on
+    paddle_tpu Tensors in every execution mode)."""
+
+    def __init__(self, forward, backward=None, infer_spec=None, name="custom_op"):
+        self._raw = make_callback_op(forward, backward, infer_spec, name)
+        self.name = name
+
+    def raw(self, *arrays):
+        """jnp-level form (for use inside other raw-array code)."""
+        return self._raw(*arrays)
+
+    def __call__(self, *tensors):
+        from ..tensor._helpers import ensure_tensor, op
+
+        return op(self._raw, *[ensure_tensor(t) for t in tensors], _name=self.name)
+
+
+def load(name: str, forward=None, backward=None, infer_spec=None, **unused_build_kwargs):
+    """API-compatible stand-in for ``paddle.utils.cpp_extension.load``: the
+    reference compiles C++/CUDA sources and dlopens them
+    (cpp_extension.py:464); here the kernel body is a Python/numpy callable
+    running as a host callback. Build-system kwargs (sources, extra_cflags,
+    ...) are accepted and ignored."""
+    if forward is None:
+        raise ValueError(
+            "paddle_tpu custom ops are host callbacks: pass forward= (and "
+            "optionally backward=, infer_spec=) instead of C++ sources")
+    return CustomOp(forward, backward, infer_spec, name=name)

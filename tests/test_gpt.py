@@ -112,3 +112,29 @@ def test_gpt_block_cache_incremental_matches_full():
         o, cache = blk(x[:, t:t + 1], cache=cache)
         outs.append(o.numpy())
     np.testing.assert_allclose(np.concatenate(outs, axis=1), full, rtol=2e-5, atol=2e-5)
+
+
+def test_generate_mp_sharded_parity():
+    """mp=2 tensor-parallel decode == replicated decode (greedy).
+
+    VERDICT r3 item 4a: generate() must respect the fleet mesh — qkv/ffn
+    sharded over 'mp', vocab-sharded head, mp-sharded KV cache."""
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.strategy import DistributedStrategy
+
+    paddle.seed(3)
+    cfg = GPTConfig.tiny()
+    m = GPTForPretraining(cfg)
+    ids = np.random.default_rng(0).integers(0, cfg.vocab_size, (2, 8)).astype("int32")
+    ref = np.asarray(m.generate(paddle.to_tensor(ids), max_new_tokens=6).numpy())
+
+    strat = DistributedStrategy()
+    strat.hybrid_configs = {"mp_degree": 2, "dp_degree": 1}
+    fleet.init(is_collective=True, strategy=strat)
+    try:
+        out = np.asarray(m.generate(paddle.to_tensor(ids), max_new_tokens=6).numpy())
+    finally:
+        fleet._hcg = None
+        fleet._strategy = None
+        fleet._is_initialized = False
+    np.testing.assert_array_equal(out, ref)

@@ -127,3 +127,27 @@ def test_data_parallel_two_process_grad_sync():
         assert r.returncode == 0, r.stdout + r.stderr
         assert os.path.exists(os.path.join(d, "ddp_ok.0"))
         assert os.path.exists(os.path.join(d, "ddp_ok.1"))
+
+
+def test_bucket_reducer_plan_and_unused_param_error():
+    """Bucket plan: fixed at init, grouped by dtype, byte-budgeted; missing
+    grads error unless find_unused_parameters=True (reducer.cc semantics)."""
+    from paddle_tpu.distributed.parallel import _BucketReducer
+
+    paddle.seed(0)
+    big = paddle.nn.Linear(256, 256)   # 256KB fp32 weight
+    params = [p for p in big.parameters() if not p.stop_gradient]
+    r = _BucketReducer(params, comm_buffer_mb=0.1)  # 100KB budget → splits
+    assert len(r.buckets) >= 2
+    assert all(dt == "float32" for dt, _ in r.buckets)
+    planned = [p for _, ps in r.buckets for p in ps]
+    assert len(planned) == len(params)
+
+    # one param has a grad, another doesn't → strict mode raises
+    x = paddle.to_tensor(np.ones((2, 256), "float32"))
+    big(x).sum().backward()
+    big.bias.grad = None
+    with pytest.raises(RuntimeError, match="no gradient"):
+        r.reduce(find_unused_parameters=False)
+    # permissive mode runs (world=1 mesh: pmean over a single process)
+    r.reduce(find_unused_parameters=True)

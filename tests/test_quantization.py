@@ -1,0 +1,105 @@
+"""Int8 PTQ: observers, per-channel weight quant, Predictor round trip.
+
+Parity: slim/quantization/post_training_quantization.py,
+imperative/ptq.py. Done-bar (VERDICT r3 item 7): quantized LeNet within 1%
+of fp32 predictions, int8 weights verifiable in the exported artifact.
+"""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.quantization import (
+    PostTrainingQuantization,
+    QuantizedConv2D,
+    QuantizedLinear,
+    quant_abs_max,
+)
+
+
+def test_quant_abs_max_per_channel_roundtrip():
+    w = np.random.default_rng(0).standard_normal((4, 8)).astype(np.float32)
+    q, s = quant_abs_max(w, channel_axis=1)
+    assert q.dtype == np.int8 and s.shape == (1, 8)
+    np.testing.assert_allclose(q * s, w, atol=np.abs(w).max() / 127 + 1e-7)
+    # per-tensor
+    q2, s2 = quant_abs_max(w)
+    assert s2.shape == ()
+    assert np.abs(q2).max() <= 127
+
+
+class LeNet(paddle.nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.conv1 = paddle.nn.Conv2D(1, 6, 5, padding=2)
+        self.conv2 = paddle.nn.Conv2D(6, 16, 5)
+        self.fc1 = paddle.nn.Linear(16 * 5 * 5, 120)
+        self.fc2 = paddle.nn.Linear(120, 10)
+
+    def forward(self, x):
+        import paddle_tpu.nn.functional as F
+
+        x = F.max_pool2d(F.relu(self.conv1(x)), 2)
+        x = F.max_pool2d(F.relu(self.conv2(x)), 2)
+        x = paddle.flatten(x, 1)
+        return self.fc2(F.relu(self.fc1(x)))
+
+
+def _calib_loader(n=4, b=8):
+    rng = np.random.default_rng(0)
+    for _ in range(n):
+        yield (paddle.to_tensor(rng.standard_normal((b, 1, 28, 28)).astype("float32")),)
+
+
+def test_ptq_lenet_accuracy_and_int8_weights():
+    paddle.seed(0)
+    m = LeNet()
+    x = np.random.default_rng(1).standard_normal((32, 1, 28, 28)).astype("float32")
+    ref = np.asarray(m(paddle.to_tensor(x)).numpy())
+
+    ptq = PostTrainingQuantization(model=m, data_loader=_calib_loader(), batch_nums=4)
+    qm = ptq.quantize()
+    assert isinstance(qm.conv1, QuantizedConv2D)
+    assert isinstance(qm.fc1, QuantizedLinear)
+    assert qm.fc1.weight_int8._value.dtype == np.int8
+    out = np.asarray(qm(paddle.to_tensor(x)).numpy())
+    # prediction agreement (accuracy-drop proxy on random nets): >= 99%
+    agree = (ref.argmax(-1) == out.argmax(-1)).mean()
+    assert agree >= 0.99, agree
+    rel = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-6)
+    assert rel < 0.05, rel
+
+
+def test_ptq_activation_fake_quant():
+    paddle.seed(0)
+    m = LeNet()
+    ptq = PostTrainingQuantization(model=m, data_loader=_calib_loader(), batch_nums=2,
+                                   activation_quantize=True)
+    qm = ptq.quantize()
+    assert qm.fc1.act_scale is not None and qm.fc1.act_scale > 0
+    x = np.random.default_rng(1).standard_normal((4, 1, 28, 28)).astype("float32")
+    out = qm(paddle.to_tensor(x)).numpy()
+    assert np.isfinite(out).all()
+
+
+def test_ptq_save_and_predictor_serves_int8():
+    from paddle_tpu.inference import Config, create_predictor
+    from paddle_tpu.static import InputSpec
+
+    paddle.seed(0)
+    m = LeNet()
+    x = np.random.default_rng(1).standard_normal((4, 1, 28, 28)).astype("float32")
+    ptq = PostTrainingQuantization(model=m, data_loader=_calib_loader(), batch_nums=2)
+    qm = ptq.quantize()
+    want = np.asarray(qm(paddle.to_tensor(x)).numpy())
+    with tempfile.TemporaryDirectory() as d:
+        prefix = os.path.join(d, "lenet_int8")
+        ptq.save_quantized_model(prefix, input_spec=[InputSpec([None, 1, 28, 28], "float32")])
+        pred = create_predictor(Config(prefix))
+        (got,) = pred.run([x])
+        np.testing.assert_allclose(np.asarray(got), want, rtol=2e-2, atol=2e-2)
+        # the artifact embeds int8 weight tensors
+        blob = open(prefix + ".pdmodel", "rb").read()
+        assert b"i8" in blob or b"int8" in blob
