@@ -52,6 +52,8 @@ from . import sparse  # noqa: F401,E402
 from . import incubate  # noqa: F401,E402
 from . import device  # noqa: F401,E402
 from . import quantization  # noqa: F401,E402
+from . import text  # noqa: F401,E402
+from . import onnx  # noqa: F401,E402
 from .static import disable_static, enable_static, in_dynamic_mode  # noqa: E402
 from .framework.io import load, save  # noqa: E402
 from .hapi.model import Model  # noqa: E402

@@ -1,0 +1,122 @@
+"""ASP — automatic structured (n:m) sparsity.
+
+Parity: python/paddle/fluid/contrib/sparsity/{asp.py,utils.py} —
+``prune_model`` computes n:m fine-grained masks over supported layers'
+weights (mask_1d best-magnitude selection), ``decorate`` wraps the
+optimizer so masked weights stay zero through updates (the reference
+inserts mask-mul ops after each optimizer op; here the mask is re-applied
+functionally after ``step()``), ``calculate_density`` / ``check_sparsity``
+are the audit helpers.
+
+TPU note: n:m sparsity on TPU is a *model compression* feature (smaller
+checkpoints, distillation targets) — there is no sparse-MXU speedup to
+claim, so masks apply as dense multiplies XLA folds into adjacent ops.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+__all__ = [
+    "decorate", "prune_model", "calculate_density", "check_sparsity",
+    "create_mask", "set_excluded_layers", "reset_excluded_layers",
+]
+
+_EXCLUDED: Dict[int, set] = {}
+_MASKS: Dict[int, np.ndarray] = {}  # id(param) -> mask
+
+
+def calculate_density(x) -> float:
+    x = np.asarray(x)
+    return float(np.count_nonzero(x)) / max(x.size, 1)
+
+
+def create_mask(tensor, func_name="mask_1d", n=2, m=4) -> np.ndarray:
+    """Keep the n largest-|x| entries in every group of m along the last
+    axis (reference get_mask_1d)."""
+    if func_name not in ("mask_1d", "mask_2d_greedy", "mask_2d_best"):
+        raise NotImplementedError(func_name)
+    t = np.asarray(tensor)
+    flat = t.reshape(-1, t.shape[-1])
+    cols = flat.shape[1]
+    pad = (-cols) % m
+    if pad:
+        flat = np.pad(flat, [(0, 0), (0, pad)])
+    g = flat.reshape(flat.shape[0], -1, m)
+    order = np.argsort(-np.abs(g), axis=-1)
+    mask = np.zeros_like(g, dtype=bool)
+    np.put_along_axis(mask, order[..., :n], True, axis=-1)
+    mask = mask.reshape(flat.shape)[:, :cols]
+    return mask.reshape(t.shape).astype(t.dtype)
+
+
+def check_sparsity(tensor, n=2, m=4, func_name="mask_1d") -> bool:
+    """True iff every m-group along the last axis has <= n nonzeros."""
+    t = np.asarray(tensor)
+    flat = t.reshape(-1, t.shape[-1])
+    cols = flat.shape[1]
+    pad = (-cols) % m
+    if pad:
+        flat = np.pad(flat, [(0, 0), (0, pad)])
+    g = flat.reshape(flat.shape[0], -1, m)
+    return bool((np.count_nonzero(g, axis=-1) <= n).all())
+
+
+def set_excluded_layers(main_program=None, param_names=None, model=None):
+    names = set(param_names or [])
+    _EXCLUDED[id(model)] = names
+
+
+def reset_excluded_layers(main_program=None, model=None):
+    _EXCLUDED.pop(id(model), None)
+
+
+def _prunable_params(model):
+    from ..nn.layer.common import Linear
+    from ..nn.layer.conv import Conv2D
+
+    excluded = _EXCLUDED.get(id(model), set())
+    out = []
+    for name, layer in model.named_sublayers(include_self=True):
+        if isinstance(layer, (Linear, Conv2D)) and layer.weight is not None:
+            pname = layer.weight.name or f"{name}.weight"
+            if pname not in excluded and name not in excluded:
+                out.append(layer.weight)
+    return out
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """Prune supported layers (Linear/Conv2D) to n:m sparsity in place;
+    record masks so a decorated optimizer keeps them enforced. Returns
+    {param_name: mask}."""
+    import jax.numpy as jnp
+
+    masks = {}
+    for p in _prunable_params(model):
+        mask = create_mask(np.asarray(p._value), mask_algo, n, m)
+        p._value = p._value * jnp.asarray(mask)
+        if with_mask:
+            _MASKS[id(p)] = mask
+        masks[p.name or str(id(p))] = mask
+    return masks
+
+
+def decorate(optimizer):
+    """Wrap ``optimizer.step`` so recorded masks re-apply after every update
+    (reference ASPHelper._insert_sparse_mask_ops)."""
+    import jax.numpy as jnp
+
+    orig_step = optimizer.step
+
+    def step(*args, **kwargs):
+        out = orig_step(*args, **kwargs)
+        for p in optimizer._params:
+            mask = _MASKS.get(id(p))
+            if mask is not None:
+                p._value = p._value * jnp.asarray(mask)
+        return out
+
+    optimizer.step = step
+    optimizer._asp_decorated = True
+    return optimizer

@@ -284,8 +284,15 @@ def to_static(function=None, input_spec=None, full_graph=True, **kwargs):
     """Decorator compiling a Tensor-level function/Layer method with jax.jit.
 
     Parity: @paddle.jit.to_static — but no AST transpile: python control flow
-    must already be trace-friendly (use lax.cond/scan via paddle_tpu ops),
-    which is the XLA contract the reference's transpiler worked around.
+    must already be trace-friendly, which is the XLA contract the reference's
+    transpiler (dygraph_to_static/program_translator.py:239) worked around.
+    Data-dependent branches/loops have first-class bridges:
+    ``paddle.static.nn.cond(pred, true_fn, false_fn)``,
+    ``paddle.static.nn.while_loop(cond, body, loop_vars)`` and
+    ``paddle.static.nn.switch_case`` — these compile to lax.cond /
+    lax.while_loop / lax.switch and work in eager, to_static and static
+    programs alike. A raw Python ``if tensor:`` under tracing raises JAX's
+    TracerBoolConversionError pointing here.
     """
 
     def decorate(fn):

@@ -652,16 +652,7 @@ class GPTForPretraining(nn.Layer):
             ids = ids[None]
         if ids.shape[1] + max_new_tokens > cfg.max_seq_len:
             raise ValueError(f"prompt {ids.shape[1]} + max_new_tokens {max_new_tokens} exceeds max_seq_len {cfg.max_seq_len}")
-        stack = self.gpt.layers
-        stacked = (tuple(unwrap(getattr(stack, n)) for n in stack._order),
-                   jnp.arange(cfg.num_layers, dtype=jnp.int32))
-        params = (
-            stacked,
-            unwrap(self.gpt.embeddings.word_embeddings.weight),
-            unwrap(self.gpt.embeddings.position_embeddings.weight),
-            unwrap(self.gpt.final_norm.weight),
-            unwrap(self.gpt.final_norm.bias),
-        )
+        params = self._decode_params()
         # tensor-parallel decode: when the fleet mesh has mp>1 (and no pp),
         # place the trunk stack per its dist_spec annotations and thread the
         # mesh so caches/logits stay mp-sharded through the token loop
@@ -674,6 +665,7 @@ class GPTForPretraining(nn.Layer):
                 from jax.sharding import NamedSharding
 
                 mesh = fm
+                stack = self.gpt.layers
                 specs = [getattr(getattr(stack, n), "dist_spec", None) for n in stack._order]
                 placed = tuple(
                     jax.device_put(arr, NamedSharding(mesh, sp if sp is not None else P()))
@@ -694,6 +686,73 @@ class GPTForPretraining(nn.Layer):
             temperature=float(temperature), top_k=int(top_k), top_p=float(top_p),
             eos=None if eos_token_id is None else int(eos_token_id), mesh=mesh)
         return _wrap_value(out)
+
+    def _decode_params(self):
+        """The decode-loop parameter pack (single definition shared by
+        generate() and export_decoder — layout matches GPTBlockStack._order)."""
+        from ..framework.core import unwrap
+
+        cfg = self.gpt.cfg
+        stack = self.gpt.layers
+        stacked = (tuple(unwrap(getattr(stack, n)) for n in stack._order),
+                   jnp.arange(cfg.num_layers, dtype=jnp.int32))
+        return (
+            stacked,
+            unwrap(self.gpt.embeddings.word_embeddings.weight),
+            unwrap(self.gpt.embeddings.position_embeddings.weight),
+            unwrap(self.gpt.final_norm.weight),
+            unwrap(self.gpt.final_norm.bias),
+        )
+
+    def export_decoder(self, path, prompt_len, max_new_tokens=32, do_sample=False,
+                       temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None):
+        """Export the whole decode loop (prefill + KV-cache token scan +
+        sampling) as a deployable StableHLO artifact servable by
+        ``paddle.inference.create_predictor``.
+
+        Parity: the reference deploys decoding through the fused decoder op
+        inside an inference program (fused_multi_transformer_op.cu consumed
+        by AnalysisPredictor); here the artifact IS the compiled loop. The
+        batch dimension is symbolic; ``prompt_len`` is fixed at export (the
+        KV cache is static-shape). Feeds: ids [b, prompt_len] int32, seed []
+        int32. Fetch: tokens [b, prompt_len + max_new_tokens] int32.
+        """
+        import pickle
+        from pathlib import Path
+
+        cfg = self.gpt.cfg
+        if not isinstance(self.gpt.layers, GPTBlockStack):
+            raise NotImplementedError("export_decoder requires the stacked trunk")
+        if prompt_len + max_new_tokens > cfg.max_seq_len:
+            raise ValueError("prompt_len + max_new_tokens exceeds max_seq_len")
+        Path(str(path)).parent.mkdir(parents=True, exist_ok=True)
+        params = self._decode_params()
+
+        def decode(ids, seed):
+            return _generate_jit(
+                params, ids, jax.random.key(seed),
+                num_heads=cfg.num_heads, num_layers=cfg.num_layers,
+                head_dim=cfg.hidden_size // cfg.num_heads,
+                max_new=int(max_new_tokens), do_sample=bool(do_sample),
+                temperature=float(temperature), top_k=int(top_k),
+                top_p=float(top_p),
+                eos=None if eos_token_id is None else int(eos_token_id))
+
+        scope = jax.export.SymbolicScope()
+        b = jax.export.symbolic_shape("b", scope=scope)[0]
+        exported = jax.export.export(jax.jit(decode))(
+            jax.ShapeDtypeStruct((b, int(prompt_len)), jnp.int32),
+            jax.ShapeDtypeStruct((), jnp.int32))
+        Path(str(path) + ".pdmodel").write_bytes(exported.serialize())
+        meta = {
+            "feed_names": ["ids", "seed"],
+            "fetch_names": ["tokens"],
+            "feed_shapes": [[-1, int(prompt_len)], []],
+            "feed_dtypes": ["int32", "int32"],
+            "decoder": {"prompt_len": int(prompt_len), "max_new_tokens": int(max_new_tokens)},
+        }
+        Path(str(path) + ".pdiparams").write_bytes(pickle.dumps(meta))
+        return str(path)
 
 
 class GPTPretrainingCriterion(nn.Layer):

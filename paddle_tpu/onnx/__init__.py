@@ -1,0 +1,13 @@
+"""paddle.onnx parity: ``paddle.onnx.export``.
+
+Parity: python/paddle/onnx/export.py (which delegates to the external
+paddle2onnx converter over a traced program). Here the exporter walks this
+repo's own static-trace IR (framework/static_trace.py Program) and emits a
+standard ONNX ModelProto. The protobuf wire encoding is written directly
+(onnx is not installed in this environment; the format is stable and small
+— varint/length-delimited fields only), so the artifact is loadable by any
+onnx runtime outside.
+"""
+from .export import export  # noqa: F401
+
+__all__ = ["export"]

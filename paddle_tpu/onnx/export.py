@@ -1,0 +1,242 @@
+"""ONNX export over the static-trace IR.
+
+The op allowlist maps this repo's primitive names (the `_name` labels the
+tensor API records into Program ops) onto ONNX ops. Anything outside the
+allowlist raises with the offending op named — same contract as the
+reference's unsupported-op errors in paddle2onnx.
+"""
+from __future__ import annotations
+
+import struct
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+# ---------------------------------------------------------------- protobuf
+# Minimal writer for the proto3 wire format: varint (type 0) and
+# length-delimited (type 2) fields are all ONNX needs.
+
+
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    n &= (1 << 64) - 1
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        out.append(b | (0x80 if n else 0))
+        if not n:
+            return bytes(out)
+
+
+def _tag(field: int, wire: int) -> bytes:
+    return _varint((field << 3) | wire)
+
+
+def _field_varint(field: int, value: int) -> bytes:
+    return _tag(field, 0) + _varint(value)
+
+
+def _field_bytes(field: int, data: bytes) -> bytes:
+    return _tag(field, 2) + _varint(len(data)) + data
+
+
+def _field_str(field: int, s: str) -> bytes:
+    return _field_bytes(field, s.encode())
+
+
+# ONNX TensorProto.DataType
+_DTYPE = {"float32": 1, "uint8": 2, "int8": 3, "int16": 5, "int32": 6,
+          "int64": 7, "bool": 9, "float16": 10, "float64": 11, "bfloat16": 16}
+
+
+def _tensor_proto(name: str, arr: np.ndarray) -> bytes:
+    arr = np.ascontiguousarray(arr)
+    out = b""
+    for d in arr.shape:
+        out += _field_varint(1, int(d))          # dims
+    out += _field_varint(2, _DTYPE[str(arr.dtype)])  # data_type
+    out += _field_str(8, name)                   # name
+    out += _field_bytes(9, arr.tobytes())        # raw_data
+    return out
+
+
+def _value_info(name: str, shape, dtype: str) -> bytes:
+    dims = b""
+    for d in shape:
+        if d is None or d < 0:
+            dims += _field_bytes(1, _field_str(2, "batch"))   # dim_param
+        else:
+            dims += _field_bytes(1, _field_varint(1, int(d)))  # dim_value
+    tensor_type = _field_varint(1, _DTYPE[dtype]) + _field_bytes(2, dims)
+    type_proto = _field_bytes(1, tensor_type)
+    return _field_str(1, name) + _field_bytes(2, type_proto)
+
+
+def _attr_ints(name: str, values) -> bytes:
+    out = _field_str(1, name)
+    for v in values:
+        out += _field_varint(8, int(v) & ((1 << 64) - 1))
+    out += _field_varint(20, 7)  # AttributeType.INTS
+    return out
+
+
+def _attr_int(name: str, v: int) -> bytes:
+    return _field_str(1, name) + _field_varint(3, int(v)) + _field_varint(20, 2)
+
+
+def _attr_float(name: str, v: float) -> bytes:
+    return (_field_str(1, name) + _tag(2, 5) + struct.pack("<f", float(v))
+            + _field_varint(20, 1))
+
+
+def _node(op_type: str, inputs: List[str], outputs: List[str], name: str = "", attrs: List[bytes] = ()) -> bytes:
+    out = b""
+    for i in inputs:
+        out += _field_str(1, i)
+    for o in outputs:
+        out += _field_str(2, o)
+    if name:
+        out += _field_str(3, name)
+    out += _field_str(4, op_type)
+    for a in attrs:
+        out += _field_bytes(5, a)
+    return out
+
+
+# ------------------------------------------------------------------ lowering
+
+_ELEMENTWISE = {
+    "add": "Add", "subtract": "Sub", "multiply": "Mul", "divide": "Div",
+    "maximum": "Max", "minimum": "Min", "pow": "Pow",
+}
+_UNARY = {
+    "relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh", "exp": "Exp",
+    "log": "Log", "sqrt": "Sqrt", "abs": "Abs", "neg": "Neg", "erf": "Erf",
+    "gelu": "Gelu",
+}
+
+
+def _lower_op(op, in_names: List[str], out_names: List[str], reg):
+    """One Program op -> list of NodeProto bytes."""
+    n = op.name
+    if n in ("linear",):
+        # x @ W + b -> Gemm (W is [in, out]; Gemm computes A·B + C directly)
+        return [_node("Gemm", in_names, out_names, reg.fresh("gemm"))]
+    if n in ("matmul", "mm", "bmm"):
+        return [_node("MatMul", in_names[:2], out_names, reg.fresh("matmul"))]
+    if n in _ELEMENTWISE:
+        return [_node(_ELEMENTWISE[n], in_names[:2], out_names, reg.fresh(n))]
+    if n in _UNARY:
+        return [_node(_UNARY[n], in_names[:1], out_names, reg.fresh(n))]
+    if n == "softmax":
+        axis = op.kwargs.get("axis", -1)
+        return [_node("Softmax", in_names[:1], out_names, reg.fresh(n), [_attr_int("axis", axis)])]
+    if n in ("reshape", "flatten"):
+        shape = [int(d) for d in op.outputs[0].shape]
+        shape_name = reg.add_const(np.asarray([-1] + shape[1:], np.int64))
+        return [_node("Reshape", [in_names[0], shape_name], out_names, reg.fresh(n))]
+    if n == "conv2d":
+        stride = op.kwargs.get("stride", 1)
+        padding = op.kwargs.get("padding", 0)
+        stride = [stride] * 2 if isinstance(stride, int) else list(stride)
+        padding = [padding] * 4 if isinstance(padding, int) else list(padding) * 2
+        attrs = [_attr_ints("strides", stride), _attr_ints("pads", padding)]
+        return [_node("Conv", in_names, out_names, reg.fresh(n), attrs)]
+    if n in ("dropout", "identity"):
+        return [_node("Identity", in_names[:1], out_names, reg.fresh(n))]
+    raise NotImplementedError(
+        f"paddle.onnx.export: op {n!r} has no ONNX lowering yet "
+        "(allowlist: linear/matmul/elementwise/activations/softmax/reshape/"
+        "conv2d) — export via paddle.jit.save (StableHLO) instead")
+
+
+class _Reg:
+    def __init__(self):
+        self.counter = 0
+        self.extra_inits: List[bytes] = []
+
+    def fresh(self, hint):
+        self.counter += 1
+        return f"{hint}_{self.counter}"
+
+    def add_const(self, arr):
+        name = self.fresh("const")
+        self.extra_inits.append(_tensor_proto(name, arr))
+        return name
+
+
+def export(layer, path, input_spec=None, opset_version=13, **configs):
+    """Trace ``layer`` with ``input_spec`` through the static recorder and
+    write ``<path>.onnx``. Returns the file path."""
+    from .. import static as static_mod
+    from ..framework.core import Tensor
+    from ..framework.static_trace import Program, pop_program, push_program
+    from ..static import InputSpec
+
+    if input_spec is None:
+        raise ValueError("paddle.onnx.export requires input_spec")
+
+    prog = Program()
+    feeds = []
+    push_program(prog)
+    try:
+        for i, spec in enumerate(input_spec):
+            name = getattr(spec, "name", None) or f"x{i}"
+            shape = [(-1 if (d is None or d < 0) else int(d)) for d in spec.shape]
+            feeds.append(static_mod.data(name, shape, str(np.dtype(spec.dtype))))
+        was_training = layer.training
+        layer.eval()
+        try:
+            out = layer(*feeds)
+        finally:
+            if was_training:
+                layer.train()
+    finally:
+        pop_program()
+    outs = list(out) if isinstance(out, (tuple, list)) else [out]
+
+    reg = _Reg()
+    sym_names: Dict[int, str] = {}
+    inits: List[bytes] = []
+    init_seen = set()
+    nodes: List[bytes] = []
+
+    def name_of(kind, ref):
+        if kind == "sym":
+            return sym_names.setdefault(id(ref), ref.name)
+        if kind == "tensor":
+            nm = ref.name or f"param_{id(ref)}"
+            if nm not in init_seen:
+                init_seen.add(nm)
+                inits.append(_tensor_proto(nm, np.asarray(ref._value)))
+            return nm
+        # const scalar: becomes an initializer
+        return reg.add_const(np.asarray(ref))
+
+    for op in prog.ops:
+        in_names = [name_of(k, r) for k, r in op.inputs]
+        out_names = [sym_names.setdefault(id(o), o.name) for o in op.outputs]
+        nodes.extend(_lower_op(op, in_names, out_names, reg))
+
+    graph = b""
+    for nd in nodes:
+        graph += _field_bytes(1, nd)
+    graph += _field_str(2, "paddle_tpu_graph")
+    for ini in inits + reg.extra_inits:
+        graph += _field_bytes(5, ini)
+    for f, spec in zip(feeds, input_spec):
+        shape = [(None if (d is None or (isinstance(d, int) and d < 0)) else int(d)) for d in spec.shape]
+        graph += _field_bytes(11, _value_info(f._value.name, shape, str(np.dtype(spec.dtype))))
+    for o in outs:
+        sv = o._value
+        graph += _field_bytes(12, _value_info(sv.name, [int(d) if d >= 0 else None for d in sv.shape], str(sv.dtype)))
+
+    model = _field_varint(1, 8)  # ir_version 8
+    model += _field_str(2, "paddle_tpu")
+    model += _field_bytes(7, graph)
+    model += _field_bytes(8, _field_str(1, "") + _field_varint(2, int(opset_version)))
+
+    out_path = str(path) + (".onnx" if not str(path).endswith(".onnx") else "")
+    with open(out_path, "wb") as f:
+        f.write(model)
+    return out_path

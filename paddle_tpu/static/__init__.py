@@ -473,5 +473,237 @@ class _StaticNN:
 
         return nn.Embedding(size[0], size[1])(input)
 
+    # -- control flow (reference fluid/layers/control_flow.py) -------------
+    # The reference builds ConditionalBlock / While sub-blocks in the
+    # ProgramDesc; the XLA-native forms are lax.cond / lax.while_loop /
+    # lax.switch. These work identically in eager, @to_static and recorded
+    # static programs — and are the documented bridge for Python `if`/`while`
+    # over traced values (which cannot compile; see jit.to_static docs).
+    # In static capture, branch/body closures are traced into SUB-programs
+    # (the ConditionalBlock analog): their outer-variable reads become the
+    # recorded cond/while op's inputs, and the sub-program interprets inside
+    # lax.cond / lax.while_loop at Executor time.
+
+    @staticmethod
+    def _trace_subblock(fn, *placeholder_specs):
+        """Run ``fn`` (with fresh symbolic placeholders for
+        ``placeholder_specs``) inside a nested Program. Returns
+        (subprogram, out_tensors, placeholders, outer_sym_deps, tensor_deps).
+        """
+        from ..framework.core import Tensor, _wrap_value
+        from ..framework.static_trace import Program, pop_program, push_program, SymbolicValue
+
+        sub = Program()
+        push_program(sub)
+        try:
+            phs = [
+                _wrap_value(SymbolicValue(tuple(s.shape), s.dtype, sub.fresh_name("loopvar")), stop_gradient=True)
+                for s in placeholder_specs
+            ]
+            out = fn(*phs) if phs else fn()
+        finally:
+            pop_program()
+        outs = list(out) if isinstance(out, (tuple, list)) else [out]
+        produced = {sv.name for op_ in sub.ops for sv in op_.outputs}
+        produced |= {t._value.name for t in phs}
+        sym_deps, tensor_deps = {}, {}
+        for op_ in sub.ops:
+            for kind, ref in op_.inputs:
+                if kind == "sym" and ref.name not in produced:
+                    sym_deps[ref.name] = ref
+                elif kind == "tensor":
+                    tensor_deps[id(ref)] = ref
+        return sub, outs, phs, sym_deps, tensor_deps
+
+    @staticmethod
+    def _branch_closure(branches):
+        """Trace each branch into a sub-block; build a picker that evaluates
+        branch ``i`` from positional values. Dependencies — outer symbolic
+        reads, captured concrete tensors, and outer values RETURNED
+        unchanged — all become positional inputs of the recorded op, so
+        gradients flow through closure-captured parameters (the eager tape /
+        jax.vjp sees them as real inputs) and identity branches resolve."""
+        from ..framework.core import Tensor
+        from ..framework.static_trace import is_symbolic
+
+        traced = [_StaticNN._trace_subblock(fn) for fn in branches]
+        n_out = len(traced[0][1])
+        if any(len(t[1]) != n_out for t in traced):
+            raise ValueError("all branches must return the same number of outputs")
+        sym_deps, tensor_deps = {}, {}
+        for sub, outs, _, deps, tens in traced:
+            sym_deps.update(deps)
+            tensor_deps.update(tens)
+            for o in outs:  # identity-returned outer values are deps too
+                if isinstance(o, Tensor):
+                    if is_symbolic(o._value):
+                        produced = {sv.name for op_ in sub.ops for sv in op_.outputs}
+                        if o._value.name not in produced:
+                            sym_deps[o._value.name] = o._value
+                    else:
+                        tensor_deps.setdefault(id(o), o)
+        names = sorted(sym_deps)
+        tensors = [tensor_deps[k] for k in sorted(tensor_deps)]
+        tpos = {id(t): i for i, t in enumerate(tensors)}
+
+        def make_runner(vals):
+            env0 = dict(zip(names, vals[:len(names)]))
+            tvals = dict(zip([id(t) for t in tensors], vals[len(names):]))
+
+            def runner(i):
+                sub, outs, _, _, _ = traced[i]
+
+                def go(_):
+                    env = sub.interpret(dict(env0), tvals)
+                    res = []
+                    for o in outs:
+                        if is_symbolic(o._value):
+                            res.append(env[o._value.name])
+                        elif id(o) in tpos:  # identity-returned captured tensor
+                            res.append(vals[len(names) + tpos[id(o)]])
+                        else:  # true constant
+                            res.append(o._value)
+                    return tuple(res)
+
+                return go
+
+            return runner
+
+        inputs = [sym_deps[n] for n in names] + tensors
+        return make_runner, inputs, n_out
+
+    @staticmethod
+    def cond(pred, true_fn=None, false_fn=None, name=None):
+        from ..tensor._helpers import ensure_tensor, op
+
+        if true_fn is None or false_fn is None:
+            raise ValueError("static.nn.cond requires both true_fn and false_fn")
+        make_runner, inputs, n_out = _StaticNN._branch_closure([true_fn, false_fn])
+
+        def fn(p, *vals):
+            import jax
+
+            runner = make_runner(vals)
+            out = jax.lax.cond(jnp.all(p), runner(0), runner(1), 0)
+            return out if n_out > 1 else out[0]
+
+        return op(fn, ensure_tensor(pred), *inputs, _name="cond")
+
+    @staticmethod
+    def while_loop(cond, body, loop_vars, is_test=False, name=None):
+        """lax.while_loop bridge. Reverse-mode through an unbounded while is
+        impossible under XLA (unknown trip count), so differentiable loop
+        vars are rejected up front — detach() them, or express bounded
+        recurrences with RNN layers / lax.scan-based ops."""
+        import jax
+
+        from ..framework.core import Tensor
+        from ..tensor._helpers import ensure_tensor, op
+
+        loop_vars = [ensure_tensor(v) for v in loop_vars]
+        for v in loop_vars:
+            if isinstance(v, Tensor) and not v.stop_gradient:
+                raise ValueError(
+                    "static.nn.while_loop cannot backprop (XLA has no "
+                    "reverse-mode for unbounded while); pass detached loop "
+                    "vars or use a bounded scan (nn RNN layers)")
+        n_loop = len(loop_vars)
+        specs = [jax.ShapeDtypeStruct(tuple(v._value.shape), v._value.dtype) for v in loop_vars]
+
+        make_c, in_c, nc = _StaticNN._branch_closure_with_args([cond], specs)
+        make_b, in_b, nb = _StaticNN._branch_closure_with_args([body], specs)
+        if nb != n_loop:
+            raise ValueError(f"while_loop body returned {nb} values for {n_loop} loop vars")
+
+        def fn(*vals):
+            lv = vals[:n_loop]
+            cv = vals[n_loop:n_loop + len(in_c)]
+            bv = vals[n_loop + len(in_c):]
+
+            def c(vs):
+                return jnp.all(make_c(cv)(0, vs)(0)[0])
+
+            def b(vs):
+                return make_b(bv)(0, vs)(0)
+
+            return jax.lax.while_loop(c, b, tuple(lv))
+
+        out = op(fn, *loop_vars, *in_c, *in_b, _name="while_loop")
+        return list(out) if isinstance(out, tuple) else [out]
+
+    @staticmethod
+    def switch_case(branch_index, branch_fns, default=None, name=None):
+        from ..tensor._helpers import ensure_tensor, op
+
+        if isinstance(branch_fns, dict):
+            items = sorted(branch_fns.items())
+        else:
+            items = list(enumerate(branch_fns)) if callable(branch_fns[0]) else [tuple(kv) for kv in branch_fns]
+        keys = [k for k, _ in items]
+        fns = [f for _, f in items]
+        if default is None:
+            default = fns[-1]
+        make_runner, inputs, n_out = _StaticNN._branch_closure(fns + [default])
+
+        def fn(idx, *vals):
+            import jax
+
+            runner = make_runner(vals)
+            branches = [runner(i) for i in range(len(fns) + 1)]
+            # map sparse keys onto dense branch slots; unmatched -> default
+            slot = jnp.full((), len(fns), jnp.int32)
+            for i, k in enumerate(keys):
+                slot = jnp.where(idx == k, jnp.int32(i), slot)
+            out = jax.lax.switch(slot, branches, 0)
+            return out if n_out > 1 else out[0]
+
+        return op(fn, ensure_tensor(branch_index), *inputs, _name="switch_case")
+
+    @staticmethod
+    def _branch_closure_with_args(fns, arg_specs):
+        """_branch_closure variant for callables taking loop-var arguments:
+        traces fn(*placeholders) and returns a runner factory whose runners
+        are called as runner(vals)(i, loop_vals) -> go."""
+        from ..framework.static_trace import is_symbolic
+
+        traced = [_StaticNN._trace_subblock(fn, *arg_specs) for fn in fns]
+        n_out = len(traced[0][1])
+        sym_deps, tensor_deps = {}, {}
+        ph_names = [[p._value.name for p in t[2]] for t in traced]
+        for (sub, outs, phs, deps, tens), names_i in zip(traced, ph_names):
+            sym_deps.update({k: v for k, v in deps.items()})
+            tensor_deps.update(tens)
+        names = sorted(sym_deps)
+        tensors = [tensor_deps[k] for k in sorted(tensor_deps)]
+
+        def make_runner(vals):
+            env0 = dict(zip(names, vals[:len(names)]))
+            tvals = dict(zip([id(t) for t in tensors], vals[len(names):]))
+
+            def at(i, loop_vals):
+                sub, outs, phs, _, _ = traced[i]
+
+                def go(_):
+                    env = dict(env0)
+                    env.update({p._value.name: v for p, v in zip(phs, loop_vals)})
+                    env = sub.interpret(env, tvals)
+                    res = []
+                    for o in outs:
+                        if is_symbolic(o._value):
+                            if o._value.name in env:
+                                res.append(env[o._value.name])
+                            else:  # identity-returned placeholder
+                                res.append(loop_vals[[p._value.name for p in phs].index(o._value.name)])
+                        else:
+                            res.append(o._value)
+                    return tuple(res)
+
+                return go
+
+            return at
+
+        inputs = [sym_deps[n] for n in names] + tensors
+        return make_runner, inputs, n_out
+
 
 nn = _StaticNN()

@@ -1,0 +1,93 @@
+"""paddle.text parity: viterbi decoding + the dataset classes.
+
+Parity: python/paddle/text/viterbi_decode.py (ViterbiDecoder over the
+viterbi_decode CRF op, paddle/fluid/operators/viterbi_decode_op.*) and
+python/paddle/text/datasets/* (IMDB, Imikolov, Conll05, MovieLens,
+UCIHousing, WMT14/16 download-backed map-style datasets).
+
+TPU-first: the decode DP is a ``lax.scan`` over time steps (argmax
+backpointers carried as int32), one XLA computation for the whole batch —
+no per-step host loop. Datasets read from a local ``data_file`` (this
+environment has no egress; the reference's auto-download becomes an
+explicit file argument with the same record schema).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..nn.layer.base import Layer
+from ..tensor._helpers import ensure_tensor, op
+from . import datasets  # noqa: F401
+
+__all__ = ["viterbi_decode", "ViterbiDecoder", "datasets"]
+
+
+def _viterbi_raw(pot, trans, lengths, include_bos_eos_tag):
+    """pot [b, T, n] f32; trans [n, n]; lengths [b] int — (scores, paths)."""
+    b, T, n = pot.shape
+    lengths = lengths.astype(jnp.int32)
+
+    if include_bos_eos_tag:
+        # last tag = BOS (start), second-to-last = EOS (stop): sequences start
+        # from BOS-transitions and end with EOS-transitions (reference op attr)
+        bos, eos = n - 1, n - 2
+        alpha0 = pot[:, 0] + trans[bos][None, :]
+    else:
+        alpha0 = pot[:, 0]
+
+    def step(carry, t):
+        alpha, hist_dummy = carry
+        # scores[b, i, j] = alpha[b, i] + trans[i, j] + pot[b, t, j]
+        scores = alpha[:, :, None] + trans[None]
+        best_prev = jnp.argmax(scores, axis=1).astype(jnp.int32)  # [b, n]
+        best_score = jnp.max(scores, axis=1) + pot[:, t]
+        # positions past a sequence's length keep its alpha frozen
+        active = (t < lengths)[:, None]
+        alpha_new = jnp.where(active, best_score, alpha)
+        bp = jnp.where(active, best_prev, jnp.arange(n, dtype=jnp.int32)[None])
+        return (alpha_new, hist_dummy), bp
+
+    (alpha, _), bps = jax.lax.scan(step, (alpha0, jnp.int32(0)), jnp.arange(1, T))
+    # bps: [T-1, b, n]
+    if include_bos_eos_tag:
+        alpha = alpha + trans[:, eos][None, :]
+    scores = jnp.max(alpha, axis=-1)
+    last_tag = jnp.argmax(alpha, axis=-1).astype(jnp.int32)  # [b]
+
+    def back(carry, bp):
+        tag = carry
+        prev = jnp.take_along_axis(bp, tag[:, None], axis=1)[:, 0]
+        return prev, tag
+
+    first_tag, path_rev = jax.lax.scan(back, last_tag, bps, reverse=True)
+    # reverse scan yields tag_t for t=1..T-1 in forward order; the final
+    # carry is tag_0
+    paths = jnp.concatenate([first_tag[None], path_rev], axis=0).T  # [b, T]
+    # mask out positions beyond each length (reference emits only length
+    # tokens; static shapes here, so the tail repeats the last valid tag)
+    idx = jnp.arange(T, dtype=jnp.int32)[None]
+    paths = jnp.where(idx < lengths[:, None], paths, 0)
+    return scores, paths.astype(jnp.int64)
+
+
+def viterbi_decode(potentials, transition_params, lengths, include_bos_eos_tag=True, name=None):
+    """Highest-scoring tag sequence under unary ``potentials`` + CRF
+    ``transition_params``. Returns (scores [b], paths [b, T] int64)."""
+    return op(
+        lambda p, t, l: _viterbi_raw(p, t, l, include_bos_eos_tag),
+        ensure_tensor(potentials), ensure_tensor(transition_params), ensure_tensor(lengths),
+        _name="viterbi_decode")
+
+
+class ViterbiDecoder(Layer):
+    """Layer form (reference text/viterbi_decode.py ViterbiDecoder)."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        super().__init__()
+        self.transitions = ensure_tensor(transitions)
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths, self.include_bos_eos_tag)

@@ -1,0 +1,197 @@
+"""Misc API tail: paddle.text (viterbi + datasets), cost model, ASP
+sparsity, ONNX export.
+
+Parity: python/paddle/text/viterbi_decode.py, text/datasets/*,
+cost_model/cost_model.py, fluid/contrib/sparsity/asp.py,
+python/paddle/onnx/export.py.
+"""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _brute_viterbi(pot, trans, length, include_tag):
+    import itertools
+
+    n = pot.shape[-1]
+    best, best_score = None, -np.inf
+    for path in itertools.product(range(n), repeat=length):
+        s = pot[0, path[0]] + (trans[n - 1, path[0]] if include_tag else 0.0)
+        for t in range(1, length):
+            s += trans[path[t - 1], path[t]] + pot[t, path[t]]
+        if include_tag:
+            s += trans[path[-1], n - 2]
+        if s > best_score:
+            best, best_score = path, s
+    return np.array(best), best_score
+
+
+@pytest.mark.parametrize("include_tag", [True, False])
+def test_viterbi_decode_matches_bruteforce(include_tag):
+    rng = np.random.default_rng(0)
+    b, T, n = 3, 5, 4
+    pot = rng.standard_normal((b, T, n)).astype(np.float32)
+    trans = rng.standard_normal((n, n)).astype(np.float32)
+    lengths = np.array([5, 3, 4], np.int64)
+    scores, paths = paddle.text.viterbi_decode(
+        paddle.to_tensor(pot), paddle.to_tensor(trans), paddle.to_tensor(lengths),
+        include_bos_eos_tag=include_tag)
+    scores, paths = np.asarray(scores.numpy()), np.asarray(paths.numpy())
+    for i in range(b):
+        L = int(lengths[i])
+        want_path, want_score = _brute_viterbi(pot[i], trans, L, include_tag)
+        np.testing.assert_allclose(scores[i], want_score, rtol=1e-5)
+        np.testing.assert_array_equal(paths[i, :L], want_path)
+
+
+def test_viterbi_decoder_layer():
+    rng = np.random.default_rng(1)
+    trans = paddle.to_tensor(rng.standard_normal((4, 4)).astype(np.float32))
+    dec = paddle.text.ViterbiDecoder(trans)
+    pot = paddle.to_tensor(rng.standard_normal((2, 6, 4)).astype(np.float32))
+    scores, paths = dec(pot, paddle.to_tensor(np.array([6, 6], np.int64)))
+    assert scores.shape == [2] and paths.shape == [2, 6]
+
+
+def test_uci_housing_local_file_and_missing_error():
+    from paddle_tpu.text.datasets import UCIHousing
+
+    with pytest.raises(FileNotFoundError, match="egress"):
+        UCIHousing(data_file=None)
+    rng = np.random.default_rng(0)
+    with tempfile.NamedTemporaryFile("w", suffix=".data", delete=False) as f:
+        for _ in range(50):
+            f.write(" ".join(f"{v:.3f}" for v in rng.standard_normal(14)) + "\n")
+        path = f.name
+    try:
+        ds = UCIHousing(data_file=path, mode="train")
+        assert len(ds) == 40
+        x, y = ds[0]
+        assert x.shape == (13,) and y.shape == (1,)
+        te = UCIHousing(data_file=path, mode="test")
+        assert len(te) == 10
+    finally:
+        os.unlink(path)
+
+
+def test_cost_model_fn_path():
+    import jax.numpy as jnp
+
+    from paddle_tpu.cost_model import CostModel
+
+    cm = CostModel()
+    out = cm.profile_measure(fn=lambda a, b: (a @ b).sum(), args=(
+        jnp.ones((64, 64), jnp.float32), jnp.ones((64, 64), jnp.float32)))
+    assert out["flops"] > 2 * 64 * 64 * 64 * 0.5  # ~2·n^3 matmul flops
+    assert cm.static_cost_data() is out
+    assert isinstance(cm.get_static_op_time("matmul"), dict)
+
+
+def test_cost_model_program_path():
+    from paddle_tpu import static
+    from paddle_tpu.cost_model import CostModel
+
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [8, 16], "float32")
+            y = paddle.nn.Linear(16, 4)(x).sum()
+        out = CostModel().profile_measure(main, startup, feed={"x": np.ones((8, 16), np.float32)}, fetch_list=[y])
+        assert out["flops"] > 0
+    finally:
+        paddle.disable_static()
+
+
+def test_asp_prune_decorate_and_audit():
+    from paddle_tpu.incubate import asp
+
+    paddle.seed(0)
+    m = paddle.nn.Sequential(paddle.nn.Linear(16, 16), paddle.nn.ReLU(), paddle.nn.Linear(16, 4))
+    w_before = np.asarray(m[0].weight.numpy()).copy()
+    masks = asp.prune_model(m, n=2, m=4)
+    assert len(masks) == 2
+    w = np.asarray(m[0].weight.numpy())
+    assert asp.check_sparsity(w, n=2, m=4)
+    assert abs(asp.calculate_density(w) - 0.5) < 0.05
+    # kept entries are the per-group top-2 magnitudes
+    grp = np.abs(w_before.reshape(-1, 4))
+    kept = (w.reshape(-1, 4) != 0)
+    for g, k in zip(grp, kept):
+        assert set(np.argsort(-g)[:2]) == set(np.where(k)[0])
+
+    opt = asp.decorate(paddle.optimizer.SGD(learning_rate=0.1, parameters=m.parameters()))
+    x = paddle.to_tensor(np.random.default_rng(0).standard_normal((8, 16)).astype("float32"))
+    m(x).sum().backward()
+    opt.step()
+    assert asp.check_sparsity(np.asarray(m[0].weight.numpy()), n=2, m=4)
+
+
+def test_onnx_export_mlp_structure():
+    class MLP(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = paddle.nn.Linear(8, 16)
+            self.fc2 = paddle.nn.Linear(16, 4)
+
+        def forward(self, x):
+            import paddle_tpu.nn.functional as F
+
+            return F.softmax(self.fc2(F.relu(self.fc1(x))))
+
+    from paddle_tpu.static import InputSpec
+
+    paddle.seed(0)
+    m = MLP()
+    with tempfile.TemporaryDirectory() as d:
+        p = paddle.onnx.export(m, os.path.join(d, "mlp"), input_spec=[InputSpec([None, 8], "float32", name="x")])
+        blob = open(p, "rb").read()
+        assert len(blob) > 8 * 16 * 4  # weights embedded
+        for tokn in (b"Gemm", b"Relu", b"Softmax", b"paddle_tpu_graph", b"x"):
+            assert tokn in blob, tokn
+        # wire-level sanity: parse top-level fields of ModelProto
+        def fields(buf):
+            i, out = 0, []
+            while i < len(buf):
+                tag = buf[i]; i += 1
+                f, w = tag >> 3, tag & 7
+                if w == 0:
+                    v = 0; s = 0
+                    while True:
+                        b7 = buf[i]; i += 1
+                        v |= (b7 & 0x7F) << s; s += 7
+                        if not b7 & 0x80:
+                            break
+                    out.append((f, v))
+                elif w == 2:
+                    ln = 0; s = 0
+                    while True:
+                        b7 = buf[i]; i += 1
+                        ln |= (b7 & 0x7F) << s; s += 7
+                        if not b7 & 0x80:
+                            break
+                    out.append((f, buf[i:i + ln])); i += ln
+                elif w == 5:
+                    out.append((f, buf[i:i + 4])); i += 4
+                else:
+                    raise AssertionError(f"wire {w}")
+            return out
+
+        top = fields(blob)
+        fnums = [f for f, _ in top]
+        assert 1 in fnums and 7 in fnums and 8 in fnums  # ir_version, graph, opset
+
+
+def test_onnx_export_unsupported_op_errors():
+    class Weird(paddle.nn.Layer):
+        def forward(self, x):
+            return paddle.cumsum(x)
+
+    from paddle_tpu.static import InputSpec
+
+    with pytest.raises(NotImplementedError, match="ONNX lowering"):
+        paddle.onnx.export(Weird(), "/tmp/never", input_spec=[InputSpec([2, 3], "float32")])

@@ -379,3 +379,130 @@ def test_executor_opt_state_rebuilt_on_program_growth():
         static.append_backward(loss2)
     (v,) = exe.run(prog, feed={"x": xv}, fetch_list=[loss2])  # must not crash
     assert np.isfinite(v)
+
+
+def test_static_nn_cond_while_switch():
+    """Control-flow builders (reference fluid/layers/control_flow.py):
+    lax.cond/while_loop/switch bridges usable in eager and static."""
+    import paddle_tpu as paddle
+    from paddle_tpu import static
+
+    a = paddle.to_tensor(np.array(3.0, "float32"))
+    b = paddle.to_tensor(np.array(5.0, "float32"))
+    out = static.nn.cond(a < b, lambda: a + b, lambda: a - b)
+    assert float(out) == 8.0
+    out = static.nn.cond(a > b, lambda: a + b, lambda: a - b)
+    assert float(out) == -2.0
+
+    i = paddle.to_tensor(np.array(0, "int32"))
+    s = paddle.to_tensor(np.array(0.0, "float32"))
+    i2, s2 = static.nn.while_loop(
+        lambda i, s: i < 5,
+        lambda i, s: [i + 1, s + 2.0],
+        [i, s])
+    assert int(i2) == 5 and float(s2) == 10.0
+
+    idx = paddle.to_tensor(np.array(2, "int32"))
+    out = static.nn.switch_case(idx, {1: lambda: a, 2: lambda: b, 3: lambda: a + b})
+    assert float(out) == 5.0
+    out = static.nn.switch_case(paddle.to_tensor(np.array(9, "int32")),
+                                {1: lambda: a, 2: lambda: b}, default=lambda: a * b)
+    assert float(out) == 15.0
+
+
+def test_static_nn_cond_in_program():
+    import paddle_tpu as paddle
+    from paddle_tpu import static
+
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [4], "float32")
+            y = static.nn.cond(x.sum() > 0, lambda: x * 2.0, lambda: x * -1.0)
+        exe = static.Executor()
+        (out,) = exe.run(main, feed={"x": np.array([1, 1, 1, 1], "float32")}, fetch_list=[y])
+        np.testing.assert_allclose(out, [2, 2, 2, 2])
+        (out,) = exe.run(main, feed={"x": np.array([-1, -1, -1, -1], "float32")}, fetch_list=[y])
+        np.testing.assert_allclose(out, [1, 1, 1, 1])
+    finally:
+        paddle.disable_static()
+
+
+def test_cond_identity_branches_and_closure_grads():
+    import paddle_tpu as paddle
+    from paddle_tpu import static
+
+    # closure-captured parameter gets gradients through cond
+    w = paddle.to_tensor(np.array([2.0], "float32"))
+    w.stop_gradient = False
+    pred = paddle.to_tensor(np.array(True))
+    out = static.nn.cond(pred, lambda: w * 3.0, lambda: w * 5.0)
+    out.sum().backward()
+    np.testing.assert_allclose(w.grad.numpy(), [3.0])
+
+    # identity branches in a static program
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [2], "float32")
+            y = static.data("y", [2], "float32")
+            z = static.nn.cond(x.sum() > 0, lambda: x, lambda: y)
+        exe = static.Executor()
+        (out,) = exe.run(main, feed={"x": np.array([1, 2], "float32"),
+                                     "y": np.array([9, 9], "float32")}, fetch_list=[z])
+        np.testing.assert_allclose(out, [1, 2])
+        (out,) = exe.run(main, feed={"x": np.array([-1, -2], "float32"),
+                                     "y": np.array([9, 9], "float32")}, fetch_list=[z])
+        np.testing.assert_allclose(out, [9, 9])
+    finally:
+        paddle.disable_static()
+
+
+def test_while_loop_in_static_program_and_grad_rejection():
+    import paddle_tpu as paddle
+    from paddle_tpu import static
+
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [2], "float32")
+            i = paddle.zeros([], "int32")
+            i2, s2 = static.nn.while_loop(
+                lambda i, s: i < 3,
+                lambda i, s: [i + 1, s + x],
+                [i, paddle.zeros([2], "float32")])
+        exe = static.Executor()
+        iv, sv = exe.run(main, feed={"x": np.array([1.0, 2.0], "float32")}, fetch_list=[i2, s2])
+        assert int(iv) == 3
+        np.testing.assert_allclose(sv, [3.0, 6.0])
+    finally:
+        paddle.disable_static()
+
+    t = paddle.to_tensor(np.array([1.0], "float32"))
+    t.stop_gradient = False
+    with pytest.raises(ValueError, match="backprop"):
+        static.nn.while_loop(lambda v: (v < 5.0).all(), lambda v: v + 1, [t])
+
+
+def test_switch_case_in_static_program():
+    import paddle_tpu as paddle
+    from paddle_tpu import static
+
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [2], "float32")
+            idx = static.data("idx", [], "int32")
+            z = static.nn.switch_case(idx, {1: lambda: x * 10.0, 2: lambda: x - 1.0},
+                                      default=lambda: x * 0.0)
+        exe = static.Executor()
+        (out,) = exe.run(main, feed={"x": np.array([1, 2], "float32"), "idx": np.int32(1)}, fetch_list=[z])
+        np.testing.assert_allclose(out, [10, 20])
+        (out,) = exe.run(main, feed={"x": np.array([1, 2], "float32"), "idx": np.int32(7)}, fetch_list=[z])
+        np.testing.assert_allclose(out, [0, 0])
+    finally:
+        paddle.disable_static()
