@@ -1,0 +1,108 @@
+"""Scope/Variable tree (parity: paddle/fluid/framework/scope.h:78 +
+pybind _Scope, python/paddle/static global_scope).
+
+The reference executor resolves every op operand by name through a
+hierarchical Scope; under XLA the compiled program owns its buffers, so the
+Scope here is the *user-facing* name registry: Executor.run publishes
+parameter and fetch values into the global scope after each run, and
+``scope.find_var(name).get_tensor()`` serves the classic inspection /
+manual-checkpoint workflows.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["Scope", "Variable", "global_scope", "scope_guard"]
+
+
+class Variable:
+    """Named slot holding one tensor value (reference framework::Variable)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value = None
+
+    def get_tensor(self):
+        return self
+
+    def set(self, value, place=None):
+        import jax.numpy as jnp
+
+        self._value = jnp.asarray(np.asarray(value))
+
+    def __array__(self, dtype=None):
+        if self._value is None:
+            raise ValueError(f"Variable {self.name!r} holds no value yet")
+        arr = np.asarray(self._value)
+        return arr.astype(dtype) if dtype else arr
+
+    def shape(self):
+        return tuple(self._value.shape) if self._value is not None else None
+
+    def numpy(self):
+        return np.asarray(self)
+
+
+class Scope:
+    """Hierarchical name → Variable map (scope.h semantics: ``var`` creates
+    locally, ``find_var`` searches up the parent chain, ``new_scope`` makes
+    a kid, ``drop_kids`` releases the subtree)."""
+
+    def __init__(self, parent: Optional["Scope"] = None):
+        self._vars: Dict[str, Variable] = {}
+        self._parent = parent
+        self._kids: List["Scope"] = []
+
+    def var(self, name: str) -> Variable:
+        if name not in self._vars:
+            self._vars[name] = Variable(name)
+        return self._vars[name]
+
+    def find_var(self, name: str) -> Optional[Variable]:
+        s: Optional[Scope] = self
+        while s is not None:
+            if name in s._vars:
+                return s._vars[name]
+            s = s._parent
+        return None
+
+    def erase(self, names) -> None:
+        for n in names if isinstance(names, (list, tuple)) else [names]:
+            self._vars.pop(n, None)
+
+    def new_scope(self) -> "Scope":
+        kid = Scope(self)
+        self._kids.append(kid)
+        return kid
+
+    def drop_kids(self) -> None:
+        self._kids.clear()
+
+    def local_var_names(self) -> List[str]:
+        return sorted(self._vars)
+
+
+_GLOBAL = Scope()
+_ACTIVE = [_GLOBAL]
+
+
+def global_scope() -> Scope:
+    return _ACTIVE[-1]
+
+
+def scope_guard(scope: Scope):
+    """Context manager swapping the active global scope (reference
+    paddle.static.scope_guard)."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def ctx():
+        _ACTIVE.append(scope)
+        try:
+            yield
+        finally:
+            _ACTIVE.pop()
+
+    return ctx()

@@ -25,6 +25,7 @@ import numpy as np
 
 from ..framework.core import Tensor, _wrap_value, unwrap
 from ..framework.dtype import to_jax_dtype
+from ..framework.scope import Scope, Variable as ScopeVariable, global_scope, scope_guard
 from ..framework.static_trace import (
     Program,
     SymbolicValue,
@@ -39,6 +40,7 @@ __all__ = [
     "data", "Executor", "append_backward", "CompiledProgram", "InputSpec",
     "save_inference_model", "load_inference_model", "enable_static",
     "disable_static", "in_dynamic_mode", "gradients", "name_scope", "py_func",
+    "global_scope", "scope_guard", "Scope",
 ]
 
 _default_main = Program()
@@ -265,12 +267,22 @@ class Executor:
             if sym.name in buf_updates:
                 buf._value = buf_updates[sym.name]
 
+        # publish results into the active Scope (reference: the executor's
+        # variables live in global_scope; find_var(...).get_tensor() works)
+        from ..framework.scope import global_scope as _gs
+
+        gs = _gs()
+        for p in params:
+            if getattr(p, "name", None):
+                gs.var(p.name)._value = p._value
         out = []
         for i in range(len(fetch_list)):
             if i in passthrough:
                 v = passthrough[i]._value
             else:
                 v = fetched[fetch_names[i]]
+                if fetch_names[i]:
+                    gs.var(fetch_names[i])._value = v
             out.append(np.asarray(v) if return_numpy else _wrap_value(v))
         return out
 

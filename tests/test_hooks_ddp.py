@@ -12,6 +12,8 @@ import textwrap
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 import paddle_tpu as paddle
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))

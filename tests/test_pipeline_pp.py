@@ -7,6 +7,8 @@ pp_layers.py:162 (PipelineLayer), gradient_merge_optimizer.py.
 """
 import numpy as np
 import pytest
+
+pytestmark = pytest.mark.slow
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh

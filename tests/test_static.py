@@ -506,3 +506,36 @@ def test_switch_case_in_static_program():
         np.testing.assert_allclose(out, [0, 0])
     finally:
         paddle.disable_static()
+
+
+def test_scope_tree_and_executor_publishing():
+    """Scope/Variable parity (scope.h:78): hierarchical lookup + the
+    classic global_scope().find_var(...).get_tensor() inspection flow."""
+    import paddle_tpu as paddle
+    from paddle_tpu import static
+
+    s = static.Scope()
+    v = s.var("a")
+    v.set(np.array([1.0, 2.0], "float32"))
+    kid = s.new_scope()
+    assert kid.find_var("a") is v          # parent-chain lookup
+    assert s.find_var("missing") is None
+    kid.var("b")
+    assert kid.local_var_names() == ["b"]
+    s.drop_kids()
+
+    with static.scope_guard(s):
+        assert static.global_scope() is s
+        paddle.enable_static()
+        try:
+            main, startup = static.Program(), static.Program()
+            with static.program_guard(main, startup):
+                x = static.data("x", [2], "float32")
+                y = (x * 3.0).sum()
+            exe = static.Executor()
+            exe.run(main, feed={"x": np.array([1.0, 2.0], "float32")}, fetch_list=[y])
+            fetched = s.find_var(y._value.name)
+            assert fetched is not None
+            np.testing.assert_allclose(fetched.numpy(), 9.0)
+        finally:
+            paddle.disable_static()
