@@ -7,11 +7,21 @@
   recorded and the flash-attention toggle is honored)
 - ``incubate.distributed``: MoE re-export (reference
   incubate/distributed/models/moe)
+- ``incubate.asp``: n:m structured sparsity (fluid/contrib/sparsity parity)
+- graph ops: graph_send_recv / graph_reindex / fused softmax-mask
+  (incubate/operators parity; segment_* reductions under XLA)
 """
 from __future__ import annotations
 
 from . import nn  # noqa: F401
 from . import optimizer  # noqa: F401
+from . import asp  # noqa: F401 — n:m structured sparsity (contrib/sparsity parity)
+from .graph_ops import (  # noqa: F401
+    graph_reindex,
+    graph_send_recv,
+    softmax_mask_fuse,
+    softmax_mask_fuse_upper_triangle,
+)
 
 from ..autograd import functional as autograd  # noqa: F401 — jacobian/hessian (incubate.autograd parity)
 

@@ -1,0 +1,108 @@
+"""Graph-learning operators.
+
+Parity: python/paddle/incubate/operators/graph_send_recv.py (+ the
+graph_reindex / sample-neighbors family) and the fused softmax-mask ops
+(softmax_mask_fuse.py, softmax_mask_fuse_upper_triangle.py).
+
+TPU-first: message passing is gather + segment reduction —
+``jax.ops.segment_*`` compiles to one fused scatter per pool type, which IS
+the memory-saving fusion the reference's CUDA kernel provides (no
+[num_edges, F] intermediate in HBM after XLA fuses the gather into the
+scatter). Neighbor sampling is host-side (numpy) by nature — it produces
+data-dependent shapes, which belong outside the compiled graph.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..tensor._helpers import ensure_tensor, op
+
+__all__ = ["graph_send_recv", "graph_reindex", "softmax_mask_fuse",
+           "softmax_mask_fuse_upper_triangle"]
+
+
+def graph_send_recv(x, src_index, dst_index, pool_type="sum", out_size=None, name=None):
+    """Gather ``x[src_index]``, reduce into ``dst_index`` slots.
+
+    pool_type: sum | mean | max | min. Output rows with no incoming message
+    are 0 (sum/mean, reference semantics) or 0 for max/min (the reference
+    fills with 0, not ±inf)."""
+    import jax
+    import jax.numpy as jnp
+
+    pool = pool_type.lower()
+    if pool not in ("sum", "mean", "max", "min"):
+        raise ValueError(f"pool_type must be sum/mean/max/min, got {pool_type}")
+
+    x = ensure_tensor(x)
+    n_out = int(out_size) if out_size is not None else int(x._value.shape[0])
+
+    def fn(xv, src, dst):
+        src = src.astype(jnp.int32)
+        dst = dst.astype(jnp.int32)
+        msgs = jnp.take(xv, src, axis=0)
+        if pool == "sum":
+            return jax.ops.segment_sum(msgs, dst, num_segments=n_out)
+        if pool == "mean":
+            s = jax.ops.segment_sum(msgs, dst, num_segments=n_out)
+            cnt = jax.ops.segment_sum(jnp.ones_like(dst, xv.dtype), dst, num_segments=n_out)
+            return s / jnp.maximum(cnt, 1)[(...,) + (None,) * (xv.ndim - 1)]
+        if pool == "max":
+            r = jax.ops.segment_max(msgs, dst, num_segments=n_out)
+        else:
+            r = jax.ops.segment_min(msgs, dst, num_segments=n_out)
+        # unreceived slots come back ±inf from segment_max/min; reference
+        # leaves them 0
+        return jnp.where(jnp.isfinite(r), r, jnp.zeros_like(r))
+
+    return op(fn, x, ensure_tensor(src_index), ensure_tensor(dst_index), _name="graph_send_recv")
+
+
+def graph_reindex(x, neighbors, count, value_buffer=None, index_buffer=None, flag_buffer_hashtable=False, name=None):
+    """Reindex a sampled subgraph to contiguous local ids (reference
+    graph_reindex.py). Host-side numpy: the output shapes are data-dependent
+    (unique node count), so this runs outside jit by design.
+
+    Returns (reindex_src, reindex_dst, out_nodes)."""
+    from ..framework.core import _wrap_value
+    import jax.numpy as jnp
+
+    xv = np.asarray(ensure_tensor(x).numpy()).reshape(-1)
+    nb = np.asarray(ensure_tensor(neighbors).numpy()).reshape(-1)
+    cnt = np.asarray(ensure_tensor(count).numpy()).reshape(-1)
+
+    out_nodes = list(xv)
+    seen = {int(v): i for i, v in enumerate(xv)}
+    for v in nb:
+        v = int(v)
+        if v not in seen:
+            seen[v] = len(out_nodes)
+            out_nodes.append(v)
+    reindex_src = np.array([seen[int(v)] for v in nb], np.int64)
+    dst = np.repeat(np.arange(len(xv), dtype=np.int64), cnt)
+    return (_wrap_value(jnp.asarray(reindex_src)),
+            _wrap_value(jnp.asarray(dst)),
+            _wrap_value(jnp.asarray(np.array(out_nodes, np.int64))))
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """softmax(x + mask) (reference fused_softmax_mask op) — XLA fuses the
+    add into the softmax; the op exists for API parity."""
+    import jax
+
+    return op(lambda a, m: jax.nn.softmax(a + m, axis=-1),
+              ensure_tensor(x), ensure_tensor(mask), _name="softmax_mask_fuse")
+
+
+def softmax_mask_fuse_upper_triangle(x, name=None):
+    """softmax with the upper triangle masked out (causal mask fused;
+    reference softmax_mask_fuse_upper_triangle)."""
+    import jax
+    import jax.numpy as jnp
+
+    def fn(a):
+        s = a.shape[-1]
+        mask = jnp.tril(jnp.ones((s, s), bool))
+        return jax.nn.softmax(jnp.where(mask, a, -1e9), axis=-1)
+
+    return op(fn, ensure_tensor(x), _name="softmax_mask_fuse_upper_triangle")

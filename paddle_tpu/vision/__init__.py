@@ -3,3 +3,4 @@ synthetic/local-file based (no network in the build environment)."""
 from . import datasets  # noqa: F401
 from . import models  # noqa: F401
 from . import transforms  # noqa: F401
+from . import ops  # noqa: F401

@@ -195,3 +195,50 @@ def test_onnx_export_unsupported_op_errors():
 
     with pytest.raises(NotImplementedError, match="ONNX lowering"):
         paddle.onnx.export(Weird(), "/tmp/never", input_spec=[InputSpec([2, 3], "float32")])
+
+
+def test_graph_send_recv_pools():
+    from paddle_tpu.incubate import graph_send_recv
+
+    x = paddle.to_tensor(np.array([[0, 2, 3], [1, 4, 5], [2, 6, 7]], "float32"))
+    src = paddle.to_tensor(np.array([0, 1, 2, 0], "int32"))
+    dst = paddle.to_tensor(np.array([1, 2, 1, 0], "int32"))
+    out = graph_send_recv(x, src, dst, pool_type="sum").numpy()
+    np.testing.assert_allclose(out, [[0, 2, 3], [2, 8, 10], [1, 4, 5]])
+    out = graph_send_recv(x, src, dst, pool_type="mean").numpy()
+    np.testing.assert_allclose(out, [[0, 2, 3], [1, 4, 5], [1, 4, 5]])
+    out = graph_send_recv(x, src, dst, pool_type="max").numpy()
+    np.testing.assert_allclose(out, [[0, 2, 3], [2, 6, 7], [1, 4, 5]])
+    # out_size extends/truncates the output rows
+    out = graph_send_recv(x, src, dst, pool_type="sum", out_size=2).numpy()
+    assert out.shape == (2, 3)
+    # gradients flow through gather+scatter
+    xt = paddle.to_tensor(np.ones((3, 3), "float32"))
+    xt.stop_gradient = False
+    graph_send_recv(xt, src, dst, "sum").sum().backward()
+    np.testing.assert_allclose(xt.grad.numpy(), [[2, 2, 2], [1, 1, 1], [1, 1, 1]])
+
+
+def test_graph_reindex():
+    from paddle_tpu.incubate import graph_reindex
+
+    x = paddle.to_tensor(np.array([0, 5, 9], "int64"))
+    neighbors = paddle.to_tensor(np.array([8, 9, 0, 4, 7, 6, 7], "int64"))
+    count = paddle.to_tensor(np.array([2, 3, 2], "int32"))
+    src, dst, nodes = graph_reindex(x, neighbors, count)
+    nodes = nodes.numpy()
+    assert list(nodes[:3]) == [0, 5, 9]
+    # each neighbor maps to its slot in nodes
+    np.testing.assert_array_equal(nodes[src.numpy()], neighbors.numpy())
+    np.testing.assert_array_equal(dst.numpy(), [0, 0, 1, 1, 1, 2, 2])
+
+
+def test_softmax_mask_fuse_ops():
+    from paddle_tpu.incubate import softmax_mask_fuse, softmax_mask_fuse_upper_triangle
+
+    x = paddle.to_tensor(np.random.default_rng(0).standard_normal((2, 4, 4)).astype("float32"))
+    m = paddle.to_tensor(np.zeros((2, 4, 4), "float32"))
+    np.testing.assert_allclose(softmax_mask_fuse(x, m).numpy().sum(-1), np.ones((2, 4)), rtol=1e-5)
+    out = softmax_mask_fuse_upper_triangle(x).numpy()
+    assert np.allclose(out.sum(-1), 1.0, rtol=1e-5)
+    assert (np.triu(out[0], 1) < 1e-6).all()  # upper triangle masked away

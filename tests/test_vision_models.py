@@ -58,3 +58,62 @@ def test_resnet18_trainstep_updates_bn():
     changed = any(not np.allclose(before[k], after[k]) for k in before)
     assert changed, "BatchNorm running stats should update in TrainStep"
     assert np.isfinite(l_last)
+
+
+def test_nms_greedy_suppression():
+    from paddle_tpu.vision.ops import nms
+
+    boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [20, 20, 30, 30], [0, 0, 9, 9]], "float32")
+    scores = np.array([0.9, 0.8, 0.7, 0.95], "float32")
+    kept = np.asarray(nms(paddle.to_tensor(boxes), 0.3, paddle.to_tensor(scores)).numpy())
+    # box3 (score .95) suppresses 0 and 1; box2 is disjoint
+    assert list(kept) == [3, 2]
+    # per-category: same boxes in different categories never suppress
+    cats = np.array([0, 1, 0, 1], "int64")
+    kept = np.asarray(nms(paddle.to_tensor(boxes), 0.3, paddle.to_tensor(scores),
+                          paddle.to_tensor(cats), categories=[0, 1]).numpy())
+    assert set(kept) == {3, 0, 2}
+
+
+def test_roi_align_uniform_feature():
+    from paddle_tpu.vision.ops import roi_align
+
+    feat = paddle.to_tensor(np.full((1, 2, 8, 8), 3.0, "float32"))
+    boxes = paddle.to_tensor(np.array([[0, 0, 4, 4], [2, 2, 6, 6]], "float32"))
+    out = roi_align(feat, boxes, paddle.to_tensor(np.array([2], "int32")), output_size=2)
+    assert out.shape == [2, 2, 2, 2]
+    np.testing.assert_allclose(out.numpy(), 3.0, rtol=1e-5)
+
+
+def test_roi_align_gradient_flows():
+    from paddle_tpu.vision.ops import roi_align
+
+    feat = paddle.to_tensor(np.random.default_rng(0).standard_normal((1, 1, 8, 8)).astype("float32"))
+    feat.stop_gradient = False
+    boxes = paddle.to_tensor(np.array([[1, 1, 6, 6]], "float32"))
+    out = roi_align(feat, boxes, paddle.to_tensor(np.array([1], "int32")), output_size=3)
+    out.sum().backward()
+    assert feat.grad is not None and float(np.abs(feat.grad.numpy()).sum()) > 0
+
+
+def test_roi_pool_max():
+    from paddle_tpu.vision.ops import roi_pool
+
+    f = np.zeros((1, 1, 8, 8), "float32")
+    f[0, 0, 2, 2] = 5.0
+    out = roi_pool(paddle.to_tensor(f), paddle.to_tensor(np.array([[0, 0, 8, 8]], "float32")),
+                   paddle.to_tensor(np.array([1], "int32")), output_size=2)
+    assert float(out.numpy().max()) == 5.0
+
+
+def test_yolo_box_shapes():
+    from paddle_tpu.vision.ops import yolo_box
+
+    N, A, C, H, W = 1, 3, 4, 2, 2
+    x = paddle.to_tensor(np.random.default_rng(0).standard_normal((N, A * (5 + C), H, W)).astype("float32"))
+    img = paddle.to_tensor(np.array([[64, 64]], "int32"))
+    boxes, scores = yolo_box(x, img, anchors=[10, 13, 16, 30, 33, 23], class_num=C, conf_thresh=0.0)
+    assert boxes.shape == [N, A * H * W, 4]
+    assert scores.shape == [N, A * H * W, C]
+    b = boxes.numpy()
+    assert (b >= 0).all() and (b <= 63).all()
