@@ -32,6 +32,16 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=N
     ns = normalized_shape if isinstance(normalized_shape, (list, tuple)) else [normalized_shape]
     axes = tuple(range(-len(ns), 0))
 
+    if len(ns) == 1 and weight is not None and bias is not None:
+        # hot path: last-axis LN with affine params uses the fused
+        # closed-form-backward kernel (ops/layer_norm.py) — autodiff of the
+        # mean/var chain compiles to several× the bandwidth bound on TPU
+        from ...ops.layer_norm import layer_norm_fused
+
+        return op(lambda v, w, b: layer_norm_fused(v, w, b, epsilon),
+                  ensure_tensor(x), ensure_tensor(weight), ensure_tensor(bias),
+                  _name="layer_norm")
+
     def fn(v, *rest):
         mean = jnp.mean(v, axis=axes, keepdims=True)
         var = jnp.var(v, axis=axes, keepdims=True)

@@ -1,0 +1,66 @@
+"""Fused LayerNorm with a hand-derived backward.
+
+Parity: the reference's layer_norm CUDA kernels
+(paddle/phi/kernels/gpu/layer_norm_kernel.cu fwd + layer_norm_grad_kernel).
+
+Why not autodiff: the r4 profile of the flagship step shows XLA's
+autodiff-of-(mean/var/normalize) backward compiling into ~0.7ms/layer of
+multiply_reduce fusions (~19ms/step over 32 LNs) — several times the
+bandwidth bound. The closed-form backward
+
+    x̂   = (x − μ) σ⁻¹
+    g    = dy ⊙ w
+    dx   = σ⁻¹ (g − mean(g) − x̂ ⊙ mean(g ⊙ x̂))
+    dw   = Σ_tokens dy ⊙ x̂,   db = Σ_tokens dy
+
+is two token-row reductions + one elementwise pass, which XLA fuses into a
+couple of kernels. Statistics are computed and applied in f32 regardless of
+input dtype (bf16-safe); residuals are (x, μ, σ⁻¹) — recompute-x̂-in-bwd, no
+[.., d] normalized tensor stored.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def layer_norm_fused(x, w, b, eps=1e-5):
+    y, _ = _ln_fwd_core(x, w, b, eps)
+    return y
+
+
+def _ln_fwd_core(x, w, b, eps):
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    xc = xf - mu
+    var = jnp.mean(xc * xc, axis=-1, keepdims=True)
+    rstd = jax.lax.rsqrt(var + eps)
+    xhat = xc * rstd
+    y = (xhat * w.astype(jnp.float32) + b.astype(jnp.float32)).astype(x.dtype)
+    return y, (x, mu, rstd)
+
+
+def _ln_vjp_fwd(x, w, b, eps):
+    y, res = _ln_fwd_core(x, w, b, eps)
+    return y, res + (w,)
+
+
+def _ln_vjp_bwd(eps, res, dy):
+    x, mu, rstd, w = res
+    xf = x.astype(jnp.float32)
+    dyf = dy.astype(jnp.float32)
+    xhat = (xf - mu) * rstd
+    g = dyf * w.astype(jnp.float32)
+    mg = jnp.mean(g, axis=-1, keepdims=True)
+    mgx = jnp.mean(g * xhat, axis=-1, keepdims=True)
+    dx = (rstd * (g - mg - xhat * mgx)).astype(x.dtype)
+    red = tuple(range(dy.ndim - 1))
+    dw = jnp.sum(dyf * xhat, axis=red).astype(w.dtype)
+    db = jnp.sum(dyf, axis=red).astype(w.dtype)
+    return dx, dw, db
+
+
+layer_norm_fused.defvjp(_ln_vjp_fwd, _ln_vjp_bwd)

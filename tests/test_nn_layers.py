@@ -308,3 +308,39 @@ class TestTransformerCache:
         mask = _wrap_value(jnp.tril(jnp.ones((4, 4), bool)))
         full = dec(tgt, memory, tgt_mask=mask).numpy()
         np.testing.assert_allclose(inc, full, rtol=2e-5, atol=2e-5)
+
+
+def test_layer_norm_fused_matches_autodiff():
+    """ops.layer_norm_fused: closed-form backward == autodiff backward."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.layer_norm import layer_norm_fused
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((4, 16, 32)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((32,)), jnp.float32)
+    b = jnp.asarray(rng.standard_normal((32,)), jnp.float32)
+    g = jnp.asarray(rng.standard_normal((4, 16, 32)), jnp.float32)
+
+    def ref(x, w, b):
+        mu = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.var(x, axis=-1, keepdims=True)
+        return (x - mu) / jnp.sqrt(var + 1e-5) * w + b
+
+    y_f = layer_norm_fused(x, w, b)
+    y_r = ref(x, w, b)
+    np.testing.assert_allclose(np.asarray(y_f), np.asarray(y_r), rtol=2e-5, atol=2e-5)
+
+    loss_f = lambda *a: jnp.sum(layer_norm_fused(*a) * g)
+    loss_r = lambda *a: jnp.sum(ref(*a) * g)
+    gf = jax.grad(loss_f, argnums=(0, 1, 2))(x, w, b)
+    gr = jax.grad(loss_r, argnums=(0, 1, 2))(x, w, b)
+    for a, c in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(c), rtol=1e-4, atol=1e-4)
+
+    # bf16 inputs: stats in f32, outputs bf16
+    xb = x.astype(jnp.bfloat16)
+    yb = layer_norm_fused(xb, w.astype(jnp.bfloat16), b.astype(jnp.bfloat16))
+    assert yb.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(yb, np.float32), np.asarray(y_r), rtol=3e-2, atol=3e-2)
