@@ -99,3 +99,31 @@ def test_predict_stack_outputs():
     ds = RandomMNIST(32)
     preds = model.predict(ds, batch_size=16, stack_outputs=True, verbose=0)
     assert preds[0].shape == (32, 10)
+
+
+def test_hapi_metrics_flow_under_accumulation():
+    """VERDICT r3: Model metrics must update when gradient accumulation is
+    on (TrainStep now returns re-interleaved per-microbatch outputs)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.jit import TrainStep
+
+    paddle.seed(0)
+    net = paddle.nn.Sequential(paddle.nn.Linear(8, 4))
+    opt = paddle.optimizer.SGD(learning_rate=0.01, parameters=net.parameters())
+    step = TrainStep(net, opt, lambda o, y: ((o - y) ** 2).mean(),
+                     accumulate_steps=2, return_outputs=True)
+    x = paddle.to_tensor(np.random.default_rng(0).standard_normal((8, 8)).astype("float32"))
+    y = paddle.to_tensor(np.zeros((8, 4), "float32"))
+    m = step(x, y)
+    assert "outputs" in m
+    out = m["outputs"].numpy()
+    assert out.shape == (8, 4)
+    # outputs correspond to the ORIGINAL batch order (strided microbatch
+    # split must be re-interleaved): compare against an accumulate_steps=1
+    # step built from identically-seeded fresh params
+    paddle.seed(0)
+    net2 = paddle.nn.Sequential(paddle.nn.Linear(8, 4))
+    opt2 = paddle.optimizer.SGD(learning_rate=0.01, parameters=net2.parameters())
+    s1 = TrainStep(net2, opt2, lambda o, y: ((o - y) ** 2).mean(), accumulate_steps=1, return_outputs=True)
+    out1 = s1(x, y)["outputs"].numpy()
+    np.testing.assert_allclose(out, out1, rtol=1e-5, atol=1e-5)

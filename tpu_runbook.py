@@ -1,0 +1,148 @@
+"""TPU verification runbook — everything blocked on hardware access, in one
+command. (Round 4: the axon tunnel dropped ~04:45 and stayed down; all CPU
+work landed, these are the on-chip steps.)
+
+    python tpu_runbook.py all        # run everything below in order
+    python tpu_runbook.py flat       # 1. flat-lane flash kernel parity + perf
+    python tpu_runbook.py step       # 2. flagship step time (flag off vs on)
+    python tpu_runbook.py decode     # 3. decode throughput row
+    python tpu_runbook.py 1p3b       # 4. BASELINE rows 4/5 single-chip
+    python tpu_runbook.py bench      # 5. bench.py headline
+
+Each section prints JSON lines; `flat` ends with a PASS/FAIL verdict for
+flipping FLAGS_flash_flat's default in framework/flags.py.
+"""
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+
+def _sync(o):
+    import jax
+
+    np.asarray(jax.device_get(jax.tree_util.tree_leaves(o)[0].reshape(-1)[0:1]))
+
+
+def check_flat():
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu.ops.flash_attention as fa
+    import paddle_tpu.ops.flash_attention_flat as ff
+
+    rng = np.random.default_rng(0)
+    ok = True
+    for (b, s, h, d, causal) in [(2, 1024, 4, 64, True), (2, 1024, 4, 64, False),
+                                 (2, 512, 8, 64, True), (1, 2048, 16, 64, True),
+                                 (2, 512, 4, 128, True), (8, 1024, 16, 64, True)]:
+        q = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.bfloat16)
+        k = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.bfloat16)
+        v = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.bfloat16)
+        g = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.bfloat16)
+        ref = jax.jit(lambda q, k, v: fa._reference_attention(q, k, v, causal))(q, k, v)
+
+        def rel(a, bb):
+            a = np.asarray(a, np.float32); bb = np.asarray(bb, np.float32)
+            return float(np.abs(a - bb).max() / (np.abs(bb).max() + 1e-6))
+
+        try:
+            out = jax.jit(lambda q, k, v: ff.flash_flat(q, k, v, causal))(q, k, v)
+            e_fwd = rel(out, ref)
+            lr = jax.jit(jax.grad(lambda q, k, v: jnp.sum(
+                (fa._reference_attention(q, k, v, causal).astype(jnp.float32) * g.astype(jnp.float32))), argnums=(0, 1, 2)))(q, k, v)
+            lf = jax.jit(jax.grad(lambda q, k, v: jnp.sum(
+                (ff.flash_flat(q, k, v, causal).astype(jnp.float32) * g.astype(jnp.float32))), argnums=(0, 1, 2)))(q, k, v)
+            e_bwd = max(rel(a, bb) for a, bb in zip(lf, lr))
+            qkv = jnp.stack([q, k, v], axis=2)
+            pk = jax.jit(lambda x: ff.flash_packed(x, causal))(qkv)
+            e_pk = rel(pk, ref)
+            good = max(e_fwd, e_bwd, e_pk) < 4e-2
+        except Exception as exc:  # compile failure etc.
+            print(json.dumps({"shape": [b, s, h, d, causal], "error": str(exc)[:200]}))
+            good = False
+            e_fwd = e_bwd = e_pk = -1
+        ok &= good
+        print(json.dumps({"shape": [b, s, h, d, causal], "fwd_err": e_fwd,
+                          "bwd_err": e_bwd, "packed_err": e_pk, "ok": good}))
+    print(json.dumps({"flat_kernels": "PASS — flip FLAGS_flash_flat default to True" if ok
+                      else "FAIL — keep FLAGS_flash_flat off"}))
+    return ok
+
+
+def _step_time(flat: bool, iters=15):
+    import paddle_tpu as paddle
+    from paddle_tpu.framework.flags import _REGISTRY
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.models.gpt import GPTConfig, GPTForPretraining, GPTPretrainingCriterion
+
+    _REGISTRY["FLAGS_flash_flat"] = flat
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=50304, hidden_size=1024, num_layers=16, num_heads=16, max_seq_len=1024)
+    model = GPTForPretraining(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4, parameters=model.parameters())
+    step = TrainStep(model, opt, GPTPretrainingCriterion(), amp_level="O2")
+    ids = np.random.default_rng(0).integers(0, cfg.vocab_size, (8, 1024)).astype("int32")
+    t = paddle.to_tensor(ids)
+    for _ in range(3):
+        out = step(t, t)
+    float(out["loss"])
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = step(t, t)
+    float(out["loss"])
+    dt = (time.perf_counter() - t0) / iters
+    return dt, 8 * 1024 / dt
+
+
+def check_step():
+    for flat in (False, True):
+        dt, tps = _step_time(flat)
+        print(json.dumps({"flagship_step": {"flash_flat": flat,
+                                            "step_ms": round(dt * 1000, 1),
+                                            "tok_per_s_chip": round(tps)}}))
+
+
+def check_decode():
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPTConfig, GPTForPretraining
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=50304, hidden_size=1024, num_layers=16, num_heads=16, max_seq_len=1024)
+    m = GPTForPretraining(cfg)
+    m.astype("bfloat16")
+    ids = np.random.default_rng(0).integers(0, cfg.vocab_size, (32, 128)).astype("int32")
+    t = paddle.to_tensor(ids)
+    out = m.generate(t, max_new_tokens=384)  # compile
+    _ = np.asarray(out.numpy())
+    t0 = time.perf_counter()
+    out = m.generate(t, max_new_tokens=384)
+    _ = np.asarray(out.numpy())
+    dt = time.perf_counter() - t0
+    print(json.dumps({"decode": {"batch": 32, "new_tokens": 384, "dtype": "bf16",
+                                 "decode_tok_per_s": round(32 * 384 / dt)}}))
+
+
+def main():
+    mode = sys.argv[1] if len(sys.argv) > 1 else "all"
+    if mode in ("flat", "all"):
+        check_flat()
+    if mode in ("step", "all"):
+        check_step()
+    if mode in ("decode", "all"):
+        check_decode()
+    if mode in ("1p3b", "all"):
+        for m in ("tpu", "tpu-ernie"):
+            r = subprocess.run([sys.executable, "bench_1p3b.py", m], capture_output=True, text=True, timeout=1800)
+            print(r.stdout.strip().splitlines()[-1] if r.stdout.strip() else json.dumps({"error": r.stderr[-300:]}))
+    if mode in ("bench", "all"):
+        r = subprocess.run([sys.executable, "bench.py"], capture_output=True, text=True, timeout=900)
+        print(r.stdout.strip().splitlines()[-1] if r.stdout.strip() else json.dumps({"error": r.stderr[-300:]}))
+
+
+if __name__ == "__main__":
+    main()
