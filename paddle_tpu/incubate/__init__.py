@@ -64,3 +64,35 @@ class autotune:
         kern = config.get("kernel", {})
         if "enable" in kern:
             set_flags({"FLAGS_use_flash_attention": bool(kern["enable"])})
+
+
+class _PrimState:
+    """incubate.autograd prim-op switches (reference
+    python/paddle/incubate/autograd/primx.py + enable_prim). Under JAX every
+    op already lowers to differentiable primitives and composes with
+    forward-/reverse-mode (jvp/vjp/jacobian/hessian in
+    paddle_tpu.autograd.functional), so the switch records intent only."""
+
+    enabled = False
+
+
+def enable_prim():
+    _PrimState.enabled = True
+
+
+def disable_prim():
+    _PrimState.enabled = False
+
+
+def prim_enabled():
+    return _PrimState.enabled
+
+
+def forward_grad(outputs, inputs, grad_inputs=None):
+    """Forward-mode AD (reference incubate/autograd/primapi.py forward_grad):
+    jvp of the graph from ``inputs`` to ``outputs``."""
+    from ..autograd import jvp as _jvp
+
+    raise NotImplementedError(
+        "use paddle_tpu.autograd.jvp(func, xs, v) — forward-mode requires "
+        "the function form (JAX traces functions, not taped graphs)")
