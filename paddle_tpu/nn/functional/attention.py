@@ -31,6 +31,25 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
         if flash_attention_available(tuple(q.shape), tuple(k.shape)):
             return op(lambda qq, kk, vv: flash_attention(qq, kk, vv, causal=is_causal), q, k, v, _name="flash_attention")
 
+    # masked / GQA envelope: additive [b|1, 1, s, s] masks (bool masks become
+    # 0/-1e30) and h_kv | h grouped KV run through the flat-lane kernels when
+    # FLAGS_flash_flat is on (reference fused_attention_op.cu attn_mask path)
+    if flag("FLAGS_use_flash_attention") and dropout_p == 0.0 and attn_mask is not None:
+        from ...ops import flash_attention_flat as _flat
+
+        b, s, h, d = q.shape
+        m = ensure_tensor(attn_mask)
+        kv_ok = tuple(k.shape) == tuple(q.shape) or (
+            k.shape[0] == b and k.shape[1] == s and h % k.shape[2] == 0 and k.shape[3] == d)
+        if (_flat.enabled((b, s, 3, h, d)) and kv_ok
+                and _flat.mask_supported(b, s, h, d, tuple(m.shape))):
+            def fn(qq, kk, vv, mm):
+                if mm.dtype == jnp.bool_:
+                    mm = jnp.where(mm, 0.0, -1e30).astype(jnp.float32)
+                return _flat.flash_flat_gqa(qq, kk, vv, causal=is_causal, mask=mm)
+
+            return op(fn, q, k, v, m, _name="flash_attention")
+
     dropping = dropout_p > 0.0 and training
     aux = [ensure_tensor(attn_mask)] if attn_mask is not None else []
     if dropping:

@@ -76,8 +76,13 @@ _TN = ((0,), (0,))
 # -- kernels ----------------------------------------------------------------
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, block_k, seq_len, scale, hg, d):
+def _fwd_kernel(q_ref, k_ref, v_ref, *rest, causal, block_k, seq_len, scale, hg, d, has_bias):
     from jax.experimental import pallas as pl
+
+    if has_bias:
+        bias_ref, o_ref, lse_ref = rest  # bias [block_q, seq] additive, finite
+    else:
+        (o_ref, lse_ref), bias_ref = rest, None
 
     qi = pl.program_id(2)
     block_q = q_ref.shape[0]
@@ -95,6 +100,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, block_k, seq_len
             kt = k_ref[pl.dslice(kb * block_k, block_k), c0:c0 + d]
             vt = v_ref[pl.dslice(kb * block_k, block_k), c0:c0 + d]
             s = _dot(q, kt, _NT)  # scale pre-applied via q
+            if has_bias:
+                s = s + bias_ref[:, pl.dslice(kb * block_k, block_k)].astype(jnp.float32)
             if masked:
                 qp = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
                 kp = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
@@ -120,9 +127,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, block_k, seq_len
     lse_ref[...] = sum(lse_cols)
 
 
-def _bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref, dq_ref, dk_ref, dv_ref,
-                *, causal, block_q, block_k, seq_len, scale, hg, d):
+def _bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref, *refs,
+                causal, block_q, block_k, seq_len, scale, hg, d, has_bias):
     from jax.experimental import pallas as pl
+
+    if has_bias:
+        bias_ref, dq_ref, dk_ref, dv_ref = refs  # bias [seq, block_k]
+    else:
+        (dq_ref, dk_ref, dv_ref), bias_ref = refs, None
 
     ki = pl.program_id(2)
     nq = seq_len // block_q
@@ -143,6 +155,8 @@ def _bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref, dq_ref, dk_ref, dv
             lse = jnp.sum(lse_ref[sl, :] * onehot, axis=1, keepdims=True)
             di = jnp.sum(di_ref[sl, :] * onehot, axis=1, keepdims=True)
             s = _dot(qt, k, _NT)  # scale pre-applied via qt
+            if has_bias:
+                s = s + bias_ref[sl, :].astype(jnp.float32)
             p = jnp.exp(s - lse)
             if masked:
                 qp = qb * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
@@ -200,9 +214,17 @@ def _fwd_call(operands, b, s, h, d, dtype, causal, packed):
             pl.BlockSpec((None, s, hd), lambda bi, gi, qi: (bi, 0, gi)),
         ]
 
+    bias = None
+    if len(operands) > (1 if packed else 3):
+        *operands, bias = operands
+        operands = tuple(operands)
+        # additive bias [b, 1, s, s] (broadcast over heads); rows for this
+        # q-block resident in VMEM
+        in_specs.append(pl.BlockSpec((None, None, block_q, s), lambda bi, gi, qi: (bi, 0, qi, 0)))
+
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, causal=causal, block_k=block_k, seq_len=s,
-                          scale=scale, hg=hg, d=d),
+                          scale=scale, hg=hg, d=d, has_bias=bias is not None),
         grid=(b, G, s // block_q),
         in_specs=in_specs,
         out_specs=[
@@ -213,7 +235,7 @@ def _fwd_call(operands, b, s, h, d, dtype, causal, packed):
             jax.ShapeDtypeStruct((b, s, h * d), dtype),
             jax.ShapeDtypeStruct((b, G, s, hg), jnp.float32),
         ],
-    )(*operands)
+    )(*operands, *( [bias] if bias is not None else [] ))
     return out, lse
 
 
@@ -248,15 +270,26 @@ def _bwd_call(operands, b, s, h, d, dtype, o, lse, do, causal, packed):
             pl.BlockSpec((None, block_k, hd), blkH),
         ]
 
+    bias = None
+    if len(operands) > (1 if packed else 3):
+        *operands, bias = operands
+        operands = tuple(operands)
+    extra_specs = [
+        pl.BlockSpec((None, s, hd), fullH),           # do
+        pl.BlockSpec((None, None, s, hg), stat),      # lse
+        pl.BlockSpec((None, None, s, hg), stat),      # di
+    ]
+    extra_ops = [do, lse, di]
+    if bias is not None:
+        # bias columns for this k-block, all q rows resident
+        extra_specs.append(pl.BlockSpec((None, None, s, block_k), lambda bi, gi, ki: (bi, 0, 0, ki)))
+        extra_ops.append(bias)
+
     dq, dk, dv = pl.pallas_call(
         functools.partial(_bwd_kernel, causal=causal, block_q=block_q, block_k=block_k,
-                          seq_len=s, scale=scale, hg=hg, d=d),
+                          seq_len=s, scale=scale, hg=hg, d=d, has_bias=bias is not None),
         grid=(b, G, s // block_k),
-        in_specs=qkv_specs + [
-            pl.BlockSpec((None, s, hd), fullH),           # do
-            pl.BlockSpec((None, None, s, hg), stat),      # lse
-            pl.BlockSpec((None, None, s, hg), stat),      # di
-        ],
+        in_specs=qkv_specs + extra_specs,
         out_specs=[
             pl.BlockSpec((None, s, hd), fullH),           # dq (f32 accumulator)
             pl.BlockSpec((None, block_k, hd), blkH),
@@ -267,7 +300,7 @@ def _bwd_call(operands, b, s, h, d, dtype, o, lse, do, causal, packed):
             jax.ShapeDtypeStruct((b, s, h * d), dtype),
             jax.ShapeDtypeStruct((b, s, h * d), dtype),
         ],
-    )(*operands, do, lse, di)
+    )(*operands, *extra_ops)
     return dq.astype(dtype), dk, dv
 
 
@@ -340,3 +373,72 @@ def flash_flat(q, k, v, causal=False):
     out = _flat(q.reshape(b, s, h * d), k.reshape(b, s, h * d), v.reshape(b, s, h * d),
                 (h, d), causal)
     return out.reshape(b, s, h, d)
+
+
+# -- masked / GQA envelope (reference fused_attention_op.cu attn_mask path,
+#    fused_softmax_mask.cu.h) -------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _flat_masked(q, k, v, bias, hd_shape, causal):
+    b, s, _ = q.shape
+    h, d = hd_shape
+    out, _ = _fwd_call((q, k, v, bias), b, s, h, d, q.dtype, causal, packed=False)
+    return out
+
+
+def _flat_masked_fwd(q, k, v, bias, hd_shape, causal):
+    b, s, _ = q.shape
+    h, d = hd_shape
+    out, lse = _fwd_call((q, k, v, bias), b, s, h, d, q.dtype, causal, packed=False)
+    return out, (q, k, v, bias, out, lse)
+
+
+def _flat_masked_bwd(hd_shape, causal, res, g):
+    q, k, v, bias, o, lse = res
+    b, s, _ = q.shape
+    h, d = hd_shape
+    dq, dk, dv = _bwd_call((q, k, v, bias), b, s, h, d, q.dtype, o, lse, g, causal, packed=False)
+    return dq, dk, dv, jnp.zeros_like(bias)  # masks are non-trainable inputs
+
+
+_flat_masked.defvjp(_flat_masked_fwd, _flat_masked_bwd)
+
+
+def mask_supported(b, s, h, d, mask_shape) -> bool:
+    """Additive [b|1, 1, s, s] masks with FINITE entries (use -1e30, not
+    -inf); full-row mask residency bounds s."""
+    if s > 1024:
+        return False
+    ms = tuple(mask_shape)
+    return len(ms) == 4 and ms[1] == 1 and ms[2] == s and ms[3] == s and ms[0] in (1, b)
+
+
+def flash_flat_masked(q, k, v, mask, causal=False):
+    """Masked attention through the flat kernels. ``mask``: additive bias
+    [b|1, 1, s, s] (bool masks must be converted to 0/-1e30 by the caller).
+    Grads flow to q/k/v; the mask gets zeros (non-trainable)."""
+    b, s, h, d = q.shape
+    if mask.shape[0] == 1 and b > 1:
+        mask = jnp.broadcast_to(mask, (b,) + mask.shape[1:])
+    out = _flat_masked(q.reshape(b, s, h * d), k.reshape(b, s, h * d),
+                       v.reshape(b, s, h * d), mask, (h, d), causal)
+    return out.reshape(b, s, h, d)
+
+
+def flash_flat_gqa(q, k, v, causal=False, mask=None):
+    """Grouped/multi-query attention: k/v have h_kv heads with h % h_kv == 0.
+    KV heads are expanded to the query head count before the kernel (one
+    bandwidth-bound repeat; the kernels then run the standard path) — the
+    envelope contract of the reference's GQA-capable fused attention."""
+    b, s, h, d = q.shape
+    h_kv = k.shape[2]
+    if h % h_kv != 0:
+        raise ValueError(f"GQA needs h_kv | h; got h={h}, h_kv={h_kv}")
+    r = h // h_kv
+    if r > 1:
+        k = jnp.repeat(k, r, axis=2)
+        v = jnp.repeat(v, r, axis=2)
+    if mask is not None:
+        return flash_flat_masked(q, k, v, mask, causal)
+    return flash_flat(q, k, v, causal)

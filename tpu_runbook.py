@@ -69,6 +69,42 @@ def check_flat():
         ok &= good
         print(json.dumps({"shape": [b, s, h, d, causal], "fwd_err": e_fwd,
                           "bwd_err": e_bwd, "packed_err": e_pk, "ok": good}))
+    # masked + GQA envelope
+    for (b, s, h, d, h_kv, causal) in [(2, 512, 8, 64, 8, False), (2, 512, 8, 64, 2, False),
+                                       (2, 1024, 8, 64, 8, True)]:
+        q = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.bfloat16)
+        k = jnp.asarray(rng.standard_normal((b, s, h_kv, d)), jnp.bfloat16)
+        v = jnp.asarray(rng.standard_normal((b, s, h_kv, d)), jnp.bfloat16)
+        # padding mask: last quarter of keys masked off
+        mask = jnp.where(jnp.arange(s) < 3 * s // 4, 0.0, -1e30).astype(jnp.float32)
+        mask = jnp.broadcast_to(mask, (b, 1, s, s))
+        kr = jnp.repeat(k, h // h_kv, axis=2)
+        vr = jnp.repeat(v, h // h_kv, axis=2)
+
+        def ref_f(q, kr, vr):
+            qh, kh, vh = (jnp.swapaxes(t, 1, 2).astype(jnp.float32) for t in (q, kr, vr))
+            lg = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) / (d ** 0.5) + mask
+            if causal:
+                cm = jnp.tril(jnp.ones((s, s), bool))
+                lg = jnp.where(cm, lg, -1e30)
+            import jax.nn
+
+            return jnp.swapaxes(jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(lg, -1), vh), 1, 2)
+
+        try:
+            import paddle_tpu.ops.flash_attention_flat as ffm
+
+            ref = jax.jit(ref_f)(q, kr, vr)
+            got = jax.jit(lambda q, k, v: ffm.flash_flat_gqa(q, k, v, causal=causal, mask=mask))(q, k, v)
+            err = float(np.abs(np.asarray(got, np.float32) - np.asarray(ref, np.float32)).max()
+                        / (np.abs(np.asarray(ref, np.float32)).max() + 1e-6))
+            good = err < 4e-2
+        except Exception as exc:
+            print(json.dumps({"masked_shape": [b, s, h, d, h_kv, causal], "error": str(exc)[:200]}))
+            good, err = False, -1
+        ok &= good
+        print(json.dumps({"masked_shape": [b, s, h, d, h_kv, causal], "err": err, "ok": good}))
+
     print(json.dumps({"flat_kernels": "PASS — flip FLAGS_flash_flat default to True" if ok
                       else "FAIL — keep FLAGS_flash_flat off"}))
     return ok
