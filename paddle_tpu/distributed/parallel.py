@@ -207,8 +207,68 @@ class DataParallel(Layer):
 
 
 def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
-    """Parity: paddle.distributed.spawn. Single-controller JAX drives all
-    local devices from one process, so spawn degenerates to a direct call."""
+    """Parity: paddle.distributed.spawn (python/paddle/distributed/spawn.py).
+
+    On TPU the canonical layout is one process per HOST driving all local
+    chips (single controller), so ``nprocs=-1`` or 1 is a direct call. An
+    explicit ``nprocs > 1`` genuinely forks: each worker is a spawned
+    process with the PADDLE_* rendezvous env (master port from
+    ``options['master']`` or an ephemeral one) — the multi-host path used
+    by the eager DataParallel tests, for CPU-backed multi-process runs.
+    Returns the context object with ``.join()`` like the reference.
+    """
+    if nprocs in (-1, 0, 1):
+        func(*args)
+        return None
+
+    import multiprocessing as mp
+    import socket
+
+    master = options.get("master")
+    if master is None:
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        master = f"127.0.0.1:{s.getsockname()[1]}"
+        s.close()
+
+    ctx = mp.get_context("spawn")
+    procs = []
+    for rank in range(nprocs):
+        p = ctx.Process(target=_spawn_worker, args=(func, args, rank, nprocs, master),
+                        daemon=daemon)
+        p.start()
+        procs.append(p)
+
+    class _Ctx:
+        processes = procs
+
+        @staticmethod
+        def join(timeout=None):
+            rc = 0
+            for p in procs:
+                p.join(timeout)
+                if p.exitcode:
+                    rc = p.exitcode
+            if rc:
+                raise RuntimeError(f"spawn: a worker exited with code {rc}")
+            return True
+
+    if join:
+        _Ctx.join()
+        return None
+    return _Ctx()
+
+
+def _spawn_worker(func, args, rank, world, master):
+    import os
+
+    os.environ.update({
+        "PADDLE_TRAINER_ID": str(rank),
+        "PADDLE_TRAINERS_NUM": str(world),
+        "PADDLE_MASTER": master,
+        "PADDLE_LOCAL_RANK": str(rank),
+        "JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS", "cpu"),
+    })
     func(*args)
 
 

@@ -153,3 +153,34 @@ def test_bucket_reducer_plan_and_unused_param_error():
         r.reduce(find_unused_parameters=False)
     # permissive mode runs (world=1 mesh: pmean over a single process)
     r.reduce(find_unused_parameters=True)
+
+
+SPAWN_HELPER = """
+import os, sys
+sys.path.insert(0, {repo!r})
+"""
+
+
+def _spawn_target(out_dir):
+    # runs in a spawned subprocess: record rank/world from the env
+    import os
+
+    rank = os.environ["PADDLE_TRAINER_ID"]
+    world = os.environ["PADDLE_TRAINERS_NUM"]
+    open(os.path.join(out_dir, f"rank{rank}"), "w").write(world)
+
+
+def test_spawn_multiprocess():
+    import tempfile
+
+    from paddle_tpu.distributed.parallel import spawn
+
+    with tempfile.TemporaryDirectory() as d:
+        spawn(_spawn_target, args=(d,), nprocs=2, join=True)
+        assert open(os.path.join(d, "rank0")).read() == "2"
+        assert open(os.path.join(d, "rank1")).read() == "2"
+
+    # nprocs=-1 is a direct call (single-controller canonical path)
+    hit = []
+    spawn(lambda: hit.append(1), nprocs=-1)
+    assert hit == [1]
