@@ -41,7 +41,7 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
         m = ensure_tensor(attn_mask)
         kv_ok = tuple(k.shape) == tuple(q.shape) or (
             k.shape[0] == b and k.shape[1] == s and h % k.shape[2] == 0 and k.shape[3] == d)
-        if (_flat.enabled((b, s, 3, h, d)) and kv_ok
+        if (_flat.enabled((b, s, 3, h, d), packed=False) and kv_ok
                 and _flat.mask_supported(b, s, h, d, tuple(m.shape))):
             def fn(qq, kk, vv, mm):
                 if mm.dtype == jnp.bool_:

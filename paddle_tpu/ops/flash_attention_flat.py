@@ -8,10 +8,12 @@ output is [b, s, 3·h·d]) and every kernel call. These kernels instead read
 the projection output's layout directly:
 
 - Operands stay [b, s, H] (H = h·d) or packed [b, s, 3H]; BlockSpecs carve
-  the lane (H) dimension into head-groups of hg·d lanes (hg = 8, or all
-  heads when h < 8 or h % 8 != 0 — Mosaic requires 128-aligned or
-  full-dimension lane blocks), and the kernel statically slices each head's
-  d columns. No transposes anywhere in the attention path.
+  the lane (H) dimension into head-groups of hg·d lanes, and the kernel
+  statically slices each head's d columns. No transposes anywhere in the
+  attention path. hg is chosen by _head_group: the largest of {8,4,2,1,h}
+  dividing h whose lane block is 128-aligned (or full-dimension) AND whose
+  bwd dq accumulator (s·hg·d f32) stays within _DQ_ELEM_BUDGET — Mosaic
+  compile time blows up past that.
 - The backward is ONE fused kernel (grid over k-blocks, inner loop over
   q-blocks): s and dp computed once (5 MXU dots vs 7 for a split dq/dkv
   pair), one exp instead of two. dq accumulates in f32 in a VMEM-resident
@@ -36,16 +38,33 @@ import jax.numpy as jnp
 _BLOCK_Q = 512
 _BLOCK_K_FWD = 512
 _BLOCK_K_BWD = 256
-_MAX_SEQ = 4096
+_MAX_SEQ = 2048
+# Mosaic compile time blows up with the fused-bwd dq accumulator block
+# (full-sequence [s, hg*d] f32, read-modify-write across k-steps): 1M elements
+# did not compile in 20 min on-chip (2026-07-30); 512K compiles in seconds.
+# The head-group size adapts so s*hg*d stays within this budget.
+_DQ_ELEM_BUDGET = 512 * 1024
 
 
-def _head_group(h):
-    return h if (h < 8 or h % 8 != 0) else 8
+def _head_group(h, s, d, packed=False):
+    # Largest divisor of h whose lane block is Mosaic-legal and whose bwd dq
+    # accumulator fits the compile budget. A full-dimension (hg == h) lane
+    # block is legal without 128-alignment ONLY for separate q/k/v operands —
+    # in the packed [b, s, 3H] tensor an H-lane block sits at offsets H and 2H,
+    # so it must be 128-aligned like any other block.
+    for hg in range(min(h, 16), 0, -1):
+        if h % hg != 0:
+            continue
+        aligned = (hg * d) % 128 == 0
+        if (aligned or (hg == h and not packed)) and s * hg * d <= _DQ_ELEM_BUDGET:
+            return hg
+    return 0  # no viable grouping — enabled() rejects
 
 
-def enabled(qkv_shape=None) -> bool:
+def enabled(qkv_shape=None, packed=True) -> bool:
     """Gate for dispatch from flash_attention_qkv. On TPU backends only;
-    FLAGS_flash_flat (default on) allows forcing the classic path."""
+    FLAGS_flash_flat allows forcing the classic path. ``packed`` must match
+    the wrapper being dispatched to (flash_packed vs flash_flat*)."""
     from ..framework.flags import flag
 
     if jax.default_backend() not in ("tpu", "axon"):
@@ -57,9 +76,7 @@ def enabled(qkv_shape=None) -> bool:
         block = min(_BLOCK_Q, s)
         if not (s >= 256 and s % block == 0 and s <= _MAX_SEQ and 64 <= d <= 128 and d % 8 == 0):
             return False
-        hg = _head_group(h)
-        # VMEM residency bound for the bwd kernel (q, do bf16 + dq f32)
-        if s * hg * d * (2 + 2 + 4) > 10 * 1024 * 1024:
+        if _head_group(h, s, d, packed=packed) == 0:
             return False
     return True
 
@@ -194,7 +211,10 @@ def _bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref, *refs,
 def _fwd_call(operands, b, s, h, d, dtype, causal, packed):
     from jax.experimental import pallas as pl
 
-    hg = _head_group(h)
+    hg = _head_group(h, s, d, packed=packed)
+    if hg == 0:
+        raise ValueError(f"flat flash kernels unsupported for h={h}, s={s}, d={d} "
+                         f"(no head grouping within the compile budget); gate with enabled()")
     hd = hg * d
     G = h // hg  # column blocks per tensor
     block_q = min(_BLOCK_Q, s)
@@ -217,10 +237,12 @@ def _fwd_call(operands, b, s, h, d, dtype, causal, packed):
     bias = None
     if len(operands) > (1 if packed else 3):
         *operands, bias = operands
-        operands = tuple(operands)
         # additive bias [b, 1, s, s] (broadcast over heads); rows for this
         # q-block resident in VMEM
         in_specs.append(pl.BlockSpec((None, None, block_q, s), lambda bi, gi, qi: (bi, 0, qi, 0)))
+    # packed mode: the q/k/v specs are three column-block views of the SAME
+    # [b, s, 3H] tensor, so it must appear once per spec
+    operands = tuple(operands) * 3 if packed else tuple(operands)
 
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, causal=causal, block_k=block_k, seq_len=s,
@@ -242,7 +264,10 @@ def _fwd_call(operands, b, s, h, d, dtype, causal, packed):
 def _bwd_call(operands, b, s, h, d, dtype, o, lse, do, causal, packed):
     from jax.experimental import pallas as pl
 
-    hg = _head_group(h)
+    hg = _head_group(h, s, d, packed=packed)
+    if hg == 0:
+        raise ValueError(f"flat flash kernels unsupported for h={h}, s={s}, d={d} "
+                         f"(no head grouping within the compile budget); gate with enabled()")
     hd = hg * d
     G = h // hg
     block_q = min(_BLOCK_Q, s)
@@ -273,7 +298,7 @@ def _bwd_call(operands, b, s, h, d, dtype, o, lse, do, causal, packed):
     bias = None
     if len(operands) > (1 if packed else 3):
         *operands, bias = operands
-        operands = tuple(operands)
+    operands = tuple(operands) * 3 if packed else tuple(operands)
     extra_specs = [
         pl.BlockSpec((None, s, hd), fullH),           # do
         pl.BlockSpec((None, None, s, hg), stat),      # lse

@@ -38,7 +38,8 @@ def check_flat():
     rng = np.random.default_rng(0)
     ok = True
     for (b, s, h, d, causal) in [(2, 1024, 4, 64, True), (2, 1024, 4, 64, False),
-                                 (2, 512, 8, 64, True), (1, 2048, 16, 64, True),
+                                 (2, 512, 8, 64, True), (2, 1024, 16, 128, True),
+                                 (1, 2048, 16, 64, True),
                                  (2, 512, 4, 128, True), (8, 1024, 16, 64, True)]:
         q = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.bfloat16)
         k = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.bfloat16)
