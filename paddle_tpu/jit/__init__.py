@@ -283,23 +283,33 @@ class EvalStep:
 def to_static(function=None, input_spec=None, full_graph=True, **kwargs):
     """Decorator compiling a Tensor-level function/Layer method with jax.jit.
 
-    Parity: @paddle.jit.to_static — but no AST transpile: python control flow
-    must already be trace-friendly, which is the XLA contract the reference's
-    transpiler (dygraph_to_static/program_translator.py:239) worked around.
-    Data-dependent branches/loops have first-class bridges:
-    ``paddle.static.nn.cond(pred, true_fn, false_fn)``,
-    ``paddle.static.nn.while_loop(cond, body, loop_vars)`` and
-    ``paddle.static.nn.switch_case`` — these compile to lax.cond /
-    lax.while_loop / lax.switch and work in eager, to_static and static
-    programs alike. A raw Python ``if tensor:`` under tracing raises JAX's
-    TracerBoolConversionError pointing here.
+    Parity: @paddle.jit.to_static including a minimal AST transpile
+    (dygraph_to_static/program_translator.py:239): Python ``if``/``while``/
+    ``for _ in range(...)`` and ``and``/``or``/``not`` are rewritten to
+    runtime dispatchers that execute natively for concrete values and compile
+    to lax.cond / lax.while_loop for traced ones (see jit/dy2static.py for
+    the supported envelope). Unsupported shapes (returns inside branches,
+    tuple-target loops, …) keep their Python semantics; a tensor-dependent
+    condition there raises JAX's TracerBoolConversionError. The explicit
+    bridges remain first-class: ``paddle.static.nn.cond``,
+    ``paddle.static.nn.while_loop`` and ``paddle.static.nn.switch_case``
+    work in eager, to_static and static programs alike; ``@jit.not_to_static``
+    opts a function out of rewriting.
     """
 
     def decorate(fn):
+        import types
+
         from ..nn.layer.base import Layer
+        from .dy2static import transpile
 
         if isinstance(fn, Layer):
             model = fn
+            fwd = model.forward
+            inner = getattr(fwd, "__func__", fwd)
+            rewritten = transpile(inner)
+            if rewritten is not inner:
+                model.forward = types.MethodType(rewritten, model)
 
             @functools.partial(jax.jit, static_argnums=(3,))
             def _fwd(params, buffers, args, training, rng):
@@ -318,6 +328,8 @@ def to_static(function=None, input_spec=None, full_graph=True, **kwargs):
 
             wrapper.__wrapped_layer__ = model
             return wrapper
+
+        fn = transpile(fn)
 
         @functools.partial(jax.jit)
         def _pure(args):
@@ -423,4 +435,5 @@ def load(path, **configs):
 
 
 from ..static import InputSpec  # noqa: E402 — one class for jit AND static
+from .dy2static import not_to_static  # noqa: E402 — opt-out marker
 # (reference: paddle.static.InputSpec is the single spec type both use)

@@ -1,0 +1,603 @@
+"""Dygraph-to-static AST transpiler: Python control flow over traced values.
+
+Reference: the @to_static AST transpiler
+(python/paddle/fluid/dygraph/dygraph_to_static/program_translator.py:239 and
+the 25 per-construct transformers in dygraph_to_static/ — ifelse_transformer,
+loop_transformer, logical_transformer). This is the TPU-native minimal core:
+instead of rewriting to fluid ConditionalBlock/While ops, rewritten control
+flow dispatches at RUNTIME between plain Python execution (concrete
+condition — exact Python semantics, zero overhead beyond a call) and the
+XLA-native bridges ``static.nn.cond`` / ``static.nn.while_loop`` (traced
+condition — compiles to lax.cond / lax.while_loop).
+
+Supported rewrites:
+- ``if``/``elif``/``else`` whose branches assign variables (no
+  return/break/continue inside the branch),
+- ``while`` loops (loop-carried variables inferred from branch stores),
+- ``for <name> in range(...)`` — runtime dispatch between a native Python
+  loop (concrete bounds: trace-unrolled, exact semantics) and a
+  while-loop form (traced bounds),
+- ``and`` / ``or`` / ``not`` over tensors (Python short-circuit semantics
+  are preserved for concrete values via lambdas).
+
+Anything else (returns inside branches, tuple-target for loops, try/except,
+…) is left untouched: concrete-value code runs exactly as before, and a
+tensor-dependent condition in unsupported shapes raises JAX's
+TracerBoolConversionError pointing at the static.nn bridges.
+
+Transformation is best-effort: if the source is unavailable (C extensions,
+REPL, lambdas) the original function is used unchanged.
+"""
+from __future__ import annotations
+
+import ast
+import copy
+import functools
+import inspect
+import textwrap
+
+
+class _Undefined:
+    """Sentinel for names not yet bound when a rewritten block runs
+    (reference: dygraph_to_static UndefinedVar)."""
+
+    _inst = None
+
+    def __new__(cls):
+        if cls._inst is None:
+            cls._inst = super().__new__(cls)
+        return cls._inst
+
+    def __repr__(self):
+        return "<undefined local (dy2static)>"
+
+    def _raise(self, *a, **k):
+        raise NameError(
+            "a local variable set in only one branch of rewritten control "
+            "flow is referenced before assignment (dy2static); initialize "
+            "it before the if/while statement")
+
+    # any use of a variable left unbound by the taken branch fails loudly,
+    # mirroring Python's UnboundLocalError-on-read (NameError subclass)
+    __bool__ = __call__ = __iter__ = __len__ = __getattr__ = _raise
+    __add__ = __radd__ = __sub__ = __rsub__ = __mul__ = __rmul__ = _raise
+    __truediv__ = __rtruediv__ = __getitem__ = __neg__ = __abs__ = _raise
+    __lt__ = __le__ = __gt__ = __ge__ = __index__ = __float__ = __int__ = _raise
+
+
+UNDEF = _Undefined()
+
+
+def ld(f):
+    """Best-effort read of an enclosing local: UNDEF when unbound."""
+    try:
+        return f()
+    except NameError:  # includes UnboundLocalError (free-var unbound)
+        return UNDEF
+
+
+def _is_traced(v):
+    from ..framework.core import Tensor
+    from ..framework.static_trace import is_symbolic
+
+    if not isinstance(v, Tensor):
+        return False
+    if is_symbolic(v._value):
+        return True
+    try:
+        import jax.core
+
+        return isinstance(v._value, jax.core.Tracer)
+    except Exception:
+        return False
+
+
+def _concrete_bool(v):
+    """bool(v) for anything concrete; None when v is traced."""
+    if _is_traced(v):
+        return None
+    return bool(v)
+
+
+def _check_defined(vals, names, what):
+    for v, n in zip(vals, names):
+        if v is UNDEF:
+            raise NameError(
+                f"variable '{n}' is used in a tensor-dependent {what} but is "
+                f"not defined before it; XLA control flow needs every "
+                f"carried/merged variable initialized up front")
+
+
+def convert_ifelse(pred, true_fn, false_fn, names):
+    b = _concrete_bool(pred)
+    if b is not None:
+        return true_fn() if b else false_fn()
+    from ..static import nn as _snn
+    from ..tensor._helpers import ensure_tensor
+
+    def _wrap(fn):
+        def run():
+            out = fn()
+            _check_defined(out, names, "if")
+            return tuple(ensure_tensor(o) for o in out)
+
+        return run
+
+    out = _snn.cond(pred, _wrap(true_fn), _wrap(false_fn))
+    return out if isinstance(out, tuple) else (out,)
+
+
+def convert_while(cond_fn, body_fn, init, names):
+    b = _concrete_bool(cond_fn(*init))
+    if b is not None:
+        vals = tuple(init)
+        while b:
+            vals = tuple(body_fn(*vals))
+            b = _concrete_bool(cond_fn(*vals))
+            if b is None:
+                raise TypeError(
+                    "while condition became a traced tensor mid-loop; a "
+                    "tensor-dependent while must start from tensor loop vars "
+                    "(static.nn.while_loop)")
+        return vals
+    from ..static import nn as _snn
+    from ..tensor._helpers import ensure_tensor
+
+    _check_defined(init, names, "while loop")
+    out = _snn.while_loop(lambda *vs: cond_fn(*vs), lambda *vs: tuple(body_fn(*vs)),
+                          [ensure_tensor(v) for v in init])
+    return tuple(out)
+
+
+def and_(f1, f2):
+    v = f1()
+    b = _concrete_bool(v)
+    if b is not None:
+        return f2() if b else v  # exact Python `and` semantics
+    from ..tensor import logical_and
+    from ..tensor._helpers import ensure_tensor
+
+    return logical_and(ensure_tensor(v).astype("bool"), ensure_tensor(f2()).astype("bool"))
+
+
+def or_(f1, f2):
+    v = f1()
+    b = _concrete_bool(v)
+    if b is not None:
+        return v if b else f2()
+    from ..tensor import logical_or
+    from ..tensor._helpers import ensure_tensor
+
+    return logical_or(ensure_tensor(v).astype("bool"), ensure_tensor(f2()).astype("bool"))
+
+
+def not_(v):
+    b = _concrete_bool(v)
+    if b is not None:
+        return not b
+    from ..tensor import logical_not
+    from ..tensor._helpers import ensure_tensor
+
+    return logical_not(ensure_tensor(v).astype("bool"))
+
+
+def maybe_range(*args):
+    """('py', range(...)) when all bounds are concrete ints, else
+    ('t', (start, stop, step)) with traced bounds."""
+    if not any(_is_traced(a) for a in args):
+        return ("py", range(*(int(a) for a in args)))
+    if len(args) == 1:
+        start, stop, step = 0, args[0], 1
+    elif len(args) == 2:
+        start, stop, step = args[0], args[1], 1
+    else:
+        start, stop, step = args
+    return ("t", (start, stop, step))
+
+
+def is_py(r):
+    return r[0] == "py"
+
+
+def py_range(r):
+    return r[1]
+
+
+def range_start(r):
+    return r[1][0]
+
+
+def range_step(r):
+    return r[1][2]
+
+
+def range_cond(i, r):
+    _, (start, stop, step) = r
+    if isinstance(step, (int, float)):
+        return (i < stop) if step > 0 else (i > stop)
+    from ..tensor._helpers import ensure_tensor
+
+    step = ensure_tensor(step)
+    return (step > 0).logical_and(ensure_tensor(i) < stop).logical_or(
+        (step <= 0).logical_and(ensure_tensor(i) > stop))
+
+
+# ---------------------------------------------------------------------------
+# AST rewriting
+# ---------------------------------------------------------------------------
+
+_JST = "__paddle_jst__"  # module alias injected into the caller's globals
+
+
+def _stores(nodes):
+    """Names (re)bound anywhere in ``nodes`` — Name(Store) covers assign,
+    augassign, annassign, for targets, with-as, walrus."""
+    out = set()
+    for n in nodes:
+        for sub in ast.walk(n):
+            if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Store):
+                out.add(sub.id)
+            elif isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                out.add(sub.name)
+    # generated helpers from inner rewrites are block-local, never carried
+    return {n for n in out if not n.startswith("__jst_")}
+
+
+class _EscapeScan(ast.NodeVisitor):
+    """Detects constructs a rewritten block can't contain: return/yield
+    anywhere, break/continue belonging to THIS level (not a nested loop),
+    and scope/effect statements we refuse to relocate."""
+
+    def __init__(self):
+        self.found = False
+
+    def generic_visit(self, node):
+        if self.found:
+            return
+        super().generic_visit(node)
+
+    def visit_Return(self, node):
+        self.found = True
+
+    def visit_Yield(self, node):
+        self.found = True
+
+    def visit_YieldFrom(self, node):
+        self.found = True
+
+    def visit_Break(self, node):
+        self.found = True
+
+    def visit_Continue(self, node):
+        self.found = True
+
+    def visit_Global(self, node):
+        self.found = True
+
+    def visit_Nonlocal(self, node):
+        self.found = True
+
+    def visit_Import(self, node):
+        self.found = True
+
+    def visit_ImportFrom(self, node):
+        self.found = True
+
+    def visit_Delete(self, node):
+        self.found = True
+
+    # subscript/attribute stores are in-place mutation: correct when executed
+    # natively, silently wrong when traced into a lax sub-block — refuse.
+    def visit_Subscript(self, node):
+        if isinstance(node.ctx, ast.Store):
+            self.found = True
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node):
+        if isinstance(node.ctx, ast.Store):
+            self.found = True
+        self.generic_visit(node)
+
+    # break/continue inside a nested loop belong to that loop; returns/yields
+    # still escape, so keep walking loop bodies but clear break/continue
+    # significance by handling loops with a child scanner.
+    def visit_For(self, node):
+        self._nested_loop(node)
+
+    def visit_While(self, node):
+        self._nested_loop(node)
+
+    def _nested_loop(self, node):
+        for sub in ast.walk(node):
+            if isinstance(sub, (ast.Return, ast.Yield, ast.YieldFrom,
+                                ast.Global, ast.Nonlocal, ast.Import,
+                                ast.ImportFrom, ast.Delete)):
+                self.found = True
+                return
+            if (isinstance(sub, (ast.Subscript, ast.Attribute))
+                    and isinstance(sub.ctx, ast.Store)):
+                self.found = True
+                return
+
+    # nested function/class bodies are separate scopes: return/yield inside
+    # them is fine; don't descend.
+    def visit_FunctionDef(self, node):
+        pass
+
+    def visit_AsyncFunctionDef(self, node):
+        pass
+
+    def visit_ClassDef(self, node):
+        pass
+
+    def visit_Lambda(self, node):
+        pass
+
+
+def _escapes(nodes):
+    s = _EscapeScan()
+    for n in nodes:
+        s.visit(n)
+        if s.found:
+            return True
+    return False
+
+
+def _name(id_, ctx=None):
+    return ast.Name(id=id_, ctx=ctx or ast.Load())
+
+
+def _jst_attr(attr):
+    return ast.Attribute(value=_name(_JST), attr=attr, ctx=ast.Load())
+
+
+def _jst_call(attr, args):
+    return ast.Call(func=_jst_attr(attr), args=args, keywords=[])
+
+
+def _ld_prologue(names):
+    """``n = _jst.ld(lambda: n)`` for each name — normalizes unbound locals
+    to UNDEF so they can be passed into rewritten blocks."""
+    stmts = []
+    for n in names:
+        lam = ast.Lambda(
+            args=ast.arguments(posonlyargs=[], args=[], vararg=None, kwonlyargs=[],
+                               kw_defaults=[], kwarg=None, defaults=[]),
+            body=_name(n))
+        stmts.append(ast.Assign(targets=[_name(n, ast.Store())],
+                                value=_jst_call("ld", [lam])))
+    return stmts
+
+
+def _tuple_of(names, ctx=None):
+    return ast.Tuple(elts=[_name(n, ctx or ast.Load()) for n in names], ctx=ctx or ast.Load())
+
+
+class _Transformer(ast.NodeTransformer):
+    def __init__(self):
+        self.n = 0
+        self.changed = False
+
+    def _uid(self):
+        self.n += 1
+        return self.n
+
+    # -- boolean operators ---------------------------------------------------
+
+    def visit_BoolOp(self, node):
+        self.generic_visit(node)
+        # a walrus inside an operand would rebind in the lambda's scope only
+        if any(isinstance(s, ast.NamedExpr) for v in node.values for s in ast.walk(v)):
+            return node
+        self.changed = True
+        fn = "and_" if isinstance(node.op, ast.And) else "or_"
+        out = node.values[-1]
+        for v in reversed(node.values[:-1]):
+            thunk = lambda body: ast.Lambda(
+                args=ast.arguments(posonlyargs=[], args=[], vararg=None, kwonlyargs=[],
+                                   kw_defaults=[], kwarg=None, defaults=[]),
+                body=body)
+            out = _jst_call(fn, [thunk(v), thunk(out)])
+        return ast.copy_location(out, node)
+
+    def visit_UnaryOp(self, node):
+        self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            self.changed = True
+            return ast.copy_location(_jst_call("not_", [node.operand]), node)
+        return node
+
+    # -- if / elif / else ----------------------------------------------------
+
+    def visit_If(self, node):
+        self.generic_visit(node)
+        if getattr(node, "_jst_skip", False):
+            return node
+        outs = sorted(_stores(node.body) | _stores(node.orelse))
+        if not outs or _escapes(node.body) or _escapes(node.orelse):
+            return node
+        uid = self._uid()
+        tname, fname = f"__jst_true_{uid}", f"__jst_false_{uid}"
+
+        def branch(fname_, body):
+            args = ast.arguments(
+                posonlyargs=[],
+                args=[ast.arg(arg=n) for n in outs],
+                vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+                defaults=[_name(n) for n in outs])
+            ret = ast.Return(value=_tuple_of(outs))
+            return ast.FunctionDef(name=fname_, args=args,
+                                   body=list(body) + [ret], decorator_list=[])
+
+        call = _jst_call("convert_ifelse", [
+            node.test, _name(tname), _name(fname),
+            ast.Tuple(elts=[ast.Constant(value=n) for n in outs], ctx=ast.Load())])
+        assign = ast.Assign(targets=[_tuple_of(outs, ast.Store())], value=call)
+        stmts = (_ld_prologue(outs)
+                 + [branch(tname, node.body), branch(fname, node.orelse or [ast.Pass()]), assign])
+        for s in stmts:
+            ast.copy_location(s, node)
+            ast.fix_missing_locations(s)
+        self.changed = True
+        return stmts
+
+    # -- while ---------------------------------------------------------------
+
+    def visit_While(self, node):
+        self.generic_visit(node)
+        return self._rewrite_while(node)
+
+    def _rewrite_while(self, node):
+        if getattr(node, "_jst_skip", False) or node.orelse:
+            return node
+        loop_vars = sorted(_stores(node.body))
+        if not loop_vars or _escapes(node.body):
+            return node
+        uid = self._uid()
+        cname, bname = f"__jst_cond_{uid}", f"__jst_body_{uid}"
+        args = ast.arguments(
+            posonlyargs=[], args=[ast.arg(arg=n) for n in loop_vars],
+            vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None, defaults=[])
+        cond_fn = ast.FunctionDef(
+            name=cname, args=copy.deepcopy(args),
+            body=[ast.Return(value=node.test)], decorator_list=[])
+        body_fn = ast.FunctionDef(
+            name=bname, args=copy.deepcopy(args),
+            body=list(node.body) + [ast.Return(value=_tuple_of(loop_vars))],
+            decorator_list=[])
+        call = _jst_call("convert_while", [
+            _name(cname), _name(bname), _tuple_of(loop_vars),
+            ast.Tuple(elts=[ast.Constant(value=n) for n in loop_vars], ctx=ast.Load())])
+        assign = ast.Assign(targets=[_tuple_of(loop_vars, ast.Store())], value=call)
+        stmts = _ld_prologue(loop_vars) + [cond_fn, body_fn, assign]
+        for s in stmts:
+            ast.copy_location(s, node)
+            ast.fix_missing_locations(s)
+        self.changed = True
+        return stmts
+
+    # -- for <name> in range(...) -------------------------------------------
+
+    def visit_For(self, node):
+        self.generic_visit(node)
+        if (node.orelse or not isinstance(node.target, ast.Name)
+                or not isinstance(node.iter, ast.Call)
+                or not isinstance(node.iter.func, ast.Name)
+                or node.iter.func.id != "range"
+                or node.iter.keywords
+                or not 1 <= len(node.iter.args) <= 3
+                or any(isinstance(a, ast.Starred) for a in node.iter.args)
+                or _escapes(node.body)
+                # a body that rebinds the target diverges from for semantics
+                # in the while-form (the rebound value would be carried)
+                or node.target.id in _stores(node.body)):
+            return node
+        uid = self._uid()
+        rname = f"__jst_range_{uid}"
+        tgt = node.target.id
+        r_assign = ast.Assign(targets=[_name(rname, ast.Store())],
+                              value=_jst_call("maybe_range", list(node.iter.args)))
+        # python path: the original loop over the concrete range
+        py_loop = ast.For(target=ast.Name(id=tgt, ctx=ast.Store()),
+                          iter=_jst_call("py_range", [_name(rname)]),
+                          body=copy.deepcopy(node.body), orelse=[])
+        # traced path: while-form, rewritten through the while machinery
+        init = ast.Assign(targets=[_name(tgt, ast.Store())],
+                          value=_jst_call("range_start", [_name(rname)]))
+        step = ast.Assign(
+            targets=[_name(tgt, ast.Store())],
+            value=ast.BinOp(left=_name(tgt), op=ast.Add(),
+                            right=_jst_call("range_step", [_name(rname)])))
+        wl = ast.While(test=_jst_call("range_cond", [_name(tgt), _name(rname)]),
+                       body=copy.deepcopy(node.body) + [step], orelse=[])
+        rewritten = self._rewrite_while(wl)
+        traced_stmts = [init] + (rewritten if isinstance(rewritten, list) else [rewritten])
+        dispatch = ast.If(test=_jst_call("is_py", [_name(rname)]),
+                          body=[py_loop], orelse=traced_stmts)
+        dispatch._jst_skip = True
+        stmts = [r_assign, dispatch]
+        for s in stmts:
+            ast.copy_location(s, node)
+            ast.fix_missing_locations(s)
+        return stmts
+
+
+# ---------------------------------------------------------------------------
+# entry point
+# ---------------------------------------------------------------------------
+
+# compiled factory per code object: the expensive parse/transform/compile is
+# shared across sibling closures; each closure gets its own factory call so
+# captured cell values stay per-instance (incl. the __class__ cell zero-arg
+# super() needs).
+_FACTORY = "__jst_factory__"
+_code_cache = {}
+
+
+def _build_factory(fn):
+    src = textwrap.dedent(inspect.getsource(fn))
+    tree = ast.parse(src)
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return None
+    fdef.decorator_list = []
+    t = _Transformer()
+    t.visit(tree)
+    if not t.changed:  # nothing rewritten — keep the original function
+        return None
+    freevars = fn.__code__.co_freevars
+    factory = ast.FunctionDef(
+        name=_FACTORY,
+        args=ast.arguments(posonlyargs=[], args=[ast.arg(arg=n) for n in freevars],
+                           vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+                           defaults=[]),
+        body=[fdef, ast.Return(value=_name(fdef.name))],
+        decorator_list=[])
+    mod = ast.Module(body=[factory], type_ignores=[])
+    ast.copy_location(factory, fdef)
+    ast.fix_missing_locations(mod)
+    return compile(mod, filename=fn.__code__.co_filename or "<dy2static>", mode="exec")
+
+
+def transpile(fn):
+    """Rewrite ``fn``'s control flow; returns ``fn`` unchanged when the
+    source is unavailable, nothing is rewritable, or the rewrite fails."""
+    if getattr(fn, "_jst_not_to_static", False) or getattr(fn, "_jst_transpiled", False):
+        return fn
+    key = getattr(fn, "__code__", None)
+    if key is None:
+        return fn
+    if key in _code_cache:
+        code = _code_cache[key]
+    else:
+        try:
+            code = _build_factory(fn)
+        except (OSError, TypeError, SyntaxError, KeyError, IndentationError):
+            code = None
+        _code_cache[key] = code
+    if code is None:
+        return fn
+    try:
+        cells = [c.cell_contents for c in (fn.__closure__ or ())]
+    except ValueError:  # an empty cell (e.g. not-yet-bound recursive ref)
+        return fn
+    import sys
+
+    # the rewritten function's globals ARE fn's module globals (live lookups,
+    # recursion resolves the decorated name); only the runtime-helper alias
+    # is injected, under a collision-safe name.
+    g = fn.__globals__
+    g.setdefault(_JST, sys.modules[__name__])
+    lns = {}
+    exec(code, g, lns)
+    new = lns[_FACTORY](*cells)
+    new = functools.wraps(fn)(new)
+    new._jst_transpiled = True
+    return new
+
+
+def not_to_static(fn):
+    """Mark ``fn`` so @to_static skips AST rewriting (reference:
+    paddle.jit.not_to_static)."""
+    fn._jst_not_to_static = True
+    return fn

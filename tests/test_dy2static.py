@@ -1,0 +1,318 @@
+"""dygraph_to_static AST transpiler tests.
+
+Reference model: dygraph_to_static test dir (unittests/dygraph_to_static/) —
+same function run eagerly (ground truth, concrete Python semantics) and under
+@to_static with tensor-dependent control flow, outputs must match.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.jit.dy2static import transpile, UNDEF
+
+
+def _np(t):
+    return np.asarray(t.numpy() if hasattr(t, "numpy") else t)
+
+
+# -- direct transpile behavior (concrete values: exact Python semantics) ----
+
+
+def test_python_semantics_preserved_concrete():
+    def f(x, flag):
+        if flag > 2:
+            y = x + 1
+        else:
+            y = x - 1
+        acc = 0
+        for i in range(3):
+            acc = acc + i * y
+        n = 0
+        while n < 4:
+            n = n + 2
+        return y, acc, n
+
+    g = transpile(f)
+    assert g is not f and getattr(g, "_jst_transpiled", False)
+    for flag in (1, 5):
+        assert f(10, flag) == g(10, flag)
+
+
+def test_boolop_short_circuit_preserved():
+    calls = []
+
+    def f(a, b):
+        def side(v):
+            calls.append(v)
+            return v
+
+        return (a and side(b)) or side(a + 10)
+
+    g = transpile(f)
+    calls.clear()
+    assert g(0, 7) == 10  # `a` falsy: side(b) must NOT run
+    assert calls == [10]
+    calls.clear()
+    assert g(3, 7) == 7
+    assert calls == [7]
+
+
+def test_unsupported_shapes_left_untouched():
+    def f(x):
+        if x > 0:
+            return 1  # return in branch: not rewritten
+        return 2
+
+    g = transpile(f)
+    assert g(3) == 1 and g(-3) == 2
+
+    def h(x):
+        total = 0
+        for a, b in [(1, 2), (3, 4)]:  # tuple target: untouched
+            total += a * b + x
+        return total
+
+    assert transpile(h)(1) == h(1)
+
+
+def test_not_to_static_optout():
+    @paddle.jit.not_to_static
+    def f(x):
+        if x > 0:
+            y = 1
+        else:
+            y = 2
+        return y
+
+    assert transpile(f) is f
+
+
+def test_undef_guard():
+    def f(x):
+        if x > 0:
+            y = 1
+        return y  # noqa: F821 — defined only on one path
+
+    g = transpile(f)
+    assert g(1) == 1
+    with pytest.raises((NameError, TypeError)):
+        bool(UNDEF)
+
+
+# -- tensor-dependent control flow under @to_static -------------------------
+
+
+def test_if_tensor_pred_to_static():
+    @paddle.jit.to_static
+    def f(x):
+        if paddle.sum(x) > 0:
+            y = x * 2
+        else:
+            y = x - 5
+        return y + 1
+
+    x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    np.testing.assert_allclose(_np(f(x)), [3.0, 5.0])
+    np.testing.assert_allclose(_np(f(-x)), [-5.0, -6.0])
+
+
+def test_elif_chain_to_static():
+    @paddle.jit.to_static
+    def f(x):
+        s = paddle.sum(x)
+        if s > 10:
+            y = x * 0
+        elif s > 0:
+            y = x * 2
+        else:
+            y = x * 3
+        return y
+
+    x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    np.testing.assert_allclose(_np(f(x)), [2.0, 4.0])
+    np.testing.assert_allclose(_np(f(x * 10)), [0.0, 0.0])
+    np.testing.assert_allclose(_np(f(-x)), [-3.0, -6.0])
+
+
+def test_while_tensor_cond_to_static():
+    @paddle.jit.to_static
+    def f(x):
+        # double until the sum crosses 100
+        while paddle.sum(x) < 100:
+            x = x * 2
+        return x
+
+    x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    out = _np(f(x))
+    ref = np.array([1.0, 2.0])
+    while ref.sum() < 100:
+        ref = ref * 2
+    np.testing.assert_allclose(out, ref)
+
+
+def test_for_traced_bound_to_static():
+    @paddle.jit.to_static
+    def f(x, n):
+        acc = paddle.zeros_like(x)
+        for i in range(n):  # n is a traced int tensor
+            acc = acc + x + i
+        return acc
+
+    x = paddle.to_tensor(np.array([1.0, 1.0], np.float32))
+    n = paddle.to_tensor(np.int32(4))
+    np.testing.assert_allclose(_np(f(x, n)), [4 * 1 + 6, 4 * 1 + 6])
+
+
+def test_for_concrete_bound_still_unrolled():
+    @paddle.jit.to_static
+    def f(x):
+        acc = x
+        for _ in range(3):
+            acc = acc * 2
+        return acc
+
+    x = paddle.to_tensor(np.array([1.0], np.float32))
+    np.testing.assert_allclose(_np(f(x)), [8.0])
+
+
+def test_nested_if_in_while():
+    @paddle.jit.to_static
+    def f(x):
+        n = paddle.to_tensor(np.int32(0))
+        while n < 6:
+            if n % 2 == 0:
+                x = x + 1
+            else:
+                x = x + 10
+            n = n + 1
+        return x
+
+    x = paddle.to_tensor(np.array([0.0], np.float32))
+    np.testing.assert_allclose(_np(f(x)), [33.0])
+
+
+def test_tensor_boolop_and_not():
+    @paddle.jit.to_static
+    def f(x):
+        a = paddle.sum(x) > 0
+        b = paddle.sum(x) < 10
+        if a and b:
+            y = x + 1
+        else:
+            y = x - 1
+        if not a:
+            y = y * 2
+        return y
+
+    x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    np.testing.assert_allclose(_np(f(x)), [2.0, 3.0])
+    np.testing.assert_allclose(_np(f(-x)), [-4.0, -6.0])   # a False: (x-1)*2
+
+
+def test_layer_forward_transpiled():
+    class Gate(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = paddle.nn.Linear(4, 4)
+
+        def forward(self, x):
+            h = self.fc(x)
+            if paddle.mean(h) > 0:
+                out = h * 2
+            else:
+                out = h * -1
+            return out
+
+    m = Gate()
+    x = paddle.to_tensor(np.random.default_rng(0).standard_normal((2, 4)).astype(np.float32))
+    eager = _np(m(x))  # eager: concrete pred, python path
+    jitted = paddle.jit.to_static(m)
+    np.testing.assert_allclose(_np(jitted(x)), eager, rtol=1e-5)
+
+
+def test_if_grad_flows_through_cond():
+    # gradients flow through the chosen branch of a rewritten tensor-if
+    x = paddle.to_tensor(np.array([1.0, 2.0], np.float32), stop_gradient=False)
+
+    def f(x):
+        if paddle.sum(x) > 0:
+            y = x * 3
+        else:
+            y = x * 5
+        return paddle.sum(y)
+
+    g = transpile(f)
+    loss = g(x)
+    loss.backward()
+    np.testing.assert_allclose(_np(x.grad), [3.0, 3.0])
+
+
+# -- review-hardening cases -------------------------------------------------
+
+
+def test_sibling_closures_get_own_cells():
+    def make(k):
+        def f(x):
+            if x > 0:
+                y = x + k
+            else:
+                y = x - k
+            return y
+
+        return transpile(f)
+
+    f1, f2 = make(1), make(2)
+    assert f1(5) == 6 and f2(5) == 7
+    assert f1(-5) == -6 and f2(-5) == -7
+
+
+def test_super_in_transpiled_forward():
+    class Base(paddle.nn.Layer):
+        def forward(self, x):
+            return x * 2
+
+    class Child(Base):
+        def forward(self, x):
+            h = super().forward(x)
+            if paddle.sum(h) > 0:
+                h = h + 1
+            else:
+                h = h - 1
+            return h
+
+    m = paddle.jit.to_static(Child())
+    x = paddle.to_tensor(np.array([3.0], np.float32))
+    np.testing.assert_allclose(_np(m(x)), [7.0])
+
+
+def test_no_control_flow_left_untransformed():
+    def f(x):
+        return x * 2 + 1
+
+    assert transpile(f) is f
+
+
+def test_live_globals_visible():
+    import tests.test_dy2static as me
+
+    def f(x):
+        if x > 0:
+            y = x + me._G
+        else:
+            y = x
+        return y
+
+    me._G = 10
+    g = transpile(f)
+    assert g(1) == 11
+    me._G = 20
+    assert g(1) == 21  # globals are live, not snapshotted
+
+
+def test_walrus_boolop_untouched():
+    def f(a):
+        ok = (v := a + 1) and v > 0
+        return ok, v
+
+    g = transpile(f)
+    assert g(2) == (True, 3)
