@@ -4,23 +4,33 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 The reference publishes no in-tree numbers (BASELINE.md), so vs_baseline is
 measured against this repo's own recorded prior (bench_baseline.json, written
 on first run) — a regression gate in the spirit of tools/ci_op_benchmark.sh.
+
+On TPU the flagship step is measured on both attention-kernel paths — the
+classic [b,h,s,d] pair and the flat-lane zero-relayout kernels
+(FLAGS_flash_flat) — and the faster one is reported. The flat measurement
+runs in a subprocess with a timeout so a pathological compile can never hang
+the benchmark.
 """
 from __future__ import annotations
 
 import json
 import os
+import subprocess
+import sys
 import time
 
 import numpy as np
 
 
-def main():
+def _measure(flash_flat: bool):
     import jax
 
     import paddle_tpu as paddle
+    from paddle_tpu.framework.flags import _REGISTRY
     from paddle_tpu.jit import TrainStep
     from paddle_tpu.models.gpt import GPTConfig, GPTForPretraining, GPTPretrainingCriterion
 
+    _REGISTRY["FLAGS_flash_flat"] = flash_flat
     d0 = jax.devices()[0]
     # the axon tunnel reports platform 'axon' with device_kind 'TPU v5 lite'
     on_tpu = d0.platform in ("tpu", "axon") or "TPU" in getattr(d0, "device_kind", "")
@@ -37,7 +47,7 @@ def main():
     crit = GPTPretrainingCriterion()
     opt = paddle.optimizer.AdamW(learning_rate=1e-4, parameters=model.parameters())
     # bf16 compute with f32 master weights (TPU-native AMP O2) + Pallas flash
-    # attention (fwd+bwd); measured 52.2k tok/s/chip vs 30.5k f32 on v5lite
+    # attention (fwd+bwd)
     amp_level = "O2" if on_tpu else None
     step = TrainStep(model, opt, crit, amp_level=amp_level)
 
@@ -58,6 +68,41 @@ def main():
 
     tokens_per_sec = batch * seq * iters / dt
     config_key = f"{d0.device_kind or d0.platform}/h{cfg.hidden_size}L{cfg.num_layers}b{batch}s{seq}/amp={amp_level}"
+    return tokens_per_sec, config_key, on_tpu
+
+
+def _measure_in_subprocess(which: str):
+    """One measurement per process: TPU runtimes hold per-process device
+    locks, so the parent must not initialize a backend before its children."""
+    env = dict(os.environ, BENCH_ONE=which)
+    r = subprocess.run([sys.executable, os.path.abspath(__file__)], env=env,
+                       capture_output=True, text=True, timeout=900)
+    line = [l for l in r.stdout.splitlines() if l.startswith("{")][-1]
+    d = json.loads(line)
+    return d["value"], d["config"], d["on_tpu"]
+
+
+def main():
+    if os.environ.get("BENCH_ONE"):
+        tps, config_key, on_tpu = _measure(os.environ["BENCH_ONE"] == "flat")
+        print(json.dumps({"value": tps, "config": config_key, "on_tpu": on_tpu}))
+        return
+
+    chosen = "classic"
+    try:
+        tokens_per_sec, config_key, on_tpu = _measure_in_subprocess("classic")
+    except Exception:
+        # subprocess machinery unavailable — single in-process measurement
+        tokens_per_sec, config_key, on_tpu = _measure(flash_flat=False)
+        on_tpu = False  # device now locked by this process: skip the flat run
+    if on_tpu:
+        try:
+            flat_tps, flat_cfg, _ = _measure_in_subprocess("flat")
+            if flat_cfg == config_key and flat_tps > tokens_per_sec:
+                tokens_per_sec, chosen = flat_tps, "flash_flat"
+        except Exception:
+            pass  # classic measurement stands
+
     base_path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "bench_baseline.json")
     vs = 1.0
     if os.path.exists(base_path):
@@ -76,6 +121,7 @@ def main():
         "value": round(tokens_per_sec, 2),
         "unit": "tokens/sec/chip",
         "vs_baseline": round(vs, 4),
+        "attention_path": chosen,
     }))
 
 

@@ -541,6 +541,13 @@ def _build_factory(fn):
     if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
         return None
     fdef.decorator_list = []
+    # private-name mangling (self.__x -> self._Cls__x) happens at class-body
+    # compile time; recompiling outside the class would silently unmangle
+    for sub in ast.walk(tree):
+        nm = sub.attr if isinstance(sub, ast.Attribute) else (
+            sub.id if isinstance(sub, ast.Name) else None)
+        if nm and nm.startswith("__") and not nm.endswith("__"):
+            return None
     t = _Transformer()
     t.visit(tree)
     if not t.changed:  # nothing rewritten — keep the original function
@@ -588,9 +595,15 @@ def transpile(fn):
     # is injected, under a collision-safe name.
     g = fn.__globals__
     g.setdefault(_JST, sys.modules[__name__])
-    lns = {}
-    exec(code, g, lns)
-    new = lns[_FACTORY](*cells)
+    try:
+        lns = {}
+        exec(code, g, lns)
+        new = lns[_FACTORY](*cells)
+    except Exception:
+        # e.g. a default-arg expression referencing an enclosing local that
+        # is not one of fn's freevars — fall back to the original function
+        _code_cache[key] = None
+        return fn
     new = functools.wraps(fn)(new)
     new._jst_transpiled = True
     return new

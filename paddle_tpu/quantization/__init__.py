@@ -31,6 +31,7 @@ from ..tensor._helpers import ensure_tensor, op
 __all__ = [
     "PostTrainingQuantization", "ImperativePTQ", "QuantizedLinear",
     "QuantizedConv2D", "quant_abs_max", "dequant", "fake_quant",
+    "ImperativeQuantAware", "QATQuantizedLinear", "QATQuantizedConv2D",
 ]
 
 
@@ -142,6 +143,10 @@ class PostTrainingQuantization:
                  executor=None, **compat_kwargs):
         if model is None:
             raise ValueError("pass the Layer to quantize as model=")
+        if isinstance(model, (Linear, Conv2D)):
+            raise ValueError(
+                "PTQ swaps sublayers in place and cannot replace the root "
+                "layer; wrap it, e.g. nn.Sequential(layer)")
         if algo not in ("abs_max", "avg"):
             raise NotImplementedError(f"activation algo {algo!r}; use 'abs_max' or 'avg'")
         if weight_quantize_type not in ("channel_wise_abs_max", "abs_max"):
@@ -223,3 +228,189 @@ class PostTrainingQuantization:
 
 class ImperativePTQ(PostTrainingQuantization):
     """Name parity with slim/quantization/imperative/ptq.py — same flow."""
+
+
+# ---------------------------------------------------------------------------
+# QAT: quantization-aware training (reference
+# slim/quantization/imperative/qat.py ImperativeQuantAware + the fake-quant
+# layers of paddle/nn/quant/quant_layers.py)
+# ---------------------------------------------------------------------------
+
+import jax  # noqa: E402
+
+
+@jax.custom_vjp
+def _qdq_ste(x, scale):
+    """Quantize-dequantize with a straight-through estimator. ``scale`` is
+    the int8 step (amax/127), broadcastable against x; scale<=0 means "not
+    yet calibrated" and passes through untouched."""
+    s = jnp.where(scale > 0, scale, 1.0)
+    q = jnp.clip(jnp.round(x / s), -127, 127) * s
+    return jnp.where(scale > 0, q.astype(x.dtype), x)
+
+
+def _qdq_fwd(x, scale):
+    return _qdq_ste(x, scale), (x, scale)
+
+
+def _qdq_bwd(res, g):
+    x, scale = res
+    # clipped STE (reference fake_quantize_dequantize grad): unit gradient
+    # inside the representable range, zero outside; scale is non-trainable
+    s = jnp.where(scale > 0, scale, jnp.inf)
+    mask = (jnp.abs(x) <= 127 * s).astype(g.dtype)
+    return g * mask, jnp.zeros_like(scale)
+
+
+_qdq_ste.defvjp(_qdq_fwd, _qdq_bwd)
+
+
+class _QATBase(Layer):
+    """Fake-quant wrapper holding the ORIGINAL trainable layer: weights are
+    quantize-dequantized per forward with fresh per-channel abs_max scales,
+    activations with a moving-average abs_max scale buffer (updated in
+    training mode through the same buffer side-effect path as BatchNorm
+    running stats, so it works inside compiled TrainStep)."""
+
+    def __init__(self, src: Layer, channel_axis: int, moving_rate: float = 0.9):
+        super().__init__()
+        self.inner = src  # parameters stay trainable and visible
+        self._channel_axis = channel_axis
+        self._moving_rate = moving_rate
+        self.register_buffer("act_scale", _wrap_value(jnp.zeros([], jnp.float32)))
+
+    def _observe_act(self, x):
+        from ..nn.functional.norm import _assign_buffer
+
+        amax = op(lambda v: (jnp.abs(v).max() / 127.0).astype(jnp.float32),
+                  x.detach(), _name="quant_act_absmax")
+        ro = self.act_scale if self.act_scale.stop_gradient else self.act_scale.detach()
+
+        def ema(old, new):
+            return jnp.where(old > 0, self._moving_rate * old + (1 - self._moving_rate) * new, new)
+
+        new_scale = op(ema, ro, amax, _name="quant_ema_scale")
+        _assign_buffer(self.act_scale, new_scale)
+        return new_scale
+
+    def _fq_act(self, x):
+        x = ensure_tensor(x)
+        scale = self._observe_act(x) if self.training else self.act_scale
+        return op(lambda v, s: _qdq_ste(v, s.astype(jnp.float32)).astype(v.dtype),
+                  x, scale, _name="fake_quantize_dequantize")
+
+    def _fq_weight(self, w):
+        axes = tuple(i for i in range(w.ndim) if i != self._channel_axis % w.ndim)
+
+        def fn(v):
+            s = jnp.maximum(jnp.abs(jax.lax.stop_gradient(v)).max(axis=axes, keepdims=True), 1e-8) / 127.0
+            return _qdq_ste(v, s)
+
+        return op(fn, w, _name="fake_channel_wise_quantize_dequantize")
+
+    def _final_act_scale(self):
+        s = float(np.asarray(unwrap(self.act_scale)))
+        return s if s > 0 else None
+
+
+class QATQuantizedLinear(_QATBase):
+    def __init__(self, src: Linear, moving_rate: float = 0.9):
+        super().__init__(src, channel_axis=1, moving_rate=moving_rate)
+
+    def forward(self, x):
+        from ..nn import functional as F
+
+        return F.linear(self._fq_act(x), self._fq_weight(self.inner.weight), self.inner.bias)
+
+    def _convert(self):
+        return QuantizedLinear(self.inner, self._final_act_scale())
+
+
+class QATQuantizedConv2D(_QATBase):
+    def __init__(self, src: Conv2D, moving_rate: float = 0.9):
+        super().__init__(src, channel_axis=0, moving_rate=moving_rate)
+
+    def forward(self, x):
+        from ..nn import functional as F
+
+        c = self.inner
+        return F.conv2d(self._fq_act(x), self._fq_weight(c.weight), c.bias,
+                        c.stride, c.padding, c.dilation, c.groups, c.data_format)
+
+    def _convert(self):
+        return QuantizedConv2D(self.inner, self._final_act_scale())
+
+
+class ImperativeQuantAware:
+    """Quantization-aware training driver (reference
+    slim/quantization/imperative/qat.py:77 ImperativeQuantAware).
+
+    ``quantize(model)`` swaps Linear/Conv2D sublayers in place for fake-quant
+    twins (call BEFORE building the optimizer so it owns the live params);
+    train as usual — weight scales track the weights, activation scales are
+    moving averages; ``save_quantized_model(model, path, input_spec)``
+    converts to int8 layers and exports a servable artifact.
+    """
+
+    def __init__(self, weight_quantize_type="channel_wise_abs_max",
+                 activation_quantize_type="moving_average_abs_max",
+                 weight_bits=8, activation_bits=8, moving_rate=0.9,
+                 quantizable_layer_type=("Conv2D", "Linear"), **compat_kwargs):
+        if weight_quantize_type not in ("channel_wise_abs_max", "abs_max"):
+            raise NotImplementedError(weight_quantize_type)
+        if activation_quantize_type != "moving_average_abs_max":
+            raise NotImplementedError(activation_quantize_type)
+        if (weight_bits, activation_bits) != (8, 8):
+            raise NotImplementedError("int8 only")
+        self.moving_rate = moving_rate
+        self.types = set(quantizable_layer_type)
+
+    def quantize(self, model: Layer) -> Layer:
+        if isinstance(model, (Linear, Conv2D)):
+            raise ValueError(
+                "quantize() swaps sublayers in place and cannot replace the "
+                "root layer; wrap it, e.g. nn.Sequential(layer)")
+        swapped = 0
+
+        def swap(parent):
+            nonlocal swapped
+            for cname, child in list(parent._sub_layers.items()):
+                if isinstance(child, Linear) and "Linear" in self.types:
+                    parent._sub_layers[cname] = QATQuantizedLinear(child, self.moving_rate)
+                    swapped += 1
+                elif isinstance(child, Conv2D) and "Conv2D" in self.types:
+                    parent._sub_layers[cname] = QATQuantizedConv2D(child, self.moving_rate)
+                    swapped += 1
+                else:
+                    swap(child)
+
+        swap(model)
+        if swapped == 0:
+            raise ValueError(
+                f"no quantizable sublayers ({sorted(self.types)}) found in "
+                f"{type(model).__name__}; nothing was quantized")
+        return model
+
+    def convert(self, model: Layer) -> Layer:
+        """Swap fake-quant layers for real int8 layers (in place)."""
+
+        def swap(parent):
+            for cname, child in list(parent._sub_layers.items()):
+                if isinstance(child, _QATBase):
+                    parent._sub_layers[cname] = child._convert()
+                else:
+                    swap(child)
+
+        swap(model)
+        return model
+
+    def save_quantized_model(self, model: Layer, path, input_spec=None, **kwargs):
+        from ..jit import save as jit_save
+
+        was_training = model.training
+        model.eval()
+        self.convert(model)
+        out = jit_save(model, path, input_spec=input_spec)
+        if was_training:
+            model.train()
+        return out

@@ -103,3 +103,81 @@ def test_ptq_save_and_predictor_serves_int8():
         # the artifact embeds int8 weight tensors
         blob = open(prefix + ".pdmodel", "rb").read()
         assert b"i8" in blob or b"int8" in blob
+
+
+# -- QAT (ImperativeQuantAware, reference imperative/qat.py) ----------------
+
+
+def test_qdq_ste_gradient():
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.quantization import _qdq_ste
+
+    x = jnp.array([0.5, -0.5, 200.0, -200.0], jnp.float32)
+    s = jnp.array(1.0 / 127.0, jnp.float32)  # amax=1 => +-200 out of range
+    g = jax.grad(lambda v: _qdq_ste(v, s).sum())(x)
+    np.testing.assert_allclose(np.asarray(g), [1.0, 1.0, 0.0, 0.0])
+    # uncalibrated scale (0) passes values AND gradients straight through
+    g0 = jax.grad(lambda v: _qdq_ste(v, jnp.float32(0.0)).sum())(x)
+    np.testing.assert_allclose(np.asarray(g0), [1.0, 1.0, 1.0, 1.0])
+    np.testing.assert_allclose(
+        np.asarray(_qdq_ste(x, jnp.float32(0.0))), np.asarray(x))
+
+
+def test_qat_train_convert_accuracy():
+    from paddle_tpu.quantization import ImperativeQuantAware, QuantizedLinear
+
+    paddle.seed(0)
+    rng = np.random.default_rng(0)
+    xs = rng.standard_normal((256, 16)).astype("float32")
+    w_true = rng.standard_normal((16, 1)).astype("float32")
+    ys = xs @ w_true + 0.05 * rng.standard_normal((256, 1)).astype("float32")
+
+    model = paddle.nn.Sequential(
+        paddle.nn.Linear(16, 32), paddle.nn.ReLU(), paddle.nn.Linear(32, 1))
+    qat = ImperativeQuantAware()
+    qat.quantize(model)
+    opt = paddle.optimizer.Adam(learning_rate=1e-2, parameters=model.parameters())
+    step = paddle.jit.TrainStep(model, opt, paddle.nn.MSELoss())
+    losses = []
+    for i in range(60):
+        sl = slice((i * 32) % 256, (i * 32) % 256 + 32)
+        losses.append(float(step(paddle.to_tensor(xs[sl]), paddle.to_tensor(ys[sl]))["loss"]))
+    assert losses[-1] < losses[0] * 0.2, (losses[0], losses[-1])
+    step.sync_to_model()  # write trained params + observer buffers back
+
+    # the moving-average observer calibrated through the compiled TrainStep
+    scales = [float(np.asarray(l.act_scale.numpy()))
+              for _, l in model.named_sublayers() if hasattr(l, "act_scale")]
+    assert scales and all(s > 0 for s in scales), scales
+
+    model.eval()
+    ref = np.asarray(model(paddle.to_tensor(xs[:64])).numpy())
+    qat.convert(model)
+    assert any(isinstance(l, QuantizedLinear) for _, l in model.named_sublayers())
+    got = np.asarray(model(paddle.to_tensor(xs[:64])).numpy())
+    # int8 model tracks the QAT fake-quant model closely
+    err = np.abs(got - ref).max() / (np.abs(ref).max() + 1e-6)
+    assert err < 0.05, err
+
+
+def test_qat_save_quantized_model_roundtrip():
+    from paddle_tpu.inference import Config, create_predictor
+    from paddle_tpu.quantization import ImperativeQuantAware
+    from paddle_tpu.static import InputSpec
+
+    paddle.seed(0)
+    m = paddle.nn.Sequential(paddle.nn.Linear(8, 8), paddle.nn.ReLU(), paddle.nn.Linear(8, 2))
+    qat = ImperativeQuantAware()
+    qat.quantize(m)
+    x = np.random.default_rng(2).standard_normal((4, 8)).astype("float32")
+    m(paddle.to_tensor(x))  # one train-mode pass calibrates observers
+    m.eval()
+    want = np.asarray(m(paddle.to_tensor(x)).numpy())
+    with tempfile.TemporaryDirectory() as d:
+        prefix = os.path.join(d, "qat_int8")
+        qat.save_quantized_model(m, prefix, input_spec=[InputSpec([None, 8], "float32")])
+        pred = create_predictor(Config(prefix))
+        (got,) = pred.run([x])
+        np.testing.assert_allclose(np.asarray(got), want, rtol=5e-2, atol=5e-2)
