@@ -3,3 +3,4 @@ from .core import Tensor, get_device, is_compiled_with_tpu, primitive, set_devic
 from .dtype import convert_dtype, get_default_dtype, set_default_dtype, to_jax_dtype
 from .flags import define_flag, flag, get_flags, set_flags
 from .random import get_rng_state, rng_scope, seed, set_rng_state, split_key
+from .selected_rows import SelectedRows

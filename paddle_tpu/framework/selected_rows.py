@@ -1,0 +1,92 @@
+"""SelectedRows: row-sparse tensor semantics for embedding gradients.
+
+Parity anchor: paddle/phi/core/selected_rows.h (rows + value block of a
+[height, ...] tensor) and the row-sparse optimizer kernels
+(paddle/fluid/operators/optimizers/adam_op.h lazy_mode,
+phi/kernels/selected_rows/). TPU-first framing: inside compiled steps the
+gradient is a dense array (XLA scatter-add is native and fuses), so
+SelectedRows here is (a) the API-parity container with merge/to_dense, and
+(b) the EAGER optimizer contract: `Embedding(sparse=True)` records the rows
+touched each forward, and SGD / Adam(lazy_mode=True) update only those rows
+— O(batch-rows) optimizer cost instead of O(vocab), which is where large
+embedding tables actually hurt.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def is_traced_value(v) -> bool:
+    from .static_trace import is_symbolic
+
+    if is_symbolic(v):
+        return True
+    try:
+        import jax.core
+
+        return isinstance(v, jax.core.Tracer)
+    except Exception:
+        return False
+
+
+class SelectedRows:
+    """Rows + values view of a [height, ...] tensor: row ``rows[i]`` holds
+    ``values[i]``; unlisted rows are zero. Duplicate rows are allowed and
+    sum (reference MergeAdd semantics)."""
+
+    def __init__(self, rows, values, height: int):
+        self._rows = jnp.asarray(rows, jnp.int32)
+        self._values = jnp.asarray(values)
+        if self._rows.ndim != 1 or self._values.shape[0] != self._rows.shape[0]:
+            raise ValueError(f"rows {self._rows.shape} vs values {self._values.shape}")
+        self._height = int(height)
+
+    @property
+    def rows(self):
+        return self._rows
+
+    @property
+    def values(self):
+        return self._values
+
+    @property
+    def height(self):
+        return self._height
+
+    def merge_add(self) -> "SelectedRows":
+        """Coalesce duplicate rows by summation (reference
+        phi/kernels/funcs/selected_rows_functor.h MergeAdd)."""
+        uniq, inv = jnp.unique(self._rows, return_inverse=True)
+        summed = jnp.zeros((uniq.shape[0],) + self._values.shape[1:], self._values.dtype)
+        summed = summed.at[inv].add(self._values)
+        return SelectedRows(uniq, summed, self._height)
+
+    def to_dense(self):
+        dense = jnp.zeros((self._height,) + self._values.shape[1:], self._values.dtype)
+        return dense.at[self._rows].add(self._values)
+
+    @staticmethod
+    def from_dense(dense, rows, height=None) -> "SelectedRows":
+        rows = jnp.asarray(rows, jnp.int32)
+        return SelectedRows(rows, jnp.asarray(dense)[rows],
+                            dense.shape[0] if height is None else height)
+
+    def __repr__(self):
+        return f"SelectedRows(height={self._height}, nnz_rows={int(self._rows.shape[0])}, dim={self._values.shape[1:]})"
+
+
+def record_rows(param, ids) -> None:
+    """Note embedding rows touched this forward on the weight parameter;
+    consumed (and cleared) by the next eager optimizer step."""
+    ids = np.unique(np.asarray(ids).ravel())
+    param.__dict__.setdefault("_sparse_rows_pending", []).append(ids)
+
+
+def take_pending_rows(param):
+    pend = param.__dict__.get("_sparse_rows_pending")
+    if not pend:
+        return None
+    rows = np.unique(np.concatenate(pend))
+    pend.clear()
+    return rows

@@ -20,3 +20,11 @@ from .norm import (  # noqa: F401
     rms_norm,
 )
 from .pooling import *  # noqa: F401,F403
+from .sequence import (  # noqa: F401
+    sequence_expand,
+    sequence_mask,
+    sequence_pad,
+    sequence_pool,
+    sequence_softmax,
+    sequence_unpad,
+)

@@ -1,0 +1,137 @@
+"""Sequence ops: the TPU-native LoDTensor replacement.
+
+Parity anchors: python/paddle/nn/functional/extension.py sequence_mask and
+the fluid sequence ops (python/paddle/fluid/layers/sequence_lod.py:
+sequence_pad, sequence_unpad, sequence_pool, sequence_softmax; C++ kernels
+under paddle/fluid/operators/sequence_ops/).
+
+The reference carries variable-length batches as LoDTensor (flat values +
+level-of-detail offsets) and every sequence op walks the LoD. On TPU, XLA
+wants static shapes, so the native representation is (padded dense
+[batch, maxlen, ...], lengths [batch]) — the exact pair sequence_pad
+produces. Every op here takes/returns that pair; masks are computed from
+lengths with iota-compare, which XLA fuses into the consumer.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...tensor._helpers import ensure_tensor, op
+
+__all__ = ["sequence_mask", "sequence_pad", "sequence_unpad", "sequence_pool",
+           "sequence_softmax", "sequence_expand"]
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    """[b] lengths -> [b, maxlen] 0/1 mask (paddle.nn.functional.sequence_mask).
+    The mask's extent is a SHAPE, so ``maxlen`` (or, when None, max(x)) must
+    be concrete — pass an int under tracing."""
+    from ...framework.dtype import to_jax_dtype
+
+    x = ensure_tensor(x)
+    jdt = to_jax_dtype(dtype)
+    if maxlen is None:
+        maxlen = int(np.asarray(x._value).max())
+    elif not isinstance(maxlen, int):
+        maxlen = int(np.asarray(ensure_tensor(maxlen)._value))
+
+    def fn(lens):
+        return (jnp.arange(maxlen)[None, :] < lens[..., None]).astype(jdt)
+
+    return op(fn, x, _name="sequence_mask")
+
+
+def sequence_pad(sequences, pad_value=0.0, maxlen=None, name=None):
+    """List of [len_i, ...] tensors -> (padded [b, maxlen, ...], lengths [b]).
+
+    Reference sequence_pad consumes a LoDTensor; the list-of-tensors form is
+    its eager equivalent (the LoD is exactly the per-item lengths). Host-side
+    by design — padding happens at data-ingest, like the DataLoader collate.
+    """
+    seqs = [np.asarray(ensure_tensor(s)._value) for s in sequences]
+    if not seqs:
+        raise ValueError("sequence_pad needs at least one sequence")
+    lengths = np.asarray([s.shape[0] for s in seqs], np.int64)
+    m = int(maxlen) if maxlen is not None else int(lengths.max())
+    if maxlen is not None and int(lengths.max()) > m:
+        raise ValueError(f"maxlen={m} < longest sequence {int(lengths.max())}")
+    tail = seqs[0].shape[1:]
+    out = np.full((len(seqs), m) + tail, pad_value, seqs[0].dtype)
+    for i, s in enumerate(seqs):
+        out[i, : s.shape[0]] = s
+    from ...framework.core import _wrap_value
+
+    return _wrap_value(jnp.asarray(out)), _wrap_value(jnp.asarray(lengths))
+
+
+def sequence_unpad(x, length, name=None):
+    """(padded [b, maxlen, ...], lengths [b]) -> list of [len_i, ...] tensors
+    (reference sequence_unpad returns the LoDTensor; a list is its eager
+    form). Host-side: output shapes are data-dependent."""
+    x = ensure_tensor(x)
+    lens = np.asarray(ensure_tensor(length)._value, np.int64)
+    arr = np.asarray(x._value)
+    from ...framework.core import _wrap_value
+
+    return [_wrap_value(jnp.asarray(arr[i, : int(l)])) for i, l in enumerate(lens)]
+
+
+def sequence_pool(x, lengths, pool_type="average", name=None):
+    """Masked pooling over the time axis of (padded [b, t, ...], lengths):
+    sum / average / sqrt / max / first / last (reference sequence_pool)."""
+    pool_type = pool_type.lower()
+    if pool_type not in ("sum", "average", "sqrt", "max", "first", "last"):
+        raise ValueError(f"unknown pool_type {pool_type!r}")
+    x, lens = ensure_tensor(x), ensure_tensor(lengths)
+
+    def fn(v, ln):
+        t = v.shape[1]
+        mask = jnp.arange(t)[None, :] < ln[:, None]
+        mexp = mask.reshape(mask.shape + (1,) * (v.ndim - 2))
+        if pool_type in ("sum", "average", "sqrt"):
+            s = jnp.sum(jnp.where(mexp, v, 0), axis=1)
+            if pool_type == "sum":
+                return s
+            den = jnp.maximum(ln, 1).astype(v.dtype)
+            den = den.reshape((-1,) + (1,) * (v.ndim - 2))
+            return s / (jnp.sqrt(den) if pool_type == "sqrt" else den)
+        if pool_type == "max":
+            neg = jnp.asarray(jnp.finfo(v.dtype).min if jnp.issubdtype(v.dtype, jnp.floating)
+                              else jnp.iinfo(v.dtype).min, v.dtype)
+            return jnp.max(jnp.where(mexp, v, neg), axis=1)
+        if pool_type == "first":
+            return v[:, 0]
+        idx = jnp.maximum(ln - 1, 0)
+        return jnp.take_along_axis(v, idx.reshape((-1, 1) + (1,) * (v.ndim - 2)), axis=1)[:, 0]
+
+    return op(fn, x, lens, _name=f"sequence_pool_{pool_type}")
+
+
+def sequence_softmax(x, lengths, name=None):
+    """Softmax over valid positions of the time axis; padded slots get 0
+    (reference sequence_softmax over each sequence's LoD span)."""
+    x, lens = ensure_tensor(x), ensure_tensor(lengths)
+
+    def fn(v, ln):
+        t = v.shape[1]
+        mask = (jnp.arange(t)[None, :] < ln[:, None])
+        mask = mask.reshape(mask.shape + (1,) * (v.ndim - 2))
+        z = jnp.where(mask, v, -jnp.inf)
+        z = z - jnp.max(z, axis=1, keepdims=True)
+        e = jnp.where(mask, jnp.exp(z), 0)
+        return e / jnp.maximum(jnp.sum(e, axis=1, keepdims=True), 1e-30)
+
+    return op(fn, x, lens, _name="sequence_softmax")
+
+
+def sequence_expand(x, lengths, name=None):
+    """Repeat row i of x lengths[i] times along a new flat axis (the common
+    reference sequence_expand use: broadcast per-sequence features onto
+    per-token positions). Host-side sizes (data-dependent output shape)."""
+    x = ensure_tensor(x)
+    lens = np.asarray(ensure_tensor(lengths)._value, np.int64)
+    from ...framework.core import _wrap_value
+
+    return _wrap_value(jnp.repeat(x._value, jnp.asarray(lens), axis=0,
+                                  total_repeat_length=int(lens.sum())))

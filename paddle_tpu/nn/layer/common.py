@@ -78,10 +78,25 @@ class Embedding(Layer):
         super().__init__()
         self.num_embeddings, self.embedding_dim = num_embeddings, embedding_dim
         self.padding_idx = padding_idx
+        self._sparse = bool(sparse)
         self.weight = self.create_parameter([num_embeddings, embedding_dim], attr=weight_attr, default_initializer=I.Normal(0.0, 1.0))
 
     def forward(self, x):
-        return F.embedding(x, self.weight, padding_idx=self.padding_idx)
+        out = F.embedding(x, self.weight, padding_idx=self.padding_idx)
+        if self._sparse and not self.weight.stop_gradient:
+            # SelectedRows contract: note which rows this batch touched so
+            # SGD / Adam(lazy_mode) can update only those rows in eager mode
+            # (framework/selected_rows.py). Only grad-producing forwards
+            # count — rows from no_grad/eval lookups have zero grad and must
+            # not be stepped. Inside a trace the grad is dense (XLA scatter).
+            from ...framework.autograd import is_grad_enabled
+            from ...framework.selected_rows import is_traced_value, record_rows
+            from ...tensor._helpers import ensure_tensor
+
+            ids = ensure_tensor(x)._value
+            if is_grad_enabled() and not is_traced_value(ids):
+                record_rows(self.weight, ids)
+        return out
 
 
 class Flatten(Layer):

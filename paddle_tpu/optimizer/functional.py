@@ -30,6 +30,11 @@ class SGDCore:
         new_params = tmap(lambda p, g: p - lr * g.astype(p.dtype), params, grads)
         return new_params, state
 
+    def row_update(self, rows, g_rows, state_p, p, lr, step):
+        """Row-sparse update (reference selected_rows sgd kernel): only the
+        embedding rows touched this step change."""
+        return p.at[rows].add((-lr * g_rows).astype(p.dtype)), state_p
+
 
 class MomentumCore:
     def __init__(self, momentum=0.9, use_nesterov=False):
@@ -70,6 +75,20 @@ class AdamCore:
             params, m, v,
         )
         return new_params, {"m": m, "v": v}
+
+    def row_update(self, rows, g_rows, state_p, p, lr, step):
+        """Lazy-mode row-sparse Adam (reference adam_op.h lazy_mode branch):
+        moments and params update only on the rows present this step; unseen
+        rows keep their moments (no decay), exactly the reference contract."""
+        m, v = state_p["m"], state_p["v"]
+        g = g_rows.astype(m.dtype)
+        m_r = self.b1 * m[rows] + (1 - self.b1) * g
+        v_r = self.b2 * v[rows] + (1 - self.b2) * jnp.square(g)
+        t = step + 1
+        bc1 = 1 - self.b1**t
+        bc2 = 1 - self.b2**t
+        new_p = p.at[rows].add(-(lr * (m_r / bc1) / (jnp.sqrt(v_r / bc2) + self.eps)).astype(p.dtype))
+        return new_p, {"m": m.at[rows].set(m_r), "v": v.at[rows].set(v_r)}
 
 
 class AdamWCore(AdamCore):

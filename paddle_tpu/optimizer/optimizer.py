@@ -57,6 +57,8 @@ class Optimizer:
             self._state = self.core.init(params_tree)
 
     def step(self):
+        from ..framework.selected_rows import take_pending_rows
+
         params = [p for p in self._params if not p.stop_gradient]
         grads = [p.grad for p in params]
         if self._grad_clip is not None:
@@ -65,7 +67,17 @@ class Optimizer:
             for i, g in enumerate(grads):
                 if g is not None:
                     g._value = clipped[i]
-        ptree = {i: p._value for i, p in enumerate(params) if grads[i] is not None}
+        # row-sparse params (Embedding(sparse=True) recorded touched rows):
+        # lazy cores update only those rows — O(batch) not O(vocab). Global
+        # grad clip already densified everything, so it disables laziness.
+        lazy = getattr(self, "_lazy_sparse", False) and hasattr(self.core, "row_update") \
+            and self._grad_clip is None and not self._weight_decay
+        sparse: Dict[int, object] = {}
+        for i, p in enumerate(params):
+            rows = take_pending_rows(p)  # always drain — stale rows must not leak
+            if rows is not None and lazy and grads[i] is not None:
+                sparse[i] = rows
+        ptree = {i: p._value for i, p in enumerate(params) if grads[i] is not None and i not in sparse}
         gtree = {i: grads[i]._value for i in ptree}
         self._pre_update(params, ptree)
         if self._weight_decay and not isinstance(self, _DecoupledWD):
@@ -76,6 +88,16 @@ class Optimizer:
         for i, p in enumerate(params):
             if i in new_params:
                 p._apply_update(new_params[i])
+        lr = self.get_lr()
+        for i, rows in sparse.items():
+            p = params[i]
+            rows_j = jnp.asarray(rows, jnp.int32)
+            state_p = {k: self._state[k][i] for k in self._state} if self._state else {}
+            new_p, new_state_p = self.core.row_update(
+                rows_j, grads[i]._value[rows_j], state_p, p._value, lr, self._step_count)
+            p._apply_update(new_p)
+            for k, v in new_state_p.items():
+                self._state[k][i] = v
         self._step_count += 1
 
     def _pre_update(self, params, ptree):
@@ -102,8 +124,11 @@ class Optimizer:
         return new_params, self._state
 
     def clear_grad(self, set_to_zero=True):
+        from ..framework.selected_rows import take_pending_rows
+
         for p in self._params:
             p.grad = None
+            take_pending_rows(p)  # drop any rows recorded without a step
 
     clear_gradients = clear_grad
 
@@ -157,6 +182,9 @@ class _DecoupledWD:
 
 class SGD(Optimizer):
     _core_cls = Fopt.SGDCore
+    # SGD over a row-sparse grad touches only those rows — identical to the
+    # dense result, so laziness is always safe (reference sgd_op SelectedRows)
+    _lazy_sparse = True
 
 
 class Momentum(Optimizer):
@@ -167,6 +195,9 @@ class Momentum(Optimizer):
 class Adam(Optimizer):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, parameters=None, weight_decay=None, grad_clip=None, lazy_mode=False, name=None, multi_precision=False):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip, core=Fopt.AdamCore(beta1, beta2, epsilon))
+        # lazy_mode: row-sparse moment/param updates for Embedding(sparse=True)
+        # grads (reference adam_op.h lazy_mode branch)
+        self._lazy_sparse = bool(lazy_mode)
 
 
 class AdamW(Optimizer, _DecoupledWD):

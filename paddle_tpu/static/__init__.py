@@ -718,4 +718,27 @@ class _StaticNN:
         return make_runner, inputs, n_out
 
 
+# sequence ops live on static.nn in the reference (fluid/layers/sequence_lod.py).
+# Only the trace-safe ones (built on the op() chokepoint) are aliased; pad/
+# unpad/expand are host-side (data-dependent shapes) and raise a pointer to
+# their eager form instead of failing deep inside np.asarray.
+from ..nn.functional import sequence  # noqa: E402
+
+for _sn in ("sequence_mask", "sequence_pool", "sequence_softmax"):
+    setattr(_StaticNN, _sn, staticmethod(getattr(sequence, _sn)))
+
+
+def _host_side_sequence_op(name):
+    def raiser(*a, **k):
+        raise NotImplementedError(
+            f"static.nn.{name} has data-dependent output shapes and cannot be "
+            f"recorded in a static program; call paddle.nn.functional.{name} "
+            f"on concrete data (e.g. at ingest, like a DataLoader collate)")
+
+    return staticmethod(raiser)
+
+
+for _sn in ("sequence_pad", "sequence_unpad", "sequence_expand"):
+    setattr(_StaticNN, _sn, _host_side_sequence_op(_sn))
+
 nn = _StaticNN()

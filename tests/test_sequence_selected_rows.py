@@ -1,0 +1,183 @@
+"""Sequence ops (the TPU-native LoD) + SelectedRows lazy sparse updates.
+
+Parity anchors: fluid/layers/sequence_lod.py sequence_* ops,
+phi/core/selected_rows.h, operators/optimizers/adam_op.h lazy_mode.
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.framework import SelectedRows
+
+
+def _np(t):
+    return np.asarray(t.numpy())
+
+
+def test_sequence_mask():
+    m = F.sequence_mask(paddle.to_tensor(np.array([1, 3, 0], np.int64)), maxlen=4)
+    np.testing.assert_array_equal(_np(m), [[1, 0, 0, 0], [1, 1, 1, 0], [0, 0, 0, 0]])
+    m2 = F.sequence_mask(paddle.to_tensor(np.array([2, 1], np.int64)), dtype="float32")
+    assert _np(m2).shape == (2, 2) and _np(m2).dtype == np.float32
+
+
+def test_sequence_pad_unpad_roundtrip():
+    seqs = [np.arange(3, dtype=np.float32).reshape(3, 1),
+            np.arange(5, dtype=np.float32).reshape(5, 1),
+            np.arange(1, dtype=np.float32).reshape(1, 1)]
+    padded, lens = F.sequence_pad([paddle.to_tensor(s) for s in seqs], pad_value=-1.0)
+    assert _np(padded).shape == (3, 5, 1)
+    np.testing.assert_array_equal(_np(lens), [3, 5, 1])
+    assert _np(padded)[0, 3, 0] == -1.0  # padding value
+    back = F.sequence_unpad(padded, lens)
+    for s, b in zip(seqs, back):
+        np.testing.assert_array_equal(s, _np(b))
+
+
+def test_sequence_pool_all_types():
+    x = np.array([[[1.0], [2.0], [9.0]],
+                  [[4.0], [7.0], [5.0]]], np.float32)
+    lens = np.array([2, 3], np.int64)
+    xt, lt = paddle.to_tensor(x), paddle.to_tensor(lens)
+    np.testing.assert_allclose(_np(F.sequence_pool(xt, lt, "sum")), [[3.0], [16.0]])
+    np.testing.assert_allclose(_np(F.sequence_pool(xt, lt, "average")), [[1.5], [16 / 3]])
+    np.testing.assert_allclose(_np(F.sequence_pool(xt, lt, "max")), [[2.0], [7.0]])
+    np.testing.assert_allclose(_np(F.sequence_pool(xt, lt, "first")), [[1.0], [4.0]])
+    np.testing.assert_allclose(_np(F.sequence_pool(xt, lt, "last")), [[2.0], [5.0]])
+    np.testing.assert_allclose(_np(F.sequence_pool(xt, lt, "sqrt")),
+                               [[3.0 / np.sqrt(2)], [16.0 / np.sqrt(3)]])
+
+
+def test_sequence_softmax_masks_padding():
+    x = np.array([[1.0, 1.0, 99.0], [1.0, 2.0, 3.0]], np.float32)[:, :, None]
+    out = _np(F.sequence_softmax(paddle.to_tensor(x), paddle.to_tensor(np.array([2, 3]))))
+    np.testing.assert_allclose(out[0, :, 0], [0.5, 0.5, 0.0], atol=1e-6)  # 99 masked
+    np.testing.assert_allclose(out[1, :, 0].sum(), 1.0, atol=1e-6)
+
+
+def test_sequence_expand():
+    x = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)
+    out = _np(F.sequence_expand(paddle.to_tensor(x), paddle.to_tensor(np.array([2, 3]))))
+    np.testing.assert_array_equal(out, [x[0], x[0], x[1], x[1], x[1]])
+
+
+def test_sequence_pool_grad_ignores_padding():
+    x = paddle.to_tensor(np.ones((2, 3, 1), np.float32), stop_gradient=False)
+    lens = paddle.to_tensor(np.array([2, 3], np.int64))
+    F.sequence_pool(x, lens, "sum").sum().backward()
+    np.testing.assert_array_equal(_np(x.grad)[:, :, 0], [[1, 1, 0], [1, 1, 1]])
+
+
+def test_static_nn_sequence_alias():
+    assert paddle.static.nn.sequence_pool is not None
+    m = paddle.static.nn.sequence_mask(paddle.to_tensor(np.array([2], np.int64)), maxlen=3)
+    np.testing.assert_array_equal(_np(m), [[1, 1, 0]])
+
+
+# -- SelectedRows -----------------------------------------------------------
+
+
+def test_selected_rows_merge_and_dense():
+    sr = SelectedRows(rows=[1, 3, 1], values=np.array([[1.0], [2.0], [10.0]], np.float32), height=5)
+    merged = sr.merge_add()
+    np.testing.assert_array_equal(np.asarray(merged.rows), [1, 3])
+    np.testing.assert_allclose(np.asarray(merged.values), [[11.0], [2.0]])
+    dense = np.asarray(sr.to_dense())
+    np.testing.assert_allclose(dense[:, 0], [0, 11, 0, 2, 0])
+    rt = SelectedRows.from_dense(dense, [1, 3])
+    np.testing.assert_allclose(np.asarray(rt.values), [[11.0], [2.0]])
+
+
+def test_sgd_sparse_embedding_matches_dense():
+    ids = np.array([[0, 2], [2, 5]], np.int64)
+
+    def run(sparse):
+        paddle.seed(7)
+        emb = paddle.nn.Embedding(8, 4, sparse=sparse)
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=emb.parameters())
+        for _ in range(3):
+            out = emb(paddle.to_tensor(ids))
+            (out * out).sum().backward()
+            opt.step()
+            opt.clear_grad()
+        return _np(emb.weight)
+
+    np.testing.assert_allclose(run(True), run(False), rtol=1e-6)
+
+
+def test_adam_lazy_mode_only_touches_seen_rows():
+    ids = np.array([[1, 2]], np.int64)
+
+    def run(lazy):
+        paddle.seed(3)
+        emb = paddle.nn.Embedding(6, 4, sparse=True)
+        w0 = _np(emb.weight).copy()
+        opt = paddle.optimizer.Adam(learning_rate=0.05, parameters=emb.parameters(), lazy_mode=lazy)
+        for _ in range(2):
+            out = emb(paddle.to_tensor(ids))
+            (out * out).sum().backward()
+            opt.step()
+            opt.clear_grad()
+        return w0, _np(emb.weight), opt
+
+    w0, w_lazy, opt = run(True)
+    # rows never seen in a batch are untouched (lazy contract)
+    untouched = [0, 3, 4, 5]
+    np.testing.assert_allclose(w_lazy[untouched], w0[untouched])
+    # seen rows moved
+    assert np.abs(w_lazy[[1, 2]] - w0[[1, 2]]).max() > 1e-4
+    # moments exist only as full arrays but changed rows match a manual check
+    m = np.asarray(opt._state["m"][0])
+    assert np.abs(m[[1, 2]]).max() > 0 and np.abs(m[untouched]).max() == 0
+
+
+def test_adam_lazy_matches_dense_when_all_rows_touched():
+    ids = np.array([[0, 1, 2, 3]], np.int64)  # every row in every batch
+
+    def run(lazy):
+        paddle.seed(11)
+        emb = paddle.nn.Embedding(4, 3, sparse=lazy)
+        opt = paddle.optimizer.Adam(learning_rate=0.02, parameters=emb.parameters(), lazy_mode=lazy)
+        for _ in range(4):
+            out = emb(paddle.to_tensor(ids))
+            (out * out).sum().backward()
+            opt.step()
+            opt.clear_grad()
+        return _np(emb.weight)
+
+    np.testing.assert_allclose(run(True), run(False), rtol=1e-5, atol=1e-6)
+
+
+def test_static_nn_host_ops_raise_clearly():
+    import pytest
+
+    with pytest.raises(NotImplementedError):
+        paddle.static.nn.sequence_pad([paddle.to_tensor(np.zeros(2, np.float32))])
+
+
+def test_no_grad_forward_records_no_rows():
+    emb = paddle.nn.Embedding(6, 4, sparse=True)
+    opt = paddle.optimizer.Adam(learning_rate=0.05, parameters=emb.parameters(), lazy_mode=True)
+    ids = np.array([[1, 2]], np.int64)
+    out = emb(paddle.to_tensor(ids))
+    (out * out).sum().backward()
+    opt.step()
+    opt.clear_grad()
+    w_before = _np(emb.weight).copy()
+    with paddle.no_grad():
+        emb(paddle.to_tensor(np.array([[5]], np.int64)))  # eval lookup: no grad
+    out = emb(paddle.to_tensor(ids))
+    (out * out).sum().backward()
+    opt.step()
+    opt.clear_grad()
+    # row 5 (seen only under no_grad) must not move: zero-grad rows with live
+    # moments would otherwise drift
+    np.testing.assert_allclose(_np(emb.weight)[5], w_before[5])
+
+
+def test_clear_grad_drains_pending_rows():
+    emb = paddle.nn.Embedding(6, 4, sparse=True)
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=emb.parameters())
+    emb(paddle.to_tensor(np.array([[3]], np.int64)))  # forward without backward
+    opt.clear_grad()
+    assert not emb.weight.__dict__.get("_sparse_rows_pending")
