@@ -1,0 +1,160 @@
+"""FleetExecutor: actor-model interceptor DAG runtime.
+
+Parity anchors: paddle/fluid/distributed/fleet_executor/ — ``Carrier``
+(carrier.h:49) owns ``Interceptor``s (interceptor.h:46; compute / amplifier /
+source / sink variants) exchanging ``InterceptorMessage`` over in-process
+queues or a brpc MessageBus; the task graph is ``TaskNode`` (task_node.h).
+The reference uses it for distributed inference and static pipeline serving.
+
+TPU-native design: training-time pipelining is compiled (spmd_pipeline —
+ppermute inside one XLA program), so this runtime targets what the reference
+actually used the DAG for: HOST-side streaming through model partitions —
+serving pipelines where stages (tokenize → predictor shard → detokenize)
+overlap across in-flight requests. Interceptors are threads; edges are the
+native bounded channels (csrc/channel.h) — the same byte-channel the C++
+data feed uses, so backpressure is real (a full channel blocks the producer),
+and payloads cross stages as pickled messages exactly like the reference's
+protobuf InterceptorMessage.
+"""
+from __future__ import annotations
+
+import pickle
+import threading
+from typing import Callable, Dict, List, Optional
+
+from ..framework.native import Channel
+
+_DATA, _STOP = 0, 1
+
+
+class TaskNode:
+    """One node of the DAG (reference task_node.h). ``role`` is informative
+    ('source'/'compute'/'sink'/'amplifier'); ``fn`` maps payload → payload
+    for compute nodes, payload → list[payload] for amplifiers."""
+
+    def __init__(self, fn: Optional[Callable] = None, role: str = "compute",
+                 task_id: Optional[int] = None, max_run_times: int = 1, name: str = ""):
+        self.fn = fn
+        self.role = role
+        self.task_id = task_id
+        self.max_run_times = max_run_times
+        self.name = name or role
+        self.downstream: List["TaskNode"] = []
+
+    def add_downstream_task(self, node: "TaskNode"):
+        self.downstream.append(node)
+        return node
+
+
+class _Interceptor(threading.Thread):
+    """One actor: drains its inbox channel, applies the node fn, forwards to
+    the outbox (reference interceptor.h Compute/Amplifier interceptors).
+    A STOP message (carrying the count of messages sent) flows through and
+    shuts the chain down in order."""
+
+    def __init__(self, node: TaskNode, inbox: Channel, outbox: Optional[Channel],
+                 errors: list):
+        super().__init__(daemon=True, name=f"interceptor-{node.name}")
+        self.node = node
+        self.inbox = inbox
+        self.outbox = outbox
+        self.errors = errors
+
+    def run(self):
+        try:
+            while True:
+                raw = self.inbox.get()
+                if raw is None:  # channel closed
+                    break
+                kind, seq, payload = pickle.loads(raw)
+                if kind == _STOP:
+                    if self.outbox is not None:
+                        self.outbox.put(raw)
+                    break
+                outs = [payload]
+                if self.node.fn is not None:
+                    out = self.node.fn(payload)
+                    outs = list(out) if self.node.role == "amplifier" else [out]
+                if self.outbox is not None:
+                    for j, o in enumerate(outs):
+                        self.outbox.put(pickle.dumps((_DATA, (seq, j), o)))
+        except Exception as e:  # surfaced by Carrier.wait
+            self.errors.append((self.node.name, e))
+            if self.outbox is not None:
+                self.outbox.put(pickle.dumps((_STOP, -1, None)))
+
+
+class Carrier:
+    """Owns the interceptors of one (linear or fan-out-free) task chain and
+    the channels between them (reference carrier.h). ``run`` feeds payloads
+    in, returns outputs in order."""
+
+    def __init__(self, chain: List[TaskNode], capacity: int = 8):
+        self.chain = chain
+        self.capacity = capacity
+
+    def run(self, feeds) -> list:
+        channels = [Channel(self.capacity) for _ in range(len(self.chain) + 1)]
+        errors: list = []
+        actors = [
+            _Interceptor(node, channels[i], channels[i + 1], errors)
+            for i, node in enumerate(self.chain)
+        ]
+        for a in actors:
+            a.start()
+
+        feeds = list(feeds)
+
+        def feed():  # the source side runs in its own thread so a full
+            for seq, payload in enumerate(feeds):  # pipeline backpressures
+                channels[0].put(pickle.dumps((_DATA, seq, payload)))  # here,
+            channels[0].put(pickle.dumps((_STOP, len(feeds), None)))  # not in
+        feeder = threading.Thread(target=feed, daemon=True)  # the collector
+        feeder.start()
+        outs = []
+        while True:
+            raw = channels[-1].get()
+            if raw is None:
+                break
+            kind, seq, payload = pickle.loads(raw)
+            if kind == _STOP:
+                break
+            outs.append((seq, payload))
+        # close before joining: a failed stage leaves upstream actors (and the
+        # feeder) blocked in put() on full channels — closing unblocks them so
+        # the error surfaces immediately instead of after join timeouts
+        for ch in channels:
+            ch.close()
+        feeder.join(timeout=30)
+        for a in actors:
+            a.join(timeout=30)
+        if errors:
+            name, exc = errors[0]
+            raise RuntimeError(f"interceptor '{name}' failed: {exc!r}") from exc
+        outs.sort(key=lambda t: t[0] if isinstance(t[0], tuple) else (t[0], 0))
+        return [p for _, p in outs]
+
+
+class FleetExecutor:
+    """User entry (reference fleet_executor.h FleetExecutor::Init/Run): build
+    a chain of TaskNodes, then ``run(feeds)`` streams payloads through with
+    stage overlap. For model stages pass a jitted callable (e.g. a
+    ``paddle.inference`` Predictor's run) as the node fn."""
+
+    def __init__(self, exe_desc: Optional[dict] = None):
+        self.exe_desc = exe_desc or {}
+        self._carrier: Optional[Carrier] = None
+
+    def init(self, task_nodes: List[TaskNode], capacity: int = 8):
+        # validate: linear chain (the reference's common serving topology);
+        # amplifiers may expand, sinks must terminate
+        for i, n in enumerate(task_nodes[:-1]):
+            if n.downstream and task_nodes[i + 1] not in n.downstream:
+                raise ValueError(f"task {n.name} downstream edges disagree with the chain order")
+        self._carrier = Carrier(task_nodes, capacity)
+        return self
+
+    def run(self, feeds) -> list:
+        if self._carrier is None:
+            raise RuntimeError("FleetExecutor.init(task_nodes) first")
+        return self._carrier.run(list(feeds))

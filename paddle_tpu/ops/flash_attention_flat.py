@@ -35,9 +35,24 @@ import functools
 import jax
 import jax.numpy as jnp
 
+# module-level so the autotune sweep (tpu_runbook.py sweep) can override;
+# defaults chosen on v5 lite for the flagship shape
 _BLOCK_Q = 512
 _BLOCK_K_FWD = 512
 _BLOCK_K_BWD = 256
+
+
+def set_blocks(block_q=None, block_k_fwd=None, block_k_bwd=None):
+    """Override kernel block sizes (autotune hook). Returns prior values."""
+    global _BLOCK_Q, _BLOCK_K_FWD, _BLOCK_K_BWD
+    prior = (_BLOCK_Q, _BLOCK_K_FWD, _BLOCK_K_BWD)
+    if block_q:
+        _BLOCK_Q = int(block_q)
+    if block_k_fwd:
+        _BLOCK_K_FWD = int(block_k_fwd)
+    if block_k_bwd:
+        _BLOCK_K_BWD = int(block_k_bwd)
+    return prior
 _MAX_SEQ = 2048
 # Mosaic compile time blows up with the fused-bwd dq accumulator block
 # (full-sequence [s, hg*d] f32, read-modify-write across k-steps): 1M elements
@@ -219,6 +234,9 @@ def _fwd_call(operands, b, s, h, d, dtype, causal, packed):
     G = h // hg  # column blocks per tensor
     block_q = min(_BLOCK_Q, s)
     block_k = min(_BLOCK_K_FWD, s)
+    if s % block_q or s % block_k:
+        raise ValueError(f"block sizes ({block_q}, {block_k}) must divide s={s}; "
+                         f"fix via set_blocks()")
     scale = 1.0 / (d ** 0.5)
 
     if packed:
@@ -272,6 +290,9 @@ def _bwd_call(operands, b, s, h, d, dtype, o, lse, do, causal, packed):
     G = h // hg
     block_q = min(_BLOCK_Q, s)
     block_k = min(_BLOCK_K_BWD, s)
+    if s % block_q or s % block_k:
+        raise ValueError(f"block sizes ({block_q}, {block_k}) must divide s={s}; "
+                         f"fix via set_blocks()")
     scale = 1.0 / (d ** 0.5)
 
     # di = rowsum(dO ∘ O) reshaped to the [b, G, s, hg] stat layout

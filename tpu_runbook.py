@@ -3,6 +3,7 @@ command. (Round 4: the axon tunnel dropped ~04:45 and stayed down; all CPU
 work landed, these are the on-chip steps.)
 
     python tpu_runbook.py all        # run everything below in order
+    python tpu_runbook.py sweep      # 0. flat-kernel block-size sweep (not in 'all')
     python tpu_runbook.py flat       # 1. flat-lane flash kernel parity + perf
     python tpu_runbook.py step       # 2. flagship step time (flag off vs on)
     python tpu_runbook.py decode     # 3. decode throughput row
@@ -164,8 +165,51 @@ def check_decode():
                                  "decode_tok_per_s": round(32 * 384 / dt)}}))
 
 
+def check_sweep():
+    """Block-size sweep for the flat kernels on the flagship attention shape.
+    Prints per-config fwd+bwd wall time; apply the winner via
+    flash_attention_flat.set_blocks (and bake it in if it beats the default)."""
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu.ops.flash_attention_flat as ff
+
+    b, s, h, d = 8, 1024, 16, 64
+    rng = np.random.default_rng(0)
+    q, k, v, g = (jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.bfloat16) for _ in range(4))
+    best = (None, 1e9)
+    prior = None
+    for bq in (256, 512):
+        for bkf in (512, 1024):
+            for bkb in (128, 256, 512):
+                p = ff.set_blocks(bq, bkf, bkb)
+                prior = prior or p
+                try:
+                    f = jax.jit(jax.value_and_grad(
+                        lambda q, k, v, g: jnp.sum(ff.flash_flat(q, k, v, True).astype(jnp.float32)
+                                                   * g.astype(jnp.float32)), argnums=(0, 1, 2)))
+                    out = f(q, k, v, g)
+                    jax.block_until_ready(out)
+                    t0 = time.perf_counter()
+                    for _ in range(20):
+                        out = f(q, k, v, g)
+                    jax.block_until_ready(out)
+                    dt = (time.perf_counter() - t0) / 20
+                except Exception as exc:
+                    print(json.dumps({"blocks": [bq, bkf, bkb], "error": str(exc)[:120]}))
+                    continue
+                print(json.dumps({"blocks": [bq, bkf, bkb], "fwd_bwd_ms": round(dt * 1000, 2)}))
+                if dt < best[1]:
+                    best = ((bq, bkf, bkb), dt)
+    print(json.dumps({"sweep_best": best[0], "ms": round(best[1] * 1000, 2)}))
+    if prior:
+        ff.set_blocks(*prior)
+
+
 def main():
     mode = sys.argv[1] if len(sys.argv) > 1 else "all"
+    if mode == "sweep":
+        check_sweep()
     if mode in ("flat", "all"):
         check_flat()
     if mode in ("step", "all"):
