@@ -4,3 +4,4 @@ from .dtype import convert_dtype, get_default_dtype, set_default_dtype, to_jax_d
 from .flags import define_flag, flag, get_flags, set_flags
 from .random import get_rng_state, rng_scope, seed, set_rng_state, split_key
 from .selected_rows import SelectedRows
+from .string_tensor import FasterTokenizer, StringTensor

@@ -1,0 +1,173 @@
+"""StringTensor + FasterTokenizer: host-side text-in-the-graph parity.
+
+Parity anchors: paddle/phi/core/string_tensor.h (pstring DenseTensor sibling)
+and operators/string/faster_tokenizer_op.cc (BERT-style tokenization as an
+in-graph op so a served model accepts raw strings).
+
+TPU framing: strings never touch the accelerator — the reference keeps them
+on CPU too. StringTensor is a shaped host container; FasterTokenizer is a
+host-side stage producing the int32 (input_ids, token_type_ids) arrays the
+device graph consumes. It slots directly into a FleetExecutor serving chain
+ahead of a Predictor stage.
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["StringTensor", "FasterTokenizer"]
+
+
+class StringTensor:
+    """A shaped array of strings (reference phi::StringTensor)."""
+
+    def __init__(self, data, shape: Optional[Sequence[int]] = None):
+        arr = np.asarray(data, dtype=object)
+        if shape is not None:
+            arr = arr.reshape(tuple(shape))
+        self._arr = arr
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return tuple(self._arr.shape)
+
+    @property
+    def ndim(self) -> int:
+        return self._arr.ndim
+
+    def numel(self) -> int:
+        return int(self._arr.size)
+
+    def reshape(self, shape) -> "StringTensor":
+        return StringTensor(self._arr.reshape(tuple(shape)))
+
+    def __getitem__(self, idx):
+        out = self._arr[idx]
+        return StringTensor(out) if isinstance(out, np.ndarray) else out
+
+    def tolist(self) -> List:
+        return self._arr.tolist()
+
+    def __iter__(self):
+        return iter(self._arr)
+
+    def __len__(self):
+        return len(self._arr)
+
+    def __repr__(self):
+        return f"StringTensor(shape={self.shape}, data={self._arr.tolist()!r})"
+
+
+def _basic_tokenize(text: str, do_lower_case: bool) -> List[str]:
+    """Whitespace + punctuation split (reference BasicTokenizer in
+    faster_tokenizer_op.h, minus CJK special-casing)."""
+    if do_lower_case:
+        text = text.lower()
+    out: List[str] = []
+    buf = []
+    for ch in text:
+        if ch.isspace():
+            if buf:
+                out.append("".join(buf))
+                buf = []
+        elif not ch.isalnum():
+            if buf:
+                out.append("".join(buf))
+                buf = []
+            out.append(ch)
+        else:
+            buf.append(ch)
+    if buf:
+        out.append("".join(buf))
+    return out
+
+
+class FasterTokenizer:
+    """BERT WordPiece tokenizer as a host op (reference
+    faster_tokenizer_op.cc): greedy longest-match-first subwords with ##
+    continuation, [CLS]/[SEP] framing, pair encoding with token_type_ids,
+    padding + truncation to fixed shapes for the device graph."""
+
+    def __init__(self, vocab: Dict[str, int], do_lower_case: bool = True,
+                 unk_token: str = "[UNK]", cls_token: str = "[CLS]",
+                 sep_token: str = "[SEP]", pad_token: str = "[PAD]",
+                 max_input_chars_per_word: int = 100):
+        self.vocab = dict(vocab)
+        self.do_lower_case = do_lower_case
+        self.unk, self.cls, self.sep, self.pad = unk_token, cls_token, sep_token, pad_token
+        for tok in (unk_token, cls_token, sep_token, pad_token):
+            if tok not in self.vocab:
+                raise ValueError(f"special token {tok!r} missing from vocab")
+        self.max_chars = max_input_chars_per_word
+
+    def _wordpiece(self, word: str) -> List[int]:
+        if len(word) > self.max_chars:
+            return [self.vocab[self.unk]]
+        ids, start = [], 0
+        while start < len(word):
+            end = len(word)
+            cur = None
+            while start < end:
+                piece = word[start:end]
+                if start > 0:
+                    piece = "##" + piece
+                if piece in self.vocab:
+                    cur = self.vocab[piece]
+                    break
+                end -= 1
+            if cur is None:
+                return [self.vocab[self.unk]]
+            ids.append(cur)
+            start = end
+        return ids
+
+    def _encode_one(self, text: str) -> List[int]:
+        ids: List[int] = []
+        for w in _basic_tokenize(text, self.do_lower_case):
+            ids.extend(self._wordpiece(w))
+        return ids
+
+    def __call__(self, text, text_pair=None, max_seq_len: int = 128,
+                 pad_to_max_seq_len: bool = True):
+        """texts: StringTensor | str | list[str] → (input_ids, token_type_ids)
+        int32 [batch, max_seq_len] numpy arrays (the device-graph inputs)."""
+        if isinstance(text, str):
+            text = [text]
+        if isinstance(text_pair, str):
+            text_pair = [text_pair]
+        texts = [str(s) for s in text]
+        pairs = None
+        if text_pair is not None:
+            pairs = [str(s) for s in text_pair]
+            if len(pairs) != len(texts):
+                raise ValueError("text and text_pair batch sizes differ")
+        n_special = 3 if pairs is not None else 2  # [CLS] a [SEP] (b [SEP])
+        if max_seq_len < n_special + (2 if pairs is not None else 1):
+            raise ValueError(f"max_seq_len={max_seq_len} leaves no room for "
+                             f"content beside the {n_special} special tokens")
+        cls_id, sep_id, pad_id = self.vocab[self.cls], self.vocab[self.sep], self.vocab[self.pad]
+        rows, segs = [], []
+        for i, t in enumerate(texts):
+            a = self._encode_one(t)
+            b = self._encode_one(pairs[i]) if pairs is not None else None
+            # truncate longest-first to fit specials (reference truncation);
+            # an empty pair text keeps its [SEP]/segment framing so batch
+            # rows stay consistently shaped
+            budget = max_seq_len - n_special
+            while len(a) + len(b or []) > budget:
+                tgt = a if len(a) >= len(b or []) else b
+                tgt.pop()
+            ids = [cls_id] + a + [sep_id] + (b + [sep_id] if b is not None else [])
+            seg = [0] * (len(a) + 2) + ([1] * (len(b) + 1) if b is not None else [])
+            if pad_to_max_seq_len:
+                ids += [pad_id] * (max_seq_len - len(ids))
+                seg += [0] * (max_seq_len - len(seg))
+            rows.append(ids)
+            segs.append(seg)
+        width = max_seq_len if pad_to_max_seq_len else max((len(r) for r in rows), default=0)
+        rows = [r + [pad_id] * (width - len(r)) for r in rows]
+        segs = [s + [0] * (width - len(s)) for s in segs]
+        out_ids = np.asarray(rows, np.int32).reshape(len(rows), width)
+        out_segs = np.asarray(segs, np.int32).reshape(len(rows), width)
+        return out_ids, out_segs

@@ -1,0 +1,96 @@
+"""StringTensor + FasterTokenizer (reference phi/core/string_tensor.h,
+operators/string/faster_tokenizer_op.cc)."""
+import numpy as np
+import pytest
+
+from paddle_tpu.framework import FasterTokenizer, StringTensor
+
+VOCAB = {tok: i for i, tok in enumerate(
+    ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "the", "cat", "sat", "un", "##happy",
+     "##ness", "happy", ",", "!", "deep", "##learn", "##ing"])}
+
+
+def test_string_tensor_shape_ops():
+    st = StringTensor(["a", "b", "c", "d"], shape=[2, 2])
+    assert st.shape == (2, 2) and st.ndim == 2 and st.numel() == 4
+    assert st[0, 1] == "b"
+    flat = st.reshape([4])
+    assert flat.tolist() == ["a", "b", "c", "d"]
+    assert [s for s in flat] == ["a", "b", "c", "d"]
+    assert len(flat) == 4
+
+
+def test_tokenizer_wordpiece_and_specials():
+    tok = FasterTokenizer(VOCAB)
+    ids, segs = tok(["The cat sat"], max_seq_len=8)
+    assert ids.shape == (1, 8) and ids.dtype == np.int32
+    # [CLS] the cat sat [SEP] pad pad pad
+    np.testing.assert_array_equal(
+        ids[0], [VOCAB["[CLS]"], VOCAB["the"], VOCAB["cat"], VOCAB["sat"],
+                 VOCAB["[SEP]"], 0, 0, 0])
+    assert segs.sum() == 0
+
+
+def test_tokenizer_subwords_and_unk():
+    tok = FasterTokenizer(VOCAB)
+    ids, _ = tok(["unhappyness zzz"], max_seq_len=8)
+    want = [VOCAB["[CLS]"], VOCAB["un"], VOCAB["##happy"], VOCAB["##ness"],
+            VOCAB["[UNK]"], VOCAB["[SEP]"], 0, 0]
+    np.testing.assert_array_equal(ids[0], want)
+
+
+def test_tokenizer_pairs_and_truncation():
+    tok = FasterTokenizer(VOCAB)
+    ids, segs = tok(["the cat"], text_pair=["happy happy happy happy"], max_seq_len=8)
+    assert ids.shape == (1, 8)
+    # segment 1 marks the pair span (incl. its [SEP])
+    assert segs[0].sum() > 0
+    sep = VOCAB["[SEP]"]
+    assert list(ids[0]).count(sep) == 2
+    # punctuation splits
+    ids2, _ = tok(["the cat, sat!"], max_seq_len=10)
+    assert VOCAB[","] in ids2[0] and VOCAB["!"] in ids2[0]
+
+
+def test_tokenizer_string_tensor_input_and_serving_chain():
+    from paddle_tpu.distributed import FleetExecutor, TaskNode
+
+    tok = FasterTokenizer(VOCAB)
+    st = StringTensor(["the cat", "happy cat sat"])
+    ids, _ = tok(st, max_seq_len=6)
+    assert ids.shape == (2, 6)
+
+    # tokenizer as the pre-stage of a serving chain
+    fe = FleetExecutor().init([
+        TaskNode(lambda s: tok([s], max_seq_len=6)[0], name="tokenize"),
+        TaskNode(lambda ids: int(ids.sum()), name="consume"),
+    ])
+    outs = fe.run(["the cat", "sat"])
+    assert outs == [int(tok(["the cat"], max_seq_len=6)[0].sum()),
+                    int(tok(["sat"], max_seq_len=6)[0].sum())]
+
+
+def test_missing_special_token_raises():
+    with pytest.raises(ValueError):
+        FasterTokenizer({"the": 0})
+
+
+def test_edge_cases_from_review():
+    tok = FasterTokenizer(VOCAB)
+    # plain-str input is wrapped, not char-iterated
+    ids, _ = tok("the cat", max_seq_len=6)
+    assert ids.shape == (1, 6) and ids[0, 1] == VOCAB["the"]
+    # too-small max_seq_len raises instead of IndexError
+    with pytest.raises(ValueError):
+        tok(["the cat"], max_seq_len=2)
+    # empty batch keeps rank-2 shape
+    ids, segs = tok([], max_seq_len=8)
+    assert ids.shape == (0, 8) and segs.shape == (0, 8)
+    # empty pair text keeps the pair framing (two [SEP]s per row)
+    ids, segs = tok(["the cat", "the cat"], text_pair=["sat", ""], max_seq_len=8)
+    sep = VOCAB["[SEP]"]
+    assert list(ids[0]).count(sep) == 2 and list(ids[1]).count(sep) == 2
+    assert segs[1].sum() > 0  # the empty pair's [SEP] is segment 1
+    # apostrophes split like the reference BasicTokenizer
+    ids, _ = tok(["don't"], max_seq_len=8)
+    assert (ids[0] == VOCAB["[UNK]"]).sum() >= 2  # don / ' / t all unk here
