@@ -52,7 +52,16 @@ def autotune_config():
 
 
 class autotune:
-    """incubate.autotune.set_config parity."""
+    """Kernel autotuning (reference python/paddle/incubate/autotune.py
+    set_config + phi/kernels/autotune/ AlgorithmsCache). On TPU, XLA
+    autotunes its own fusions; what remains tunable are OUR Pallas kernel
+    block sizes. ``tune_flash_blocks`` times candidate (block_q, block_k_fwd,
+    block_k_bwd) configs for a given attention shape on the live backend,
+    applies the winner via flash_attention_flat.set_blocks, and persists it
+    (AlgorithmsCache parity) keyed by device kind + shape; ``load_tuned``
+    re-applies a cached winner in a fresh process."""
+
+    CACHE = ".autotune_cache.json"
 
     @staticmethod
     def set_config(config=None):
@@ -64,6 +73,119 @@ class autotune:
         kern = config.get("kernel", {})
         if "enable" in kern:
             set_flags({"FLAGS_use_flash_attention": bool(kern["enable"])})
+
+    @staticmethod
+    def _cache_path(path=None):
+        import os
+
+        return path or os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))), autotune.CACHE)
+
+    @staticmethod
+    def _cache_key(shape):
+        import jax
+
+        d0 = jax.devices()[0]
+        kind = getattr(d0, "device_kind", None) or d0.platform
+        return f"{kind}/b{shape[0]}s{shape[1]}h{shape[2]}d{shape[3]}"
+
+    @staticmethod
+    def tune_flash_blocks(shape=(8, 1024, 16, 64), iters=10, cache_path=None,
+                          candidates=None, on_result=None, _timer=None):
+        """Sweep block configs for the flat flash kernels on ``shape``
+        (b, s, h, d); apply + persist the fastest. ``on_result(blocks, dt)``
+        is called per successful candidate (progress reporting). Returns the
+        winning (block_q, block_k_fwd, block_k_bwd) or None when the kernels
+        are unavailable on this backend (CPU test meshes)."""
+        import time
+
+        from ..ops import flash_attention_flat as ff
+
+        b, s, h, d = shape
+        # packed=False: the superset gate (full-dim head groups are legal
+        # unpacked); block sizes are shared globals, so tuning the unpacked
+        # path tunes the packed dispatch too
+        if _timer is None and not ff.enabled((b, s, 3, h, d), packed=False):
+            return None
+        cands = candidates or [(bq, bkf, bkb)
+                               for bq in (256, 512) for bkf in (512, 1024)
+                               for bkb in (128, 256)]
+
+        def default_timer(blocks):
+            import jax
+            import jax.numpy as jnp
+            import numpy as np
+
+            rng = np.random.default_rng(0)
+            q, k, v, g = (jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.bfloat16)
+                          for _ in range(4))
+            f = jax.jit(jax.value_and_grad(
+                lambda q, k, v, g: jnp.sum(ff.flash_flat(q, k, v, True).astype(jnp.float32)
+                                           * g.astype(jnp.float32)), argnums=(0, 1, 2)))
+            jax.block_until_ready(f(q, k, v, g))
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out = f(q, k, v, g)
+            jax.block_until_ready(out)
+            return (time.perf_counter() - t0) / iters
+
+        timer = _timer or default_timer
+        prior = ff.set_blocks()  # read current (no-op set)
+        best, best_t = None, float("inf")
+        seen = set()
+        for blocks in cands:
+            eff = (min(blocks[0], s), min(blocks[1], s), min(blocks[2], s))
+            if any(s % e for e in eff) or eff in seen:
+                continue  # indivisible, or clamps to an already-timed config
+            seen.add(eff)
+            ff.set_blocks(*blocks)
+            try:
+                dt = timer(blocks)
+            except Exception:
+                continue
+            if on_result is not None:
+                on_result(blocks, dt)
+            if dt < best_t:
+                best, best_t = blocks, dt
+        if best is None:
+            ff.set_blocks(*prior)
+            return None
+        ff.set_blocks(*best)
+        autotune.save_tuned(shape, best, cache_path)
+        return tuple(best)
+
+    @staticmethod
+    def save_tuned(shape, blocks, cache_path=None):
+        import json
+        import os
+
+        path = autotune._cache_path(cache_path)
+        try:
+            cache = json.load(open(path))
+        except Exception:
+            cache = {}
+        cache[autotune._cache_key(shape)] = list(blocks)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:  # atomic replace: concurrent writers
+            json.dump(cache, f)    # cannot interleave/corrupt the cache
+        os.replace(tmp, path)
+
+    @staticmethod
+    def load_tuned(shape=(8, 1024, 16, 64), cache_path=None):
+        """Apply a previously tuned config for ``shape``; True if found."""
+        import json
+
+        from ..ops import flash_attention_flat as ff
+
+        try:
+            cache = json.load(open(autotune._cache_path(cache_path)))
+        except Exception:
+            return False
+        best = cache.get(autotune._cache_key(shape))
+        if not best:
+            return False
+        ff.set_blocks(*best)
+        return True
 
 
 class _PrimState:

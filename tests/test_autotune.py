@@ -1,0 +1,61 @@
+"""Kernel autotune cache (reference python/paddle/incubate/autotune.py +
+phi/kernels/autotune AlgorithmsCache): sweep, apply, persist, reload."""
+import os
+import tempfile
+
+import paddle_tpu  # noqa: F401
+import paddle_tpu.ops.flash_attention_flat as ff
+from paddle_tpu.incubate import autotune
+
+
+def setup_function(_):
+    ff.set_blocks(512, 512, 256)
+
+
+def teardown_function(_):
+    ff.set_blocks(512, 512, 256)
+
+
+def test_tune_applies_and_persists_fastest():
+    times = {(256, 1024, 128): 0.001}
+    timer = lambda blocks: times.get(tuple(blocks), 0.01)
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "cache.json")
+        best = autotune.tune_flash_blocks(cache_path=p, _timer=timer)
+        assert best == (256, 1024, 128)
+        assert ff.set_blocks() == (256, 1024, 128)  # applied in-process
+
+        ff.set_blocks(512, 512, 256)
+        assert autotune.load_tuned(cache_path=p) is True  # fresh-process path
+        assert ff.set_blocks() == (256, 1024, 128)
+        # unknown shape: no-op
+        assert autotune.load_tuned(shape=(1, 512, 4, 64), cache_path=p) is False
+
+
+def test_tune_declines_on_cpu_backend():
+    # flat kernels are TPU-only; without an injected timer the tuner no-ops
+    assert autotune.tune_flash_blocks() is None
+
+
+def test_failing_candidates_skipped():
+    calls = []
+
+    def timer(blocks):
+        calls.append(tuple(blocks))
+        if blocks[0] == 256:
+            raise RuntimeError("compile failed")
+        return 0.01
+
+    with tempfile.TemporaryDirectory() as d:
+        best = autotune.tune_flash_blocks(cache_path=os.path.join(d, "c.json"), _timer=timer)
+        assert best is not None and best[0] == 512
+    assert any(c[0] == 256 for c in calls)
+
+
+def test_set_config_flag_passthrough():
+    from paddle_tpu.framework.flags import flag
+
+    autotune.set_config({"kernel": {"enable": False}})
+    assert flag("FLAGS_use_flash_attention") is False
+    autotune.set_config({"kernel": {"enable": True}})
+    assert flag("FLAGS_use_flash_attention") is True

@@ -166,44 +166,20 @@ def check_decode():
 
 
 def check_sweep():
-    """Block-size sweep for the flat kernels on the flagship attention shape.
-    Prints per-config fwd+bwd wall time; apply the winner via
-    flash_attention_flat.set_blocks (and bake it in if it beats the default)."""
-    import jax
-    import jax.numpy as jnp
+    """Block-size sweep for the flat kernels on the flagship attention shape
+    via incubate.autotune (which applies + persists the winner; load_tuned()
+    re-applies it in later processes)."""
+    from paddle_tpu.framework.flags import _REGISTRY
+    from paddle_tpu.incubate import autotune
 
-    import paddle_tpu.ops.flash_attention_flat as ff
-
-    b, s, h, d = 8, 1024, 16, 64
-    rng = np.random.default_rng(0)
-    q, k, v, g = (jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.bfloat16) for _ in range(4))
-    best = (None, 1e9)
-    prior = None
-    for bq in (256, 512):
-        for bkf in (512, 1024):
-            for bkb in (128, 256, 512):
-                p = ff.set_blocks(bq, bkf, bkb)
-                prior = prior or p
-                try:
-                    f = jax.jit(jax.value_and_grad(
-                        lambda q, k, v, g: jnp.sum(ff.flash_flat(q, k, v, True).astype(jnp.float32)
-                                                   * g.astype(jnp.float32)), argnums=(0, 1, 2)))
-                    out = f(q, k, v, g)
-                    jax.block_until_ready(out)
-                    t0 = time.perf_counter()
-                    for _ in range(20):
-                        out = f(q, k, v, g)
-                    jax.block_until_ready(out)
-                    dt = (time.perf_counter() - t0) / 20
-                except Exception as exc:
-                    print(json.dumps({"blocks": [bq, bkf, bkb], "error": str(exc)[:120]}))
-                    continue
-                print(json.dumps({"blocks": [bq, bkf, bkb], "fwd_bwd_ms": round(dt * 1000, 2)}))
-                if dt < best[1]:
-                    best = ((bq, bkf, bkb), dt)
-    print(json.dumps({"sweep_best": best[0], "ms": round(best[1] * 1000, 2)}))
-    if prior:
-        ff.set_blocks(*prior)
+    _REGISTRY["FLAGS_flash_flat"] = True
+    cands = [(bq, bkf, bkb) for bq in (256, 512) for bkf in (512, 1024)
+             for bkb in (128, 256, 512)]
+    best = autotune.tune_flash_blocks(
+        shape=(8, 1024, 16, 64), iters=20, candidates=cands,
+        on_result=lambda blocks, dt: print(json.dumps(
+            {"blocks": list(blocks), "fwd_bwd_ms": round(dt * 1000, 2)})))
+    print(json.dumps({"sweep_best": list(best) if best else None}))
 
 
 def main():
