@@ -57,7 +57,135 @@ from . import onnx  # noqa: F401,E402
 from .static import disable_static, enable_static, in_dynamic_mode  # noqa: E402
 from .framework.io import load, save  # noqa: E402
 from .hapi.model import Model  # noqa: E402
+from .hapi.dynamic_flops import flops  # noqa: E402
 from .nn.layer.container import ParameterList  # noqa: E402
+from .framework.param_attr import (  # noqa: E402
+    CPUPlace,
+    CUDAPinnedPlace,
+    CUDAPlace,
+    NPUPlace,
+    ParamAttr,
+    TPUPlace,
+)
+from .distributed import DataParallel  # noqa: E402
+
+# dtype aliases (reference: paddle.float32 etc. are framework dtypes; here
+# the framework dtype IS the numpy/jax dtype object)
+import numpy as _np  # noqa: E402
+
+dtype = _np.dtype
+bool = _np.dtype("bool")  # noqa: A001 — paddle exports these exact names
+uint8 = _np.dtype("uint8")
+int8 = _np.dtype("int8")
+int16 = _np.dtype("int16")
+int32 = _np.dtype("int32")
+int64 = _np.dtype("int64")
+float16 = _np.dtype("float16")
+float32 = _np.dtype("float32")
+float64 = _np.dtype("float64")
+complex64 = _np.dtype("complex64")
+complex128 = _np.dtype("complex128")
+import jax.numpy as _jnp  # noqa: E402
+
+bfloat16 = _jnp.bfloat16
+
+
+def is_floating_point(x):
+    import jax.numpy as jnp
+
+    return jnp.issubdtype(tensor._helpers.ensure_tensor(x)._value.dtype, jnp.floating)
+
+
+def is_integer(x):
+    import jax.numpy as jnp
+
+    return jnp.issubdtype(tensor._helpers.ensure_tensor(x)._value.dtype, jnp.integer)
+
+
+def is_complex(x):
+    import jax.numpy as jnp
+
+    return jnp.issubdtype(tensor._helpers.ensure_tensor(x)._value.dtype, jnp.complexfloating)
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None, sci_mode=None, linewidth=None):
+    """numpy printoptions passthrough (reference paddle.set_printoptions)."""
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    _np.set_printoptions(**kw)
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Batch a sample generator (reference paddle.batch / fluid batch.py)."""
+
+    def gen():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return gen
+
+
+def summary(net, input_size=None, dtypes=None):
+    """paddle.summary parity: wraps hapi Model.summary for a bare Layer."""
+    return Model(net).summary(input_size)
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False, default_initializer=None):
+    """Standalone parameter (reference paddle.create_parameter /
+    fluid.layers.create_parameter)."""
+    from .nn.layer.base import Layer
+
+    holder = Layer()
+    holder._dtype = str(dtype)
+    p = holder.create_parameter(list(shape), dtype=str(dtype), attr=attr, is_bias=is_bias,
+                                default_initializer=default_initializer)
+    if name:
+        p.name = name
+    return p
+
+
+def check_shape(shape):
+    """Validate a shape argument (reference fluid/layers/utils.py:378)."""
+    if isinstance(shape, Tensor):
+        return
+    for d in shape:
+        if not isinstance(d, (int, _np.integer)) and not isinstance(d, Tensor):
+            raise TypeError(f"shape entries must be ints or Tensors, got {type(d).__name__}")
+        if isinstance(d, (int, _np.integer)) and d < -1:
+            raise ValueError(f"shape dims must be >= -1, got {d}")
+
+
+def disable_signal_handler():
+    """No-op (reference disables its C++ signal interceptors; this runtime
+    installs none)."""
+
+
+def get_cuda_rng_state():
+    """Accelerator RNG state (maps to the framework RNG on TPU)."""
+    from .framework.random import get_rng_state
+
+    return get_rng_state()
+
+
+def set_cuda_rng_state(state):
+    from .framework.random import set_rng_state
+
+    set_rng_state(state)
 
 
 def grad(outputs, inputs, grad_outputs=None, retain_graph=False, create_graph=False, allow_unused=False):

@@ -1,0 +1,76 @@
+"""ParamAttr + device Place classes.
+
+Parity anchors: python/paddle/fluid/param_attr.py (ParamAttr) and the
+pybind Place types (paddle/phi/common/place.h). On TPU, Places are identity
+markers — placement is the mesh/sharding's job — but the constructors exist
+so reference code (`paddle.CPUPlace()`, `place=...` kwargs) runs unchanged.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+
+class ParamAttr:
+    """Parameter attribute bundle (reference fluid/param_attr.py:31):
+    name, initializer, learning_rate (per-param LR scale), regularizer,
+    trainable. Consumed by Layer.create_parameter."""
+
+    def __init__(self, name: Optional[str] = None, initializer: Any = None,
+                 learning_rate: float = 1.0, regularizer: Any = None,
+                 trainable: bool = True, do_model_average: bool = True,
+                 need_clip: bool = True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.do_model_average = do_model_average
+        self.need_clip = need_clip
+
+
+class _Place:
+    _kind = "unknown"
+
+    def __init__(self, device_id: int = 0):
+        self.device_id = int(device_id)
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.device_id})"
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self.device_id == other.device_id
+
+    def __hash__(self):
+        return hash((type(self).__name__, self.device_id))
+
+
+class CPUPlace(_Place):
+    _kind = "cpu"
+
+    def __init__(self):
+        super().__init__(0)
+
+    def __repr__(self):
+        return "CPUPlace"
+
+
+class TPUPlace(_Place):
+    _kind = "tpu"
+
+
+class CUDAPlace(_Place):
+    """Accepted for source compatibility; on this framework it denotes 'the
+    accelerator' (the TPU chip) — there is no CUDA."""
+
+    _kind = "tpu"
+
+
+class CUDAPinnedPlace(_Place):
+    _kind = "cpu"
+
+    def __init__(self):
+        super().__init__(0)
+
+
+class NPUPlace(_Place):
+    _kind = "tpu"

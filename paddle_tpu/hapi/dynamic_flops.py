@@ -1,0 +1,68 @@
+"""Dynamic FLOPs counter (reference python/paddle/hapi/dynamic_flops.py:25):
+forward hooks on leaf layers accumulate multiply-add counts for a given
+input_size; paddle.flops(net, input_size) returns the total."""
+from __future__ import annotations
+
+import numpy as np
+
+
+def _count(layer, x_shape, y_shape, custom_ops=None):
+    from ..nn.layer.common import Linear
+    from ..nn.layer.conv import Conv2D
+    from ..nn.layer.norm import _BatchNormBase
+
+    if custom_ops and type(layer) in custom_ops:
+        return int(custom_ops[type(layer)](layer, x_shape, y_shape))
+    if isinstance(layer, Conv2D):
+        w = layer.weight._value
+        out_elems = int(np.prod(y_shape))
+        kh, kw, cin = int(w.shape[2]), int(w.shape[3]), int(w.shape[1])
+        return out_elems * cin * kh * kw // max(layer.groups, 1) * max(layer.groups, 1)
+    if isinstance(layer, Linear):
+        w = layer.weight._value
+        batch_elems = int(np.prod(x_shape)) // int(w.shape[0])
+        return batch_elems * int(w.shape[0]) * int(w.shape[1])
+    if isinstance(layer, _BatchNormBase):
+        return 2 * int(np.prod(y_shape))
+    return 0
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """Count multiply-adds of one forward at ``input_size`` (incl. batch dim).
+    Runs the real forward with hooks, so dynamic control flow is honored."""
+    import jax.numpy as jnp
+
+    from ..framework.core import _wrap_value
+    from ..framework.dtype import get_default_dtype, to_jax_dtype
+
+    rows = []
+    handles = []
+
+    def mk(name, layer):
+        def hook(lyr, inputs, output):
+            xs = inputs[0] if isinstance(inputs, (tuple, list)) else inputs
+            n = _count(lyr, tuple(xs.shape), tuple(output.shape) if hasattr(output, "shape") else (), custom_ops)
+            if n:
+                rows.append((name, type(lyr).__name__, n))
+
+        return layer.register_forward_post_hook(hook)
+
+    for name, sub in net.named_sublayers():
+        if not sub._sub_layers:  # leaves only
+            handles.append(mk(name, sub))
+    was_training = net.training
+    net.eval()
+    x = _wrap_value(jnp.zeros(tuple(input_size), to_jax_dtype(get_default_dtype())))
+    try:
+        net(x)
+    finally:
+        for h in handles:
+            h.remove()
+        if was_training:
+            net.train()
+    total = sum(n for _, _, n in rows)
+    if print_detail:
+        for name, kind, n in rows:
+            print(f"{name:<40} {kind:<16} {n:>14,}")
+        print(f"{'total':<40} {'':<16} {total:>14,}")
+    return total

@@ -78,8 +78,12 @@ class autotune:
     def _cache_path(path=None):
         import os
 
-        return path or os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__)))), autotune.CACHE)
+        if path:
+            return path
+        env = os.environ.get("PADDLE_TPU_AUTOTUNE_CACHE")
+        if env:
+            return env
+        return os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu", "autotune.json")
 
     @staticmethod
     def _cache_key(shape):
@@ -91,12 +95,13 @@ class autotune:
 
     @staticmethod
     def tune_flash_blocks(shape=(8, 1024, 16, 64), iters=10, cache_path=None,
-                          candidates=None, on_result=None, _timer=None):
+                          candidates=None, on_result=None, on_error=None, _timer=None):
         """Sweep block configs for the flat flash kernels on ``shape``
         (b, s, h, d); apply + persist the fastest. ``on_result(blocks, dt)``
-        is called per successful candidate (progress reporting). Returns the
-        winning (block_q, block_k_fwd, block_k_bwd) or None when the kernels
-        are unavailable on this backend (CPU test meshes)."""
+        fires per successful candidate, ``on_error(blocks, exc)`` per failed
+        one (compile blowups stay visible). Returns the winning (block_q,
+        block_k_fwd, block_k_bwd) or None when the kernels are unavailable
+        on this backend (CPU test meshes)."""
         import time
 
         from ..ops import flash_attention_flat as ff
@@ -141,7 +146,9 @@ class autotune:
             ff.set_blocks(*blocks)
             try:
                 dt = timer(blocks)
-            except Exception:
+            except Exception as exc:
+                if on_error is not None:
+                    on_error(blocks, exc)
                 continue
             if on_result is not None:
                 on_result(blocks, dt)
@@ -160,6 +167,7 @@ class autotune:
         import os
 
         path = autotune._cache_path(cache_path)
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         try:
             cache = json.load(open(path))
         except Exception:

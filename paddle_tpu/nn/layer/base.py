@@ -57,7 +57,14 @@ class Layer:
         if init is None:
             init = I.Constant(0.0) if is_bias else I.XavierNormal()
         value = init(shape, dtype)
-        return _make_param(value)
+        p = _make_param(value)
+        if attr is not None:
+            if getattr(attr, "name", None):
+                p.name = attr.name
+            if getattr(attr, "trainable", True) is False:
+                p.stop_gradient = True
+                p.trainable = False
+        return p
 
     def create_tensor(self, value=None, dtype=None):
         import jax.numpy as jnp

@@ -364,3 +364,91 @@ def rank(x):
 
 def shape(x):
     return _wrap_value(jnp.asarray(ensure_tensor(x).shape, dtype=jnp.int32))
+
+
+# -- round-4 API-diff tail (reference python/paddle/__init__.py names) ------
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return op(lambda v: jnp.diagonal(v, offset=offset, axis1=axis1, axis2=axis2),
+              ensure_tensor(x), _name="diagonal")
+
+
+def unstack(x, axis=0, num=None, name=None):
+    """Split along ``axis`` into a list of tensors with that axis removed
+    (reference fluid.layers.unstack)."""
+    x = ensure_tensor(x)
+    if num is not None and x.shape[axis] is not None and num != x.shape[axis]:
+        raise ValueError(f"unstack num={num} mismatches axis extent {x.shape[axis]}")
+    n = x.shape[axis] if num is None else num
+    outs = []
+    for i in range(n):
+        outs.append(op(lambda v, i=i: jnp.take(v, i, axis=axis), x, _name="unstack"))
+    return outs
+
+
+def reverse(x, axis, name=None):
+    """flip alias (the reference keeps both names)."""
+    return flip(x, axis)
+
+
+def multiplex(inputs, index, name=None):
+    """Row-wise select: out[i] = inputs[index[i, 0]][i] (reference
+    fluid.layers.multiplex)."""
+    ins = [ensure_tensor(t) for t in inputs]
+    idx = ensure_tensor(index)
+
+    def fn(ix, *tensors):
+        stacked = jnp.stack(tensors, axis=0)  # [n, batch, ...]
+        sel = ix.reshape(-1).astype(jnp.int32)
+        return jnp.take_along_axis(
+            stacked, sel[None, :, *(None,) * (stacked.ndim - 2)], axis=0)[0]
+
+    return op(fn, idx, *ins, _name="multiplex")
+
+
+def tolist(x):
+    return np.asarray(ensure_tensor(x)._value).tolist()
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def _inplace(name, x, fn):
+    """Apply ``fn`` to a snapshot of ``x`` and rebind ``x`` to the result —
+    the paddle inplace-op contract (version-bumped reuse of the same python
+    Tensor). The snapshot keeps the autograd edge pointing at the OLD
+    producer, so rebinding cannot create a self-referential node; later
+    reads of x see — and differentiate through — the new value. For a LEAF
+    x, a hook on the snapshot mirrors accumulated grads back onto x.grad
+    (reference: inplace ops on leaves still populate x.grad)."""
+    from ..framework.autograd import _accum_grad
+    from ..framework.static_trace import guard_inplace
+
+    guard_inplace(name, x)
+    old = _wrap_value(x._value, stop_gradient=x.stop_gradient)
+    old._node, old._out_idx = x._node, x._out_idx
+    if old._node is None and not old.stop_gradient:
+        def _mirror(g):  # hooks receive a wrapped Tensor; grads store raw values
+            _accum_grad(x, g._value if hasattr(g, "_value") else g)
+
+        old.register_hook(_mirror)
+    out = fn(old)
+    x._value, x._node, x._out_idx = out._value, out._node, out._out_idx
+    return x
+
+
+def squeeze_(x, axis=None, name=None):
+    x = ensure_tensor(x)
+    return _inplace("squeeze_", x, lambda v: squeeze(v, axis))
+
+
+def unsqueeze_(x, axis, name=None):
+    x = ensure_tensor(x)
+    return _inplace("unsqueeze_", x, lambda v: unsqueeze(v, axis))
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    x = ensure_tensor(x)
+    return _inplace("scatter_", x, lambda v: scatter(v, index, updates, overwrite))

@@ -549,3 +549,24 @@ def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
 def einsum(equation, *operands):
     tensors = [ensure_tensor(t) for t in operands]
     return op(lambda *vals: jnp.einsum(equation, *vals), *tensors, _name="einsum")
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    """Clamp each slice along ``axis`` to p-norm <= max_norm (reference
+    paddle.renorm)."""
+    x = ensure_tensor(x)
+
+    def fn(v):
+        axes = tuple(i for i in range(v.ndim) if i != axis % v.ndim)
+        norms = jnp.sum(jnp.abs(v) ** p, axis=axes, keepdims=True) ** (1.0 / p)
+        scale = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+        return v * scale.astype(v.dtype)
+
+    return op(fn, x, _name="renorm")
+
+
+def tanh_(x, name=None):
+    from .manipulation import _inplace
+
+    x = ensure_tensor(x)
+    return _inplace("tanh_", x, tanh)

@@ -177,8 +177,11 @@ def check_sweep():
              for bkb in (128, 256, 512)]
     best = autotune.tune_flash_blocks(
         shape=(8, 1024, 16, 64), iters=20, candidates=cands,
+        cache_path="/root/repo/.autotune_cache.json",
         on_result=lambda blocks, dt: print(json.dumps(
-            {"blocks": list(blocks), "fwd_bwd_ms": round(dt * 1000, 2)})))
+            {"blocks": list(blocks), "fwd_bwd_ms": round(dt * 1000, 2)})),
+        on_error=lambda blocks, exc: print(json.dumps(
+            {"blocks": list(blocks), "error": str(exc)[:120]})))
     print(json.dumps({"sweep_best": list(best) if best else None}))
 
 
