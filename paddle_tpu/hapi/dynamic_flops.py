@@ -16,8 +16,9 @@ def _count(layer, x_shape, y_shape, custom_ops=None):
     if isinstance(layer, Conv2D):
         w = layer.weight._value
         out_elems = int(np.prod(y_shape))
+        # weight is [out_c, in_c // groups, kh, kw]: cin is already per-group
         kh, kw, cin = int(w.shape[2]), int(w.shape[3]), int(w.shape[1])
-        return out_elems * cin * kh * kw // max(layer.groups, 1) * max(layer.groups, 1)
+        return out_elems * cin * kh * kw
     if isinstance(layer, Linear):
         w = layer.weight._value
         batch_elems = int(np.prod(x_shape)) // int(w.shape[0])

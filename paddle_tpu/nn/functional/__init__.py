@@ -28,3 +28,4 @@ from .sequence import (  # noqa: F401
     sequence_softmax,
     sequence_unpad,
 )
+from .extension_ops import *  # noqa: F401,F403

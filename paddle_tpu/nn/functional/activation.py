@@ -153,3 +153,38 @@ def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
         return y
 
     return op(fn, x, _name="gumbel_softmax")
+
+
+def celu(x, alpha=1.0, name=None):
+    """max(0,x) + min(0, a*(exp(x/a)-1)) (reference nn/functional/activation.py celu)."""
+    return op(lambda v: jnp.maximum(v, 0) + jnp.minimum(
+        alpha * (jnp.exp(v / alpha) - 1.0), 0).astype(v.dtype), ensure_tensor(x), _name="celu")
+
+
+def thresholded_relu(x, threshold=1.0, name=None):
+    return op(lambda v: jnp.where(v > threshold, v, 0).astype(v.dtype),
+              ensure_tensor(x), _name="thresholded_relu")
+
+
+def relu_(x, name=None):
+    from ...tensor.manipulation import _inplace
+
+    return _inplace("relu_", ensure_tensor(x), relu)
+
+
+def elu_(x, alpha=1.0, name=None):
+    from ...tensor.manipulation import _inplace
+
+    return _inplace("elu_", ensure_tensor(x), lambda v: elu(v, alpha))
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    from ...tensor.manipulation import _inplace
+
+    return _inplace("softmax_", ensure_tensor(x), lambda v: softmax(v, axis, dtype))
+
+
+def tanh_(x, name=None):
+    from ...tensor.math import tanh_ as _t
+
+    return _t(x)

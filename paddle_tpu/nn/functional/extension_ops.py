@@ -1,0 +1,308 @@
+"""Functional tail ops closing the nn.functional API diff.
+
+Parity anchors: python/paddle/nn/functional/{vision,extension,common}.py —
+pixel_shuffle/unshuffle, channel_shuffle, affine_grid, grid_sample,
+temporal_shift, fold, max_unpool*, diag_embed, gather_tree,
+class_center_sample, sparse_attention, zeropad2d. All are pure jnp
+compositions through the primitive chokepoint; XLA fuses them — the
+reference needs a CUDA kernel per op (paddle/fluid/operators/
+{pixel_shuffle_op.cu, grid_sampler_op.cu, temporal_shift_op.cu, ...}).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...tensor._helpers import ensure_tensor, op
+
+__all__ = [
+    "pixel_shuffle", "pixel_unshuffle", "channel_shuffle", "zeropad2d",
+    "diag_embed", "temporal_shift", "affine_grid", "grid_sample", "fold",
+    "max_unpool1d", "max_unpool2d", "max_unpool3d", "gather_tree",
+    "class_center_sample", "sparse_attention",
+]
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = int(upscale_factor)
+
+    def fn(v):
+        if data_format == "NHWC":
+            v = jnp.transpose(v, (0, 3, 1, 2))
+        n, c, h, w = v.shape
+        v = v.reshape(n, c // (r * r), r, r, h, w)
+        v = jnp.transpose(v, (0, 1, 4, 2, 5, 3)).reshape(n, c // (r * r), h * r, w * r)
+        if data_format == "NHWC":
+            v = jnp.transpose(v, (0, 2, 3, 1))
+        return v
+
+    return op(fn, ensure_tensor(x), _name="pixel_shuffle")
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = int(downscale_factor)
+
+    def fn(v):
+        if data_format == "NHWC":
+            v = jnp.transpose(v, (0, 3, 1, 2))
+        n, c, h, w = v.shape
+        v = v.reshape(n, c, h // r, r, w // r, r)
+        v = jnp.transpose(v, (0, 1, 3, 5, 2, 4)).reshape(n, c * r * r, h // r, w // r)
+        if data_format == "NHWC":
+            v = jnp.transpose(v, (0, 2, 3, 1))
+        return v
+
+    return op(fn, ensure_tensor(x), _name="pixel_unshuffle")
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    g = int(groups)
+
+    def fn(v):
+        if data_format == "NHWC":
+            v = jnp.transpose(v, (0, 3, 1, 2))
+        n, c, h, w = v.shape
+        v = v.reshape(n, g, c // g, h, w)
+        v = jnp.transpose(v, (0, 2, 1, 3, 4)).reshape(n, c, h, w)
+        if data_format == "NHWC":
+            v = jnp.transpose(v, (0, 2, 3, 1))
+        return v
+
+    return op(fn, ensure_tensor(x), _name="channel_shuffle")
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    l, r, t, b = (padding if isinstance(padding, (list, tuple)) else [padding] * 4)
+
+    def fn(v):
+        pads = [(0, 0), (0, 0), (t, b), (l, r)] if data_format == "NCHW" \
+            else [(0, 0), (t, b), (l, r), (0, 0)]
+        return jnp.pad(v, pads)
+
+    return op(fn, ensure_tensor(x), _name="zeropad2d")
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None):
+    return op(lambda v: jnp.vectorize(lambda row: jnp.diag(row, k=offset),
+                                      signature="(n)->(m,m)")(v)
+              if (dim1, dim2) == (-2, -1) else
+              jnp.moveaxis(jnp.vectorize(lambda row: jnp.diag(row, k=offset),
+                                         signature="(n)->(m,m)")(v), (-2, -1), (dim1, dim2)),
+              ensure_tensor(x), _name="diag_embed")
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW", name=None):
+    """Shift a fraction of channels one step along the segment (time) axis
+    (reference temporal_shift_op: TSM)."""
+
+    def fn(v):
+        if data_format == "NHWC":
+            v = jnp.transpose(v, (0, 3, 1, 2))
+        nt, c, h, w = v.shape
+        n = nt // seg_num
+        v = v.reshape(n, seg_num, c, h, w)
+        c1 = int(c * shift_ratio)
+        c2 = int(c * 2 * shift_ratio)
+        back = jnp.concatenate([v[:, 1:, :c1], jnp.zeros_like(v[:, :1, :c1])], axis=1)
+        fwd = jnp.concatenate([jnp.zeros_like(v[:, :1, c1:c2]), v[:, :-1, c1:c2]], axis=1)
+        out = jnp.concatenate([back, fwd, v[:, :, c2:]], axis=2).reshape(nt, c, h, w)
+        if data_format == "NHWC":
+            out = jnp.transpose(out, (0, 2, 3, 1))
+        return out
+
+    return op(fn, ensure_tensor(x), _name="temporal_shift")
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """[n, 2, 3] affine params -> [n, h, w, 2] sampling grid (reference
+    affine_grid_op)."""
+    n, _, h, w = [int(d) for d in out_shape]
+
+    def lin(size):
+        if align_corners:
+            return jnp.linspace(-1.0, 1.0, size)
+        step = 2.0 / size
+        return jnp.linspace(-1.0 + step / 2, 1.0 - step / 2, size)
+
+    def fn(th):
+        ys, xs = jnp.meshgrid(lin(h), lin(w), indexing="ij")
+        base = jnp.stack([xs, ys, jnp.ones_like(xs)], axis=-1)  # [h, w, 3]
+        return jnp.einsum("hwk,nck->nhwc", base, th.astype(jnp.float32)).astype(th.dtype)
+
+    return op(fn, ensure_tensor(theta), _name="affine_grid")
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros", align_corners=True, name=None):
+    """Sample [n,c,h,w] at normalized grid [n,gh,gw,2] (reference
+    grid_sampler_op). Modes: bilinear/nearest; padding: zeros/border."""
+    if mode not in ("bilinear", "nearest"):
+        raise ValueError(mode)
+    if padding_mode not in ("zeros", "border"):
+        raise NotImplementedError(f"padding_mode {padding_mode!r}")
+
+    def fn(v, g):
+        n, c, h, w = v.shape
+        gx, gy = g[..., 0].astype(jnp.float32), g[..., 1].astype(jnp.float32)
+        if align_corners:
+            fx = (gx + 1) * (w - 1) / 2
+            fy = (gy + 1) * (h - 1) / 2
+        else:
+            fx = ((gx + 1) * w - 1) / 2
+            fy = ((gy + 1) * h - 1) / 2
+
+        def gather(ix, iy):
+            inb = (ix >= 0) & (ix < w) & (iy >= 0) & (iy < h)
+            ixc = jnp.clip(ix, 0, w - 1)
+            iyc = jnp.clip(iy, 0, h - 1)
+            vals = v[jnp.arange(n)[:, None, None], :, iyc, ixc]  # [n, gh, gw, c]
+            if padding_mode == "zeros":
+                vals = jnp.where(inb[..., None], vals, 0)
+            return vals
+
+        if mode == "nearest":
+            out = gather(jnp.round(fx).astype(jnp.int32), jnp.round(fy).astype(jnp.int32))
+        else:
+            x0 = jnp.floor(fx).astype(jnp.int32)
+            y0 = jnp.floor(fy).astype(jnp.int32)
+            wx = (fx - x0)[..., None]
+            wy = (fy - y0)[..., None]
+            out = (gather(x0, y0) * (1 - wx) * (1 - wy)
+                   + gather(x0 + 1, y0) * wx * (1 - wy)
+                   + gather(x0, y0 + 1) * (1 - wx) * wy
+                   + gather(x0 + 1, y0 + 1) * wx * wy)
+        return jnp.transpose(out.astype(v.dtype), (0, 3, 1, 2))
+
+    return op(fn, ensure_tensor(x), ensure_tensor(grid), _name="grid_sample")
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """col2im: [n, c*kh*kw, L] patches -> [n, c, H, W] with overlap-add
+    (reference fold_op / unfold inverse)."""
+    from .pooling import _pair
+
+    H, W = _pair(output_sizes, 2)
+    kh, kw = _pair(kernel_sizes, 2)
+    sh, sw = _pair(strides, 2)
+    ph, pw = _pair(paddings, 2)
+    dh, dw = _pair(dilations, 2)
+    ho = (H + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+    wo = (W + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+
+    def fn(v):
+        n, ckk, L = v.shape
+        c = ckk // (kh * kw)
+        v = v.reshape(n, c, kh, kw, ho, wo)
+        out = jnp.zeros((n, c, H + 2 * ph, W + 2 * pw), v.dtype)
+        for i in range(kh):
+            for j in range(kw):
+                ys = i * dh
+                xs = j * dw
+                out = out.at[:, :, ys:ys + sh * ho:sh, xs:xs + sw * wo:sw].add(v[:, :, i, j])
+        return out[:, :, ph:ph + H, pw:pw + W]
+
+    return op(fn, ensure_tensor(x), _name="fold")
+
+
+def _max_unpool(x, indices, n, kernel_size, stride, padding, output_size, data_format):
+    """Scatter pooled values back to the positions recorded by
+    return_mask=True max pooling (reference unpool_op)."""
+    from .pooling import _pair
+
+    ks = _pair(kernel_size, n)
+    st = _pair(stride if stride is not None else kernel_size, n)
+    pd = _pair(padding, n)
+
+    def fn(v, idx):
+        spatial_in = v.shape[2:]
+        if output_size is not None:
+            out_sp = [int(d) for d in output_size[-n:]]
+        else:
+            out_sp = [(spatial_in[i] - 1) * st[i] - 2 * pd[i] + ks[i] for i in range(n)]
+        N, C = v.shape[:2]
+        S = int(np.prod(out_sp))
+        flat = jnp.zeros((N, C, S), v.dtype)
+        vi = v.reshape(N, C, -1)
+        ii = idx.reshape(N, C, -1).astype(jnp.int32)
+        flat = flat.at[jnp.arange(N)[:, None, None], jnp.arange(C)[None, :, None], ii].set(vi)
+        return flat.reshape((N, C) + tuple(out_sp))
+
+    return op(fn, ensure_tensor(x), ensure_tensor(indices), _name="max_unpool")
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0, data_format="NCL", output_size=None, name=None):
+    return _max_unpool(x, indices, 1, kernel_size, stride, padding, output_size, data_format)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0, data_format="NCHW", output_size=None, name=None):
+    return _max_unpool(x, indices, 2, kernel_size, stride, padding, output_size, data_format)
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0, data_format="NCDHW", output_size=None, name=None):
+    return _max_unpool(x, indices, 3, kernel_size, stride, padding, output_size, data_format)
+
+
+def gather_tree(ids, parents, name=None):
+    """Back-trace beam-search parent pointers into full sequences
+    (reference gather_tree_op): ids/parents [T, batch, beam]."""
+
+    def fn(ids_, par):
+        T = ids_.shape[0]
+
+        def step(beams, t):
+            # beams: the beam index occupied at time t; emit its token, then
+            # hop to its parent for time t-1
+            tok = jnp.take_along_axis(ids_[t], beams, axis=-1)
+            prev = jnp.take_along_axis(par[t], beams, axis=-1)
+            return prev, tok
+
+        init = jnp.broadcast_to(jnp.arange(ids_.shape[2]), ids_.shape[1:])
+        _, toks = jax.lax.scan(step, init, jnp.arange(T - 1, -1, -1))
+        return toks[::-1]
+
+    return op(fn, ensure_tensor(ids), ensure_tensor(parents), _name="gather_tree")
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """Sample negative class centers plus all positives (reference
+    class_center_sample_op, PartialFC). Returns (remapped_label,
+    sampled_class_indices). Host-side sampling: the sampled set is data-
+    dependent."""
+    lab = np.asarray(ensure_tensor(label)._value).ravel()
+    pos = np.unique(lab)
+    num_samples = max(int(num_samples), len(pos))
+    neg_pool = np.setdiff1d(np.arange(num_classes), pos)
+    rng = np.random.default_rng()  # fresh entropy: negatives resample per call
+    extra = rng.choice(neg_pool, size=min(num_samples - len(pos), len(neg_pool)), replace=False)
+    sampled = np.sort(np.concatenate([pos, extra]))
+    remap = {c: i for i, c in enumerate(sampled)}
+    new_lab = np.asarray([remap[c] for c in lab], np.int64)
+    from ...framework.core import _wrap_value
+
+    return _wrap_value(jnp.asarray(new_lab)), _wrap_value(jnp.asarray(sampled))
+
+
+def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns, name=None):
+    """Block-sparse attention (reference sparse_attention_op). TPU-native
+    form: materialize the CSR layout as an additive mask and let the fused
+    attention path run it — on TPU the MXU prefers dense tiles with masking
+    over gather-based sparsity at these block sizes."""
+    q, k, v = ensure_tensor(query), ensure_tensor(key), ensure_tensor(value)
+    off, cols = ensure_tensor(sparse_csr_offset), ensure_tensor(sparse_csr_columns)
+
+    def fn(qq, kk, vv, o, c):
+        b, h, s, d = qq.shape
+        # CSR rows -> dense [s, s] connectivity (same for every batch/head
+        # when offsets are 2-D [h, s+1]; take head 0 layout otherwise)
+        o2 = o.reshape(-1, o.shape[-1])[0]
+        c2 = c.reshape(-1)[: int(o2[-1])] if c.ndim > 1 else c
+        counts = o2[1:] - o2[:-1]
+        row_of = jnp.repeat(jnp.arange(s), counts, total_repeat_length=c2.shape[0])
+        mask = jnp.zeros((s, s), bool).at[row_of, c2].set(True)
+        logits = jnp.einsum("bhqd,bhkd->bhqk", qq.astype(jnp.float32), kk.astype(jnp.float32))
+        logits = logits / jnp.sqrt(jnp.asarray(d, jnp.float32))
+        logits = jnp.where(mask[None, None], logits, -1e30)
+        p = jax.nn.softmax(logits, axis=-1)
+        return jnp.einsum("bhqk,bhkd->bhqd", p, vv.astype(jnp.float32)).astype(qq.dtype)
+
+    return op(fn, q, k, v, off, cols, _name="sparse_attention")

@@ -272,3 +272,152 @@ def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0, red
         return _reduce(loss, reduction)
 
     return op(fn, ensure_tensor(logit), ensure_tensor(label), _name="sigmoid_focal_loss")
+
+
+# -- round-4 loss tail ------------------------------------------------------
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """1 - 2|X∩Y|/(|X|+|Y|) over the last axis (reference
+    nn/functional/loss.py dice_loss)."""
+    x, y = ensure_tensor(input), ensure_tensor(label)
+
+    def fn(p, t):
+        t1 = jax.nn.one_hot(t.squeeze(-1), p.shape[-1], dtype=p.dtype)
+        red = tuple(range(1, p.ndim))
+        inter = jnp.sum(p * t1, axis=red)
+        union = jnp.sum(p, axis=red) + jnp.sum(t1, axis=red)
+        return jnp.mean(1.0 - (2.0 * inter + epsilon) / (union + epsilon))
+
+    return op(fn, x, y, _name="dice_loss")
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
+    """N-pair loss (reference npair_loss): cross entropy over
+    anchor·positiveᵀ similarities + L2 on embeddings."""
+    a, p, lab = ensure_tensor(anchor), ensure_tensor(positive), ensure_tensor(labels)
+
+    def fn(av, pv, lv):
+        lv = lv.reshape(-1, 1)
+        tgt = (lv == lv.T).astype(jnp.float32)
+        tgt = tgt / jnp.sum(tgt, axis=1, keepdims=True)
+        logits = av.astype(jnp.float32) @ pv.astype(jnp.float32).T
+        ce = -jnp.mean(jnp.sum(tgt * jax.nn.log_softmax(logits, axis=1), axis=1))
+        reg = l2_reg * 0.25 * (jnp.mean(jnp.sum(av.astype(jnp.float32) ** 2, 1))
+                               + jnp.mean(jnp.sum(pv.astype(jnp.float32) ** 2, 1)))
+        return ce + reg
+
+    return op(fn, a, p, lab, _name="npair_loss")
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0, reduction="mean", name=None):
+    """CTC forward algorithm in log space via lax.scan (reference
+    warpctc_op / nn.functional.ctc_loss). log_probs: [T, B, C] logits
+    (softmax applied internally, reference contract), labels [B, L]."""
+    lp, lab = ensure_tensor(log_probs), ensure_tensor(labels)
+    il, ll = ensure_tensor(input_lengths), ensure_tensor(label_lengths)
+
+    def fn(logits, lv, ilv, llv):
+        T, B, C = logits.shape
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        L = lv.shape[1]
+        S = 2 * L + 1
+        # extended label sequence: blank l1 blank l2 ... blank
+        ext = jnp.full((B, S), blank, jnp.int32)
+        ext = ext.at[:, 1::2].set(lv.astype(jnp.int32))
+        neg = jnp.float32(-1e30)
+        alpha0 = jnp.full((B, S), neg)
+        alpha0 = alpha0.at[:, 0].set(logp[0, jnp.arange(B), blank])
+        alpha0 = alpha0.at[:, 1].set(jnp.where(llv > 0, logp[0, jnp.arange(B), ext[:, 1]], neg))
+
+        allow_skip = jnp.concatenate(
+            [jnp.zeros((B, 2), bool),
+             (ext[:, 2:] != blank) & (ext[:, 2:] != ext[:, :-2])], axis=1)
+
+        def step(alpha, t):
+            a1 = jnp.concatenate([jnp.full((B, 1), neg), alpha[:, :-1]], axis=1)
+            a2 = jnp.concatenate([jnp.full((B, 2), neg), alpha[:, :-2]], axis=1)
+            a2 = jnp.where(allow_skip, a2, neg)
+            merged = jnp.logaddexp(jnp.logaddexp(alpha, a1), a2)
+            emit = jnp.take_along_axis(logp[t], ext, axis=1)
+            new = merged + emit
+            return jnp.where((t < ilv)[:, None], new, alpha), None
+
+        alpha, _ = jax.lax.scan(step, alpha0, jnp.arange(1, T))
+        sidx = 2 * llv.astype(jnp.int32)
+        last_blank = jnp.take_along_axis(alpha, sidx[:, None], axis=1)[:, 0]
+        last_label = jnp.take_along_axis(alpha, jnp.maximum(sidx - 1, 0)[:, None], axis=1)[:, 0]
+        ll_ = jnp.logaddexp(last_blank, jnp.where(llv > 0, last_label, neg))
+        loss = -ll_
+        if reduction == "mean":
+            return jnp.mean(loss / jnp.maximum(llv.astype(jnp.float32), 1.0))
+        if reduction == "sum":
+            return jnp.sum(loss)
+        return loss
+
+    return op(fn, lp, lab, il, ll, _name="ctc_loss")
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None, path_table=None, path_code=None, is_sparse=False, name=None):
+    """Hierarchical sigmoid with the default complete binary tree
+    (reference hsigmoid_loss / hierarchical_sigmoid_op). weight:
+    [num_classes-1, feature]."""
+    if path_table is not None or path_code is not None:
+        raise NotImplementedError("custom trees not supported; use the default tree")
+    x, lab, w = ensure_tensor(input), ensure_tensor(label), ensure_tensor(weight)
+    args = [x, lab, w] + ([ensure_tensor(bias)] if bias is not None else [])
+    # default tree depth
+    import math as _m
+
+    depth = max(1, int(_m.ceil(_m.log2(max(num_classes, 2)))))
+
+    def fn(xv, lv, wv, *rest):
+        bv = rest[0] if rest else None
+        B = xv.shape[0]
+        code = lv.reshape(-1).astype(jnp.int32) + num_classes  # leaf node id in implicit heap
+        loss = jnp.zeros((B,), jnp.float32)
+        for _ in range(depth):
+            parent = code // 2
+            is_right = (code % 2).astype(jnp.float32)
+            valid = parent >= 1
+            nw = wv[jnp.clip(parent - 1, 0, wv.shape[0] - 1)]
+            logit = jnp.sum(xv.astype(jnp.float32) * nw.astype(jnp.float32), axis=1)
+            if bv is not None:
+                logit = logit + bv.reshape(-1)[jnp.clip(parent - 1, 0, wv.shape[0] - 1)].astype(jnp.float32)
+            # right child => sigmoid(logit), left => 1 - sigmoid
+            ll_ = jax.nn.log_sigmoid(jnp.where(is_right > 0, logit, -logit))
+            loss = loss - jnp.where(valid, ll_, 0.0)
+            code = parent
+        return jnp.mean(loss)
+
+    return op(fn, *args, _name="hsigmoid_loss")
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5, margin3=0.0, scale=64.0, group=None, return_softmax=False, reduction="mean", name=None):
+    """ArcFace-family margin softmax (reference margin_cross_entropy_op):
+    cos(m1·θ + m2) - m3 on the target logit, then scaled CE."""
+    if group is not None:
+        raise NotImplementedError(
+            "margin_cross_entropy over a model-parallel group: use the "
+            "vocab/class-sharded ParallelCrossEntropy path (distributed "
+            "mp_layers) — per-shard-only softmax would be silently wrong")
+    lg, lab = ensure_tensor(logits), ensure_tensor(label)
+
+    def fn(lv, yv):
+        y = yv.reshape(-1).astype(jnp.int32)
+        cos = jnp.clip(lv.astype(jnp.float32), -1.0, 1.0)
+        theta = jnp.arccos(cos)
+        tgt = jnp.cos(margin1 * theta + margin2) - margin3
+        onehot = jax.nn.one_hot(y, lv.shape[-1], dtype=jnp.float32)
+        out = jnp.where(onehot > 0, tgt, cos) * scale
+        logp = jax.nn.log_softmax(out, axis=-1)
+        loss = -jnp.take_along_axis(logp, y[:, None], axis=1)[:, 0]
+        if reduction == "mean":
+            loss = jnp.mean(loss)
+        elif reduction == "sum":
+            loss = jnp.sum(loss)
+        if return_softmax:
+            return loss, jnp.exp(logp)
+        return loss
+
+    return op(fn, lg, lab, _name="margin_cross_entropy")

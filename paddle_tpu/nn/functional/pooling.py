@@ -37,15 +37,56 @@ def _pool(x, kernel, stride, padding, n, init, reduce_fn, avg=False, ceil_mode=F
     return op(fn, ensure_tensor(x), _name="pool")
 
 
+def _max_pool_with_mask(x, kernel, stride, padding, n):
+    """Pooled values + flat spatial argmax indices (reference max_pool
+    mask semantics, consumed by max_unpool*). Windows are gathered as
+    patches; the mask records each window max's flat index into the
+    (unpadded) input spatial plane."""
+    ks = _pair(kernel, n)
+    st = _pair(stride if stride is not None else kernel, n)
+    pd = _pair(padding, n)
+
+    def fn(v):
+        spatial = v.shape[2:]
+        # patches pad with 0; shift values positive so padding can never win
+        shift = jnp.min(v) - 1
+        pt = jax.lax.conv_general_dilated_patches(
+            v - shift, filter_shape=ks, window_strides=st, padding=[(p, p) for p in pd])
+        N, C = v.shape[0], v.shape[1]
+        out_sp = pt.shape[2:]
+        pt = pt.reshape(N, C, int(np.prod(ks)), *out_sp)
+        local = jnp.argmax(pt, axis=2)
+        pooled = jnp.max(pt, axis=2) + shift
+        # local window offset -> global flat index
+        grids = jnp.meshgrid(*[jnp.arange(s) for s in out_sp], indexing="ij")
+        loc = local
+        flat = jnp.zeros_like(local)
+        for i in range(n):
+            kprod = int(np.prod(ks[i + 1:]))
+            off_i = loc // kprod  # offset within window along dim i
+            loc = loc % kprod
+            gi = grids[i] * st[i] - pd[i] + off_i
+            flat = flat * spatial[i] + gi
+        return pooled, flat.astype(jnp.int32)
+
+    return op(fn, ensure_tensor(x), _name="max_pool_mask")
+
+
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, name=None):
+    if return_mask:
+        return _max_pool_with_mask(x, kernel_size, stride, padding, 1)
     return _pool(x, kernel_size, stride, padding, 1, -jnp.inf, jax.lax.max)
 
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCHW", name=None):
+    if return_mask:
+        return _max_pool_with_mask(x, kernel_size, stride, padding, 2)
     return _pool(x, kernel_size, stride, padding, 2, -jnp.inf, jax.lax.max)
 
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCDHW", name=None):
+    if return_mask:
+        return _max_pool_with_mask(x, kernel_size, stride, padding, 3)
     return _pool(x, kernel_size, stride, padding, 3, -jnp.inf, jax.lax.max)
 
 

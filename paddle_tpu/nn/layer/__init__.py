@@ -36,3 +36,21 @@ from .transformer import (  # noqa: F401
     TransformerEncoder,
     TransformerEncoderLayer,
 )
+from .extension import (  # noqa: F401
+    BeamSearchDecoder,
+    BiRNN,
+    ChannelShuffle,
+    CTCLoss,
+    dynamic_decode,
+    Fold,
+    HSigmoidLoss,
+    MaxUnPool1D,
+    MaxUnPool2D,
+    MaxUnPool3D,
+    PairwiseDistance,
+    PixelShuffle,
+    PixelUnshuffle,
+    RNNCellBase,
+    Softmax2D,
+    ThresholdedReLU,
+)

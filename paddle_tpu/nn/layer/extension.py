@@ -1,0 +1,289 @@
+"""Layer tail closing the paddle.nn API diff: pixel/channel shuffles, Fold,
+MaxUnPool, Softmax2D, ThresholdedReLU, PairwiseDistance, CTCLoss,
+HSigmoidLoss, BiRNN, RNNCellBase, BeamSearchDecoder + dynamic_decode.
+
+Parity anchors: python/paddle/nn/layer/{vision,common,activation,distance,
+loss,rnn}.py and fluid/layers/rnn.py (BeamSearchDecoder/dynamic_decode).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...tensor._helpers import ensure_tensor
+from .base import Layer
+
+
+class PixelShuffle(Layer):
+    def __init__(self, upscale_factor, data_format="NCHW", name=None):
+        super().__init__()
+        self.r, self.df = upscale_factor, data_format
+
+    def forward(self, x):
+        from .. import functional as F
+
+        return F.pixel_shuffle(x, self.r, self.df)
+
+
+class PixelUnshuffle(Layer):
+    def __init__(self, downscale_factor, data_format="NCHW", name=None):
+        super().__init__()
+        self.r, self.df = downscale_factor, data_format
+
+    def forward(self, x):
+        from .. import functional as F
+
+        return F.pixel_unshuffle(x, self.r, self.df)
+
+
+class ChannelShuffle(Layer):
+    def __init__(self, groups, data_format="NCHW", name=None):
+        super().__init__()
+        self.g, self.df = groups, data_format
+
+    def forward(self, x):
+        from .. import functional as F
+
+        return F.channel_shuffle(x, self.g, self.df)
+
+
+class Fold(Layer):
+    def __init__(self, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+        super().__init__()
+        self.a = (output_sizes, kernel_sizes, strides, paddings, dilations)
+
+    def forward(self, x):
+        from .. import functional as F
+
+        return F.fold(x, *self.a)
+
+
+class _MaxUnPoolBase(Layer):
+    _fn = None
+
+    def __init__(self, kernel_size, stride=None, padding=0, data_format=None, output_size=None, name=None):
+        super().__init__()
+        self.kernel_size, self.stride, self.padding = kernel_size, stride, padding
+        self.output_size = output_size
+
+    def forward(self, x, indices):
+        from .. import functional as F
+
+        return getattr(F, self._fn)(x, indices, self.kernel_size, self.stride,
+                                    self.padding, output_size=self.output_size)
+
+
+class MaxUnPool1D(_MaxUnPoolBase):
+    _fn = "max_unpool1d"
+
+
+class MaxUnPool2D(_MaxUnPoolBase):
+    _fn = "max_unpool2d"
+
+
+class MaxUnPool3D(_MaxUnPoolBase):
+    _fn = "max_unpool3d"
+
+
+class Softmax2D(Layer):
+    """Softmax over the channel axis of NCHW input (reference Softmax2D)."""
+
+    def forward(self, x):
+        from .. import functional as F
+
+        return F.softmax(x, axis=-3)
+
+
+class ThresholdedReLU(Layer):
+    def __init__(self, threshold=1.0, name=None):
+        super().__init__()
+        self.threshold = threshold
+
+    def forward(self, x):
+        from .. import functional as F
+
+        return F.thresholded_relu(x, self.threshold)
+
+
+class PairwiseDistance(Layer):
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p, self.eps, self.keepdim = p, epsilon, keepdim
+
+    def forward(self, x, y):
+        import jax.numpy as jnp
+
+        from ...tensor._helpers import op
+
+        return op(lambda a, b: jnp.sum(jnp.abs(a - b + self.eps) ** self.p, axis=-1,
+                                       keepdims=self.keepdim) ** (1.0 / self.p),
+                  ensure_tensor(x), ensure_tensor(y), _name="pairwise_distance")
+
+
+class CTCLoss(Layer):
+    def __init__(self, blank=0, reduction="mean"):
+        super().__init__()
+        self.blank, self.reduction = blank, reduction
+
+    def forward(self, logits, labels, input_lengths, label_lengths, norm_by_times=False):
+        from .. import functional as F
+
+        return F.ctc_loss(logits, labels, input_lengths, label_lengths,
+                          self.blank, self.reduction)
+
+
+class HSigmoidLoss(Layer):
+    def __init__(self, feature_size, num_classes, weight_attr=None, bias_attr=None, is_custom=False, is_sparse=False, name=None):
+        super().__init__()
+        if is_custom:
+            raise NotImplementedError("custom trees not supported; default tree only")
+        self.num_classes = num_classes
+        self.weight = self.create_parameter([num_classes - 1, feature_size], attr=weight_attr)
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [num_classes - 1, 1], attr=bias_attr, is_bias=True)
+
+    def forward(self, input, label):
+        from .. import functional as F
+
+        return F.hsigmoid_loss(input, label, self.num_classes, self.weight, self.bias)
+
+
+# -- RNN tail ----------------------------------------------------------------
+
+from .rnn import RNN  # noqa: E402
+
+
+class RNNCellBase(Layer):
+    """Base for user cells (reference rnn.py RNNCellBase): provides
+    get_initial_states."""
+
+    def get_initial_states(self, batch_ref, shape=None, dtype=None, init_value=0.0, batch_dim_idx=0):
+        import jax.numpy as jnp
+
+        from ...framework.core import _wrap_value
+
+        batch = ensure_tensor(batch_ref).shape[batch_dim_idx]
+        hidden = shape if shape is not None else getattr(self, "state_shape", None)
+
+        def build(shp):
+            return _wrap_value(jnp.full((batch,) + tuple(int(d) for d in shp), init_value,
+                                        jnp.float32))
+
+        if isinstance(hidden, (list, tuple)) and hidden and isinstance(hidden[0], (list, tuple)):
+            return tuple(build(s) for s in hidden)
+        return build(tuple(hidden))
+
+
+class BiRNN(Layer):
+    """Bidirectional cell wrapper (reference nn.BiRNN): runs forward and
+    reverse cells, concatenates outputs on the feature axis."""
+
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...tensor.manipulation import concat
+
+        st_fw, st_bw = (initial_states if initial_states is not None else (None, None))
+        out_fw, s_fw = self.fw(inputs, st_fw, sequence_length)
+        out_bw, s_bw = self.bw(inputs, st_bw, sequence_length)
+        return concat([out_fw, out_bw], axis=-1), (s_fw, s_bw)
+
+
+class BeamSearchDecoder:
+    """Beam-search decoding over a cell (reference fluid/layers/rnn.py
+    BeamSearchDecoder). Host-driven loop (decode lengths are data
+    dependent); the cell/embedding/output projections run on device."""
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start, self.end, self.beam = int(start_token), int(end_token), int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=32, **kwargs):
+    """Greedy-beam decode loop (reference fluid/layers/rnn.py
+    dynamic_decode): returns (ids [B, beam, T], per-beam scores)."""
+    import jax.numpy as jnp
+
+    from ...framework.core import _wrap_value
+
+    cell, K = decoder.cell, decoder.beam
+    state = inits
+    # infer batch from state leaves
+    leaves = state if isinstance(state, (list, tuple)) else [state]
+    B = ensure_tensor(leaves[0]).shape[0]
+
+    def logits_of(tok, st):
+        x = tok
+        if decoder.embedding_fn is not None:
+            x = decoder.embedding_fn(x)
+        out, new_st = cell(x, st)
+        if decoder.output_fn is not None:
+            out = decoder.output_fn(out)
+        return out, new_st
+
+    import jax
+
+    # step 0: expand each batch item into K beams
+    tok0 = _wrap_value(jnp.full((B,), decoder.start, jnp.int32))
+    out, state = logits_of(tok0, state)
+    lp0 = jax.nn.log_softmax(jnp.asarray(ensure_tensor(out)._value, jnp.float32), axis=-1)
+    scores, toks = jax.lax.top_k(lp0, K)  # [B, K]
+    seqs = [[[int(toks[b, k])] for k in range(K)] for b in range(B)]
+    beam_scores = np.asarray(scores)
+    # replicate the POST-start-token state per beam
+    def rep(t):
+        v = ensure_tensor(t)._value
+        return _wrap_value(jnp.repeat(v, K, axis=0))
+
+    state = tuple(rep(s) for s in state) if isinstance(state, (list, tuple)) else rep(state)
+    finished = np.zeros((B, K), bool)
+
+    for _ in range(max_step_num - 1):
+        if finished.all():
+            break
+        flat_tok = _wrap_value(jnp.asarray(
+            [seqs[b][k][-1] for b in range(B) for k in range(K)], jnp.int32))
+        out, state = logits_of(flat_tok, state)
+        lp = jax.nn.log_softmax(jnp.asarray(ensure_tensor(out)._value, jnp.float32), axis=-1)
+        V = lp.shape[-1]
+        lp = np.asarray(lp).reshape(B, K, V)
+        new_seqs, new_scores, sel_beams = [], [], []
+        for b in range(B):
+            cand = []
+            for k in range(K):
+                if finished[b, k]:
+                    cand.append((beam_scores[b, k], k, decoder.end))
+                    continue
+                top = np.argsort(lp[b, k])[-K:]
+                for t in top:
+                    cand.append((beam_scores[b, k] + lp[b, k, t], k, int(t)))
+            cand.sort(key=lambda c: -c[0])
+            picked = cand[:K]
+            new_seqs.append([seqs[b][k] + ([t] if not finished[b, k] else []) for _, k, t in picked])
+            new_scores.append([s for s, _, _ in picked])
+            sel_beams.append([k for _, k, _ in picked])
+        # reorder states to the selected beams
+        idx = jnp.asarray([b * K + k for b in range(B) for k in sel_beams[b]])
+
+        def reorder(t):
+            return _wrap_value(jnp.take(ensure_tensor(t)._value, idx, axis=0))
+
+        state = tuple(reorder(s) for s in state) if isinstance(state, tuple) else reorder(state)
+        seqs = new_seqs
+        beam_scores = np.asarray(new_scores)
+        for b in range(B):
+            for k in range(K):
+                if seqs[b][k] and seqs[b][k][-1] == decoder.end:
+                    finished[b, k] = True
+
+    T = max(len(s) for bs in seqs for s in bs)
+    ids = np.full((B, K, T), decoder.end, np.int64)
+    for b in range(B):
+        for k in range(K):
+            ids[b, k, : len(seqs[b][k])] = seqs[b][k]
+    return _wrap_value(jnp.asarray(ids)), _wrap_value(jnp.asarray(beam_scores, jnp.float32))

@@ -1,0 +1,259 @@
+"""nn API tail: pixel/channel ops, unpool round trips, CTC, margin CE,
+hsigmoid, BiRNN, beam search, sparse attention.
+
+Parity anchors: python/paddle/nn/layer/{vision,loss,rnn}.py,
+nn/functional/{vision,extension,loss}.py, fluid/layers/rnn.py.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+
+def _np(t):
+    return np.asarray(t.numpy())
+
+
+def test_pixel_shuffle_roundtrip():
+    x = paddle.to_tensor(np.random.default_rng(0).standard_normal((2, 8, 3, 3)).astype("float32"))
+    up = F.pixel_shuffle(x, 2)
+    assert tuple(up.shape) == (2, 2, 6, 6)
+    back = F.pixel_unshuffle(up, 2)
+    np.testing.assert_allclose(_np(back), _np(x))
+    m = paddle.nn.PixelShuffle(2)
+    np.testing.assert_allclose(_np(m(x)), _np(up))
+
+
+def test_channel_shuffle():
+    x = np.arange(2 * 6 * 1 * 1, dtype=np.float32).reshape(2, 6, 1, 1)
+    out = _np(F.channel_shuffle(paddle.to_tensor(x), 2))
+    # [g, c/g] -> [c/g, g] interleave
+    np.testing.assert_array_equal(out[0, :, 0, 0], [0, 3, 1, 4, 2, 5])
+
+
+def test_zeropad2d_and_diag_embed():
+    x = paddle.to_tensor(np.ones((1, 1, 2, 2), np.float32))
+    p = _np(F.zeropad2d(x, [1, 2, 3, 4]))
+    assert p.shape == (1, 1, 2 + 3 + 4, 2 + 1 + 2) and p.sum() == 4
+    d = _np(F.diag_embed(paddle.to_tensor(np.array([[1.0, 2.0]], np.float32))))
+    np.testing.assert_allclose(d, [[[1, 0], [0, 2]]])
+
+
+def test_max_pool_mask_and_unpool_roundtrip():
+    rng = np.random.default_rng(3)
+    x = paddle.to_tensor(rng.standard_normal((2, 3, 6, 6)).astype("float32") - 5.0)  # all negative
+    pooled, mask = F.max_pool2d(x, 2, return_mask=True)
+    assert tuple(pooled.shape) == (2, 3, 3, 3) and tuple(mask.shape) == (2, 3, 3, 3)
+    # mask indexes the true argmax in the flat 6x6 plane
+    xv = _np(x)
+    mv = _np(mask)
+    pv = _np(pooled)
+    for n in range(2):
+        for c in range(3):
+            flat = xv[n, c].ravel()
+            np.testing.assert_allclose(flat[mv[n, c].ravel()], pv[n, c].ravel(), rtol=1e-6)
+    # unpool scatters values back to their argmax positions
+    un = _np(F.max_unpool2d(pooled, mask, 2))
+    assert un.shape == (2, 3, 6, 6)
+    for n in range(2):
+        for c in range(3):
+            nz = un[n, c].ravel()[mv[n, c].ravel()]
+            np.testing.assert_allclose(nz, pv[n, c].ravel(), rtol=1e-6)
+    un1 = F.max_unpool1d(*F.max_pool1d(paddle.to_tensor(xv[:, :, 0]), 2, return_mask=True), 2)
+    assert tuple(un1.shape) == (2, 3, 6)
+
+
+def test_fold_inverts_unfold_on_nonoverlap():
+    x = paddle.to_tensor(np.random.default_rng(0).standard_normal((1, 2, 4, 4)).astype("float32"))
+    cols = F.unfold(x, 2, strides=2)
+    back = F.fold(cols, (4, 4), 2, strides=2)
+    np.testing.assert_allclose(_np(back), _np(x), rtol=1e-6)
+
+
+def test_temporal_shift_moves_channels():
+    nt, c, h, w = 4, 4, 1, 1  # n=2 segments of T=2
+    x = np.arange(nt * c, dtype=np.float32).reshape(nt, c, h, w)
+    out = _np(F.temporal_shift(paddle.to_tensor(x), seg_num=2, shift_ratio=0.25))
+    assert out.shape == x.shape
+    # channel 0 shifted backward: position t takes t+1's value, last zero
+    assert out[0, 0, 0, 0] == x[1, 0, 0, 0] and out[1, 0, 0, 0] == 0
+    # channel 1 shifted forward
+    assert out[0, 1, 0, 0] == 0 and out[1, 1, 0, 0] == x[0, 1, 0, 0]
+
+
+def test_affine_grid_identity_sample():
+    x = paddle.to_tensor(np.random.default_rng(1).standard_normal((1, 1, 5, 5)).astype("float32"))
+    theta = paddle.to_tensor(np.array([[[1.0, 0, 0], [0, 1.0, 0]]], np.float32))
+    grid = F.affine_grid(theta, [1, 1, 5, 5])
+    out = F.grid_sample(x, grid)
+    np.testing.assert_allclose(_np(out), _np(x), atol=1e-5)
+    near = F.grid_sample(x, grid, mode="nearest")
+    np.testing.assert_allclose(_np(near), _np(x), atol=1e-5)
+
+
+def test_activation_tail():
+    x = paddle.to_tensor(np.array([-2.0, 0.5, 2.0], np.float32))
+    np.testing.assert_allclose(_np(F.thresholded_relu(x)), [0, 0, 2.0])
+    c = _np(F.celu(x, alpha=1.0))
+    np.testing.assert_allclose(c, np.maximum(0, _np(x)) + np.minimum(0, np.exp(_np(x)) - 1), rtol=1e-5)
+    y = paddle.to_tensor(np.array([-1.0, 1.0], np.float32))
+    F.relu_(y)
+    np.testing.assert_allclose(_np(y), [0.0, 1.0])
+    m = paddle.nn.Softmax2D()
+    s = _np(m(paddle.to_tensor(np.zeros((1, 3, 2, 2), np.float32))))
+    np.testing.assert_allclose(s, np.full((1, 3, 2, 2), 1 / 3), rtol=1e-6)
+
+
+def _brute_ctc(logp, labels, blank):
+    """Enumerate all alignments of length T; sum path probs (tiny cases)."""
+    import itertools
+
+    T, C = logp.shape
+
+    def collapse(path):
+        out = []
+        prev = None
+        for p in path:
+            if p != prev and p != blank:
+                out.append(p)
+            prev = p
+        return out
+
+    total = 0.0
+    for path in itertools.product(range(C), repeat=T):
+        if collapse(path) == list(labels):
+            total += np.exp(sum(logp[t, p] for t, p in enumerate(path)))
+    return -np.log(total)
+
+
+def test_ctc_loss_matches_brute_force():
+    rng = np.random.default_rng(0)
+    T, B, C = 4, 1, 3
+    logits = rng.standard_normal((T, B, C)).astype(np.float32)
+    labels = np.array([[1, 2]], np.int64)
+    loss = _np(F.ctc_loss(paddle.to_tensor(logits), paddle.to_tensor(labels),
+                          paddle.to_tensor(np.array([T])), paddle.to_tensor(np.array([2])),
+                          reduction="none"))
+    logp = np.log(np.exp(logits[:, 0]) / np.exp(logits[:, 0]).sum(-1, keepdims=True))
+    want = _brute_ctc(logp, [1, 2], 0)
+    np.testing.assert_allclose(loss[0], want, rtol=1e-4)
+    # layer form + mean reduction runs
+    crit = paddle.nn.CTCLoss()
+    m = _np(crit(paddle.to_tensor(logits), paddle.to_tensor(labels),
+                 paddle.to_tensor(np.array([T])), paddle.to_tensor(np.array([2]))))
+    np.testing.assert_allclose(m, want / 2, rtol=1e-4)
+
+
+def test_dice_npair_margin_hsigmoid():
+    rng = np.random.default_rng(0)
+    # perfect prediction -> dice ~ 0
+    lab = np.array([[0], [1]], np.int64)
+    perfect = np.eye(2, dtype=np.float32)
+    d = float(_np(F.dice_loss(paddle.to_tensor(perfect), paddle.to_tensor(lab))))
+    assert d < 1e-3
+    a = paddle.to_tensor(rng.standard_normal((4, 8)).astype("float32"))
+    p = paddle.to_tensor(rng.standard_normal((4, 8)).astype("float32"))
+    l = paddle.to_tensor(np.array([0, 1, 2, 3], np.int64))
+    assert np.isfinite(float(_np(F.npair_loss(a, p, l))))
+    cos = paddle.to_tensor((rng.standard_normal((4, 10)) * 0.3).astype(np.float32))
+    y = paddle.to_tensor(np.array([1, 4, 7, 2], np.int64))
+    mce = float(_np(F.margin_cross_entropy(cos, y)))
+    plain = float(_np(F.margin_cross_entropy(cos, y, margin1=1.0, margin2=0.0, margin3=0.0, scale=1.0)))
+    assert np.isfinite(mce) and mce > plain  # margin makes targets harder
+    hs = paddle.nn.HSigmoidLoss(8, 6)
+    out = float(_np(hs(paddle.to_tensor(rng.standard_normal((3, 8)).astype("float32")),
+                       paddle.to_tensor(np.array([[0], [3], [5]], np.int64)))))
+    assert np.isfinite(out) and out > 0
+    pd = paddle.nn.PairwiseDistance()
+    dd = _np(pd(a, p))
+    np.testing.assert_allclose(dd, np.linalg.norm(_np(a) - _np(p) + 1e-6, axis=-1), rtol=1e-4)
+
+
+def test_birnn_concats_directions():
+    cell_fw = paddle.nn.SimpleRNNCell(4, 6)
+    cell_bw = paddle.nn.SimpleRNNCell(4, 6)
+    bi = paddle.nn.BiRNN(cell_fw, cell_bw)
+    x = paddle.to_tensor(np.random.default_rng(0).standard_normal((2, 5, 4)).astype("float32"))
+    out, (sf, sb) = bi(x)
+    assert tuple(out.shape) == (2, 5, 12)
+    fw_only, _ = paddle.nn.RNN(cell_fw)(x)
+    np.testing.assert_allclose(_np(out)[:, :, :6], _np(fw_only), rtol=1e-5)
+
+
+def test_gather_tree():
+    ids = paddle.to_tensor(np.array([[[2, 5]], [[6, 3]]], np.int64))      # [T=2, B=1, K=2]
+    parents = paddle.to_tensor(np.array([[[0, 0]], [[1, 0]]], np.int64))
+    out = _np(F.gather_tree(ids, parents))
+    # beam 0 at t=1 came from parent 1 -> its t=0 token is ids[0, :, 1] = 5
+    np.testing.assert_array_equal(out[:, 0, 0], [5, 6])
+    np.testing.assert_array_equal(out[:, 0, 1], [2, 3])
+
+
+def test_beam_search_decoder_greedy_path():
+    class Cell(paddle.nn.Layer):
+        """Deterministic: always prefers token (state_sum mod 4)."""
+
+        def __init__(self):
+            super().__init__()
+            self.fc = paddle.nn.Linear(4, 4)
+
+        def forward(self, tok, state):
+            import paddle_tpu.tensor as T
+
+            onehot = paddle.nn.functional.one_hot(tok % 4, 4).astype("float32")
+            new_state = state + onehot
+            return new_state * 3.0, new_state
+
+    from paddle_tpu.nn.layer.extension import BeamSearchDecoder, dynamic_decode
+
+    cell = Cell()
+    dec = BeamSearchDecoder(cell, start_token=1, end_token=3, beam_size=2)
+    st = paddle.to_tensor(np.zeros((2, 4), np.float32))
+    ids, scores = dynamic_decode(dec, inits=st, max_step_num=4)
+    assert tuple(ids.shape)[0:2] == (2, 2)
+    assert _np(scores).shape == (2, 2)
+    # beams are score-sorted
+    s = _np(scores)
+    assert (s[:, 0] >= s[:, 1]).all()
+
+
+def test_sparse_attention_full_csr_equals_dense():
+    rng = np.random.default_rng(0)
+    b, h, s, d = 1, 2, 4, 8
+    q, k, v = (rng.standard_normal((b, h, s, d)).astype(np.float32) for _ in range(3))
+    offset = np.tile(np.arange(0, s * s + 1, s, dtype=np.int32), (h, 1))
+    cols = np.tile(np.tile(np.arange(s, dtype=np.int32), s), (h, 1))
+    out = _np(F.sparse_attention(paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+                                 paddle.to_tensor(offset), paddle.to_tensor(cols)))
+    logits = np.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(d)
+    p = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+    want = np.einsum("bhqk,bhkd->bhqd", p, v)
+    np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
+
+
+def test_class_center_sample():
+    lab = paddle.to_tensor(np.array([3, 7, 3], np.int64))
+    new_lab, sampled = F.class_center_sample(lab, num_classes=20, num_samples=6)
+    sv = _np(sampled)
+    assert 3 in sv and 7 in sv and len(sv) <= 6
+    nv = _np(new_lab)
+    assert (sv[nv] == np.array([3, 7, 3])).all()  # remap consistent
+
+
+def test_dynamic_decode_tuple_state_cell():
+    from paddle_tpu.nn.layer.extension import BeamSearchDecoder, dynamic_decode
+
+    paddle.seed(0)
+    cell = paddle.nn.LSTMCell(4, 4)
+    emb = paddle.nn.Embedding(6, 4)
+    dec = BeamSearchDecoder(cell, start_token=1, end_token=5, beam_size=2,
+                            embedding_fn=lambda t: emb(t),
+                            output_fn=lambda h: h @ paddle.to_tensor(
+                                np.random.default_rng(0).standard_normal((4, 6)).astype("float32")))
+    h0 = paddle.to_tensor(np.zeros((2, 4), np.float32))
+    c0 = paddle.to_tensor(np.zeros((2, 4), np.float32))
+    ids, scores = dynamic_decode(dec, inits=(h0, c0), max_step_num=5)
+    assert tuple(ids.shape)[:2] == (2, 2) and np.isfinite(_np(scores)).all()
+    # the post-start-token state must differ from zeros: beams diverge
+    assert len(set(map(tuple, _np(ids).reshape(4, -1).tolist()))) > 1
