@@ -107,3 +107,105 @@ def get_device_properties(device=None):
         "process_index": d.process_index,
         "total_memory": device_memory_limit(d),
     }
+
+
+# -- device-query surface (reference python/paddle/device/__init__.py) -------
+# On this framework the only accelerator is the TPU via PJRT; the CUDA/XPU/
+# NPU/MLU/IPU predicates exist for source compatibility and answer False.
+
+from .framework.core import get_device, set_device  # noqa: E402,F401
+from .framework.param_attr import (  # noqa: E402,F401
+    CPUPlace,
+    CUDAPinnedPlace,
+    CUDAPlace,
+    NPUPlace,
+    TPUPlace,
+)
+
+
+class XPUPlace(TPUPlace):
+    pass
+
+
+class MLUPlace(TPUPlace):
+    pass
+
+
+class IPUPlace(TPUPlace):
+    pass
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_rocm() -> bool:
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_npu() -> bool:
+    return False
+
+
+def is_compiled_with_mlu() -> bool:
+    return False
+
+
+def is_compiled_with_ipu() -> bool:
+    return False
+
+
+def is_compiled_with_cinn() -> bool:
+    # XLA is the tensor compiler here; the CINN-specific toggle is False
+    return False
+
+
+def get_cudnn_version():
+    return None  # no cuDNN on TPU
+
+
+def get_all_device_type():
+    import jax
+
+    return sorted({d.platform for d in jax.devices()} | {"cpu"})
+
+
+def get_all_custom_device_type():
+    return [p for p in get_all_device_type() if p not in ("cpu", "gpu", "tpu")]
+
+
+def get_available_device():
+    import jax
+
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def get_available_custom_device():
+    return [d for d in get_available_device()
+            if d.split(":")[0] not in ("cpu", "gpu", "tpu")]
+
+
+class _CudaNamespace:
+    """paddle.device.cuda compatibility: memory queries map to the PJRT
+    allocator stats above (reference device/cuda/__init__.py)."""
+
+    max_memory_allocated = staticmethod(max_memory_allocated)
+    max_memory_reserved = staticmethod(max_memory_reserved)
+    memory_allocated = staticmethod(memory_allocated)
+    memory_reserved = staticmethod(memory_reserved)
+    empty_cache = staticmethod(empty_cache)
+    device_count = staticmethod(device_count)
+    get_device_properties = staticmethod(get_device_properties)
+
+    @staticmethod
+    def synchronize(device=None):
+        import jax
+
+        jax.block_until_ready(jax.numpy.zeros(()))
+
+
+cuda = _CudaNamespace()

@@ -9,6 +9,7 @@ traceable, and static-capturable like every other tensor op.
 from __future__ import annotations
 
 import jax.numpy as jnp
+import numpy as np
 
 from .tensor._helpers import ensure_tensor, op
 
@@ -112,3 +113,41 @@ def fftshift(x, axes=None, name=None):
 
 def ifftshift(x, axes=None, name=None):
     return op(lambda v: jnp.fft.ifftshift(v, axes=axes), ensure_tensor(x), _name="ifftshift")
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    """N-d Hermitian FFT (reference paddle.fft.hfftn). jnp has no hfftn;
+    identity: hfftn(x, norm) = irfftn(conj(x), s, backward) * prod(S) with
+    the requested norm applied as an explicit scale."""
+
+    def fn(v):
+        if axes is not None:
+            ax = tuple(axes)
+        else:  # numpy semantics: s picks the LAST len(s) axes
+            ax = tuple(range(v.ndim))[-len(s):] if s is not None else tuple(range(v.ndim))
+        if s is None:
+            shape = [v.shape[a] for a in ax]
+            shape[-1] = max(2 * (v.shape[ax[-1]] - 1), 1)
+        else:
+            shape = list(s)
+        N = float(np.prod(shape))
+        scale = {"backward": 1.0, "ortho": 1.0 / np.sqrt(N), "forward": 1.0 / N}[norm]
+        return jnp.fft.irfftn(jnp.conj(v), s=shape, axes=ax, norm="backward") * N * scale
+
+    return op(fn, ensure_tensor(x), _name="hfftn")
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    """Inverse of hfftn: conj(rfftn(x, backward)) with the inverse scale."""
+
+    def fn(v):
+        if axes is not None:
+            ax = tuple(axes)
+        else:
+            ax = tuple(range(v.ndim))[-len(s):] if s is not None else tuple(range(v.ndim))
+        shape = list(s) if s is not None else [v.shape[a] for a in ax]
+        N = float(np.prod(shape))
+        scale = {"backward": 1.0 / N, "ortho": 1.0 / np.sqrt(N), "forward": 1.0}[norm]
+        return jnp.conj(jnp.fft.rfftn(v, s=shape, axes=ax, norm="backward")) * scale
+
+    return op(fn, ensure_tensor(x), _name="ihfftn")

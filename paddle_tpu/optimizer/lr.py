@@ -8,6 +8,8 @@ from __future__ import annotations
 
 import math
 
+import numpy as np
+
 import jax.numpy as jnp
 
 
@@ -273,3 +275,71 @@ class ReduceOnPlateau(LRScheduler):
             self.last_lr = max(self.last_lr * self.factor, self.min_lr)
             self.cooldown_counter = self.cooldown
             self.num_bad = 0
+
+
+class MultiplicativeDecay(LRScheduler):
+    """lr_{t} = lr_{t-1} * lr_lambda(t) (reference optimizer/lr.py
+    MultiplicativeDecay). Stateful product — lr_at(step) recomputes the
+    prefix product for traced use (host loop; schedulers run per epoch)."""
+
+    def __init__(self, learning_rate, lr_lambda, last_epoch=-1, verbose=False):
+        self.lr_lambda = lr_lambda
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def get_lr(self):
+        lr = self.base_lr
+        for t in range(1, self.last_epoch + 1):
+            lr *= self.lr_lambda(t)
+        return lr
+
+    def lr_at(self, step):
+        import jax.numpy as jnp
+
+        try:
+            s = int(step)
+        except TypeError:
+            raise NotImplementedError(
+                "MultiplicativeDecay needs a concrete step under tracing; "
+                "drive it per-epoch via scheduler.step()")
+        return jnp.asarray(self.get_lr() if s == self.last_epoch else
+                           self.base_lr * float(np.prod([self.lr_lambda(t) for t in range(1, s + 1)])),
+                           jnp.float32)
+
+
+class CyclicLR(LRScheduler):
+    """Triangular cyclic schedule (reference optimizer/lr.py CyclicLR):
+    cycles between base_learning_rate and max_learning_rate with
+    step_size_up/down, scaled per mode."""
+
+    def __init__(self, base_learning_rate, max_learning_rate, step_size_up,
+                 step_size_down=None, mode="triangular", exp_gamma=1.0,
+                 scale_fn=None, scale_mode="cycle", last_epoch=-1, verbose=False):
+        self.max_lr = max_learning_rate
+        self.up = int(step_size_up)
+        self.down = int(step_size_down) if step_size_down is not None else self.up
+        self.mode = mode
+        self.gamma = exp_gamma
+        if scale_fn is not None:
+            self.scale_fn, self.scale_mode = scale_fn, scale_mode
+        elif mode == "triangular":
+            self.scale_fn, self.scale_mode = (lambda c: 1.0), "cycle"
+        elif mode == "triangular2":
+            self.scale_fn, self.scale_mode = (lambda c: 1.0 / (2.0 ** (c - 1))), "cycle"
+        elif mode == "exp_range":
+            self.scale_fn, self.scale_mode = (lambda it: self.gamma ** it), "iterations"
+        else:
+            raise ValueError(mode)
+        super().__init__(base_learning_rate, last_epoch, verbose)
+
+    def get_lr(self):
+        return float(self.lr_at(max(self.last_epoch, 0)))
+
+    def lr_at(self, step):
+        # jnp ops so this traces inside compiled train steps (lr_at contract)
+        total = self.up + self.down
+        stepf = jnp.asarray(step, jnp.float32)
+        cycle = jnp.floor(1 + stepf / total)
+        it = stepf - (cycle - 1) * total
+        x = jnp.where(it <= self.up, it / self.up, 1.0 - (it - self.up) / self.down)
+        scale = self.scale_fn(cycle if self.scale_mode == "cycle" else stepf)
+        return (self.base_lr + (self.max_lr - self.base_lr) * x * scale).astype(jnp.float32)

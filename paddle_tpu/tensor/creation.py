@@ -225,3 +225,29 @@ def multinomial(x, num_samples=1, replacement=False, name=None):
         g = jax.random.gumbel(key, v.shape)
         _, out = jax.lax.top_k(logits + g, num_samples)
     return _wrap_value(out.astype(to_jax_dtype("int64")))
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    """In-place uniform refill (reference paddle.Tensor.uniform_)."""
+    from .manipulation import _inplace
+
+    x = ensure_tensor(x)
+    return _inplace("uniform_", x,
+                    lambda v: uniform(tuple(v.shape), str(v._value.dtype), min, max, seed))
+
+
+def exponential_(x, lam=1.0, name=None):
+    """In-place Exponential(lam) refill (reference paddle.Tensor.exponential_)."""
+    from ..framework import random as _random
+    from .manipulation import _inplace
+
+    x = ensure_tensor(x)
+
+    def fill(v):
+        import jax
+
+        key = _random.split_key()
+        u = jax.random.uniform(key, tuple(v.shape), jnp.float32, 1e-7, 1.0)
+        return op(lambda _: (-jnp.log(u) / lam).astype(v._value.dtype), v, _name="exponential_")
+
+    return _inplace("exponential_", x, fill)

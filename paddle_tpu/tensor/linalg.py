@@ -144,3 +144,64 @@ def lu(x, pivot=True, get_infos=False, name=None):
         return outs
 
     return op(fn, ensure_tensor(x), _name="lu")
+
+
+def cond(x, p=None, name=None):
+    """Condition number (reference paddle.linalg.cond): norm(x)·norm(x⁻¹)
+    for p in {None/'fro', 2, -2, 1, -1, inf, -inf, 'nuc'}."""
+    x = ensure_tensor(x)
+
+    def fn(v):
+        a = v.astype(jnp.float32)
+        if p in (None, 2, -2, "nuc"):
+            s = jnp.linalg.svd(a, compute_uv=False)
+            if p == "nuc":
+                return jnp.sum(s, -1) * jnp.sum(1.0 / s, -1)
+            return (s[..., 0] / s[..., -1]) if p != -2 else (s[..., -1] / s[..., 0])
+        inv = jnp.linalg.inv(a)
+        if p == "fro":
+            nrm = lambda m: jnp.sqrt(jnp.sum(m * m, axis=(-2, -1)))
+        elif p in (1, -1):
+            red = (jnp.max if p == 1 else jnp.min)
+            nrm = lambda m: red(jnp.sum(jnp.abs(m), axis=-2), axis=-1)
+        elif p in (float("inf"), float("-inf")):
+            red = (jnp.max if p == float("inf") else jnp.min)
+            nrm = lambda m: red(jnp.sum(jnp.abs(m), axis=-1), axis=-1)
+        else:
+            raise ValueError(f"unsupported p={p!r}")
+        return nrm(a) * nrm(inv)
+
+    return op(fn, x, _name="cond")
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    """Unpack lu()'s packed LU + pivots into (P, L, U) (reference
+    paddle.linalg.lu_unpack)."""
+    lu_t, piv = ensure_tensor(x), ensure_tensor(y)
+
+    def one(lv, pv):
+        m, n = lv.shape[-2], lv.shape[-1]
+        k = min(m, n)
+        L = jnp.tril(lv[:, :k], -1) + jnp.eye(m, k, dtype=lv.dtype)
+        U = jnp.triu(lv[:k, :])
+        # pivots are 0-based sequential swaps (jax.scipy lu_factor — what
+        # this repo's lu() returns): row i swapped with row pv[i]
+        perm = jnp.arange(m)
+        for i in range(pv.shape[-1]):
+            j = pv[i]
+            pi, pj = perm[i], perm[j]
+            perm = perm.at[i].set(pj).at[j].set(pi)
+        P = jax.nn.one_hot(perm, m, dtype=lv.dtype).T
+        return P, L, U
+
+    def fn(lv, pv):
+        if lv.ndim == 2:
+            return one(lv, pv)
+        lead = lv.shape[:-2]
+        lf = lv.reshape((-1,) + lv.shape[-2:])
+        pf = pv.reshape((-1, pv.shape[-1]))
+        P, L, U = jax.vmap(one)(lf, pf)
+        return (P.reshape(lead + P.shape[1:]), L.reshape(lead + L.shape[1:]),
+                U.reshape(lead + U.shape[1:]))
+
+    return op(fn, lu_t, piv, _name="lu_unpack")

@@ -84,3 +84,15 @@ def is_empty(x, name=None):
 
 def is_tensor(x):
     return isinstance(x, Tensor)
+
+
+def is_floating_point(x):
+    return jnp.issubdtype(ensure_tensor(x)._value.dtype, jnp.floating)
+
+
+def is_integer(x):
+    return jnp.issubdtype(ensure_tensor(x)._value.dtype, jnp.integer)
+
+
+def is_complex(x):
+    return jnp.issubdtype(ensure_tensor(x)._value.dtype, jnp.complexfloating)

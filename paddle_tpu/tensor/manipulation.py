@@ -452,3 +452,14 @@ def unsqueeze_(x, axis, name=None):
 def scatter_(x, index, updates, overwrite=True, name=None):
     x = ensure_tensor(x)
     return _inplace("scatter_", x, lambda v: scatter(v, index, updates, overwrite))
+
+
+def put_along_axis_(arr, indices, values, axis, reduce="assign", name=None):
+    x = ensure_tensor(arr)
+    return _inplace("put_along_axis_", x,
+                    lambda v: put_along_axis(v, indices, values, axis, reduce))
+
+
+def flatten_(x, start_axis=0, stop_axis=-1, name=None):
+    x = ensure_tensor(x)
+    return _inplace("flatten_", x, lambda v: flatten(v, start_axis, stop_axis))

@@ -570,3 +570,32 @@ def tanh_(x, name=None):
 
     x = ensure_tensor(x)
     return _inplace("tanh_", x, tanh)
+
+
+# -- inplace tail (reference paddle.Tensor.add_ etc.) -----------------------
+
+
+def _mk_inplace(name, fn):
+    from .manipulation import _inplace
+
+    def inplace(x, *args, **kwargs):
+        return _inplace(name, ensure_tensor(x), lambda v: fn(v, *args, **kwargs))
+
+    inplace.__name__ = name
+    inplace.__doc__ = f"In-place {name[:-1]} (reference paddle.{name})."
+    return inplace
+
+
+add_ = _mk_inplace("add_", lambda v, y, name=None: add(v, y))
+subtract_ = _mk_inplace("subtract_", lambda v, y, name=None: subtract(v, y))
+ceil_ = _mk_inplace("ceil_", lambda v, name=None: ceil(v))
+floor_ = _mk_inplace("floor_", lambda v, name=None: floor(v))
+exp_ = _mk_inplace("exp_", lambda v, name=None: exp(v))
+sqrt_ = _mk_inplace("sqrt_", lambda v, name=None: sqrt(v))
+rsqrt_ = _mk_inplace("rsqrt_", lambda v, name=None: rsqrt(v))
+reciprocal_ = _mk_inplace("reciprocal_", lambda v, name=None: reciprocal(v))
+round_ = _mk_inplace("round_", lambda v, name=None: round(v))
+clip_ = _mk_inplace("clip_", lambda v, min=None, max=None, name=None: clip(v, min, max))
+scale_ = _mk_inplace("scale_", lambda v, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None: globals()["scale"](v, scale, bias, bias_after_scale, act))
+erfinv_ = _mk_inplace("erfinv_", lambda v, name=None: erfinv(v))
+lerp_ = _mk_inplace("lerp_", lambda v, y, weight, name=None: lerp(v, y, weight))

@@ -234,3 +234,286 @@ def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio=32,
     import jax
 
     return op(fn, x, img_size, _name="yolo_box")
+
+
+# -- round-4 ops tail --------------------------------------------------------
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    """Position-sensitive RoI pooling (reference psroi_pool_op, R-FCN):
+    input channels are grouped as [out_c, ph, pw]; bin (i, j) of the output
+    average-pools its own channel group over that spatial bin."""
+    import jax.numpy as jnp
+
+    if isinstance(output_size, int):
+        ph = pw = output_size
+    else:
+        ph, pw = output_size
+
+    def fn(v, bx, bn):
+        N, C, H, W = v.shape
+        out_c = C // (ph * pw)
+        R = bx.shape[0]
+        # map each roi to its source image via boxes_num prefix sums (same
+        # contract as roi_align)
+        img_of = jnp.searchsorted(jnp.cumsum(bn), jnp.arange(R), side="right")
+        x1 = bx[:, 0] * spatial_scale
+        y1 = bx[:, 1] * spatial_scale
+        x2 = bx[:, 2] * spatial_scale
+        y2 = bx[:, 3] * spatial_scale
+        bh = jnp.maximum(y2 - y1, 0.1) / ph
+        bw = jnp.maximum(x2 - x1, 0.1) / pw
+        vg = v.reshape(N, out_c, ph, pw, H, W)
+        ys = jnp.arange(H)[None, None, :]  # [1,1,H]
+        xs = jnp.arange(W)[None, None, :]
+        outs = []
+        for i in range(ph):
+            for j in range(pw):
+                ys0 = (y1 + i * bh)[:, None]
+                ys1 = (y1 + (i + 1) * bh)[:, None]
+                xs0 = (x1 + j * bw)[:, None]
+                xs1 = (x1 + (j + 1) * bw)[:, None]
+                my = ((ys[0] >= jnp.floor(ys0)) & (ys[0] < jnp.ceil(ys1))).astype(v.dtype)  # [R,H]
+                mx = ((xs[0] >= jnp.floor(xs0)) & (xs[0] < jnp.ceil(xs1))).astype(v.dtype)  # [R,W]
+                m2 = my[:, :, None] * mx[:, None, :]  # [R,H,W]
+                cnt = jnp.maximum(m2.sum((1, 2)), 1.0)  # [R]
+                grp = vg[img_of, :, i, j]  # [R, out_c, H, W]
+                pooled = jnp.einsum("rchw,rhw->rc", grp, m2) / cnt[:, None]
+                outs.append(pooled)
+        out = jnp.stack(outs, -1).reshape(R, out_c, ph, pw)
+        return out
+
+    return op(fn, ensure_tensor(x), ensure_tensor(boxes), ensure_tensor(boxes_num),
+              _name="psroi_pool")
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0, dilation=1,
+                  deformable_groups=1, groups=1, mask=None, name=None):
+    """Deformable conv v1/v2 (reference deform_conv2d / deform_conv2d_op):
+    bilinear-sample the input at offset-shifted tap locations, then a dense
+    matmul per output position — gathers + one MXU contraction, no custom
+    kernel."""
+    import jax.numpy as jnp
+
+    if groups != 1 or deformable_groups != 1:
+        raise NotImplementedError("groups/deformable_groups > 1")
+    st = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    pd = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    dl = (dilation, dilation) if isinstance(dilation, int) else tuple(dilation)
+    args = [ensure_tensor(x), ensure_tensor(offset), ensure_tensor(weight)]
+    if mask is not None:
+        args.append(ensure_tensor(mask))
+    if bias is not None:
+        args.append(ensure_tensor(bias))
+    has_mask, has_bias = mask is not None, bias is not None
+
+    def fn(v, off, w, *rest):
+        mk = rest[0] if has_mask else None
+        bs = rest[-1] if has_bias else None
+        N, C, H, W = v.shape
+        OC, IC, KH, KW = w.shape
+        HO = (H + 2 * pd[0] - dl[0] * (KH - 1) - 1) // st[0] + 1
+        WO = (W + 2 * pd[1] - dl[1] * (KW - 1) - 1) // st[1] + 1
+        base_y = jnp.arange(HO)[:, None] * st[0] - pd[0]
+        base_x = jnp.arange(WO)[None, :] * st[1] - pd[1]
+        cols = []
+        off = off.reshape(N, KH, KW, 2, HO, WO)
+        for ki in range(KH):
+            for kj in range(KW):
+                dy = off[:, ki, kj, 0]
+                dx = off[:, ki, kj, 1]
+                sy = base_y[None] + ki * dl[0] + dy  # [N, HO, WO]
+                sx = base_x[None] + kj * dl[1] + dx
+                y0 = jnp.floor(sy)
+                x0 = jnp.floor(sx)
+                wy = sy - y0
+                wx = sx - x0
+
+                def g(yy, xx):
+                    inb = (yy >= 0) & (yy < H) & (xx >= 0) & (xx < W)
+                    yc = jnp.clip(yy, 0, H - 1).astype(jnp.int32)
+                    xc = jnp.clip(xx, 0, W - 1).astype(jnp.int32)
+                    vals = v[jnp.arange(N)[:, None, None], :, yc, xc]  # [N,HO,WO,C]
+                    return jnp.where(inb[..., None], vals, 0.0)
+
+                s = (g(y0, x0) * ((1 - wy) * (1 - wx))[..., None]
+                     + g(y0, x0 + 1) * ((1 - wy) * wx)[..., None]
+                     + g(y0 + 1, x0) * (wy * (1 - wx))[..., None]
+                     + g(y0 + 1, x0 + 1) * (wy * wx)[..., None])
+                if mk is not None:
+                    mkk = mk.reshape(N, KH, KW, HO, WO)[:, ki, kj]
+                    s = s * mkk[..., None]
+                cols.append(s)  # [N,HO,WO,C]
+        col = jnp.stack(cols, axis=3)  # [N,HO,WO,KH*KW,C]
+        wflat = w.reshape(OC, IC, KH * KW).transpose(2, 1, 0)  # [KK, IC, OC]
+        out = jnp.einsum("nhwkc,kco->nohw", col, wflat,
+                         preferred_element_type=jnp.float32).astype(v.dtype)
+        if bs is not None:
+            out = out + bs.reshape(1, -1, 1, 1)
+        return out
+
+    return op(fn, *args, _name="deform_conv2d")
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num, ignore_thresh,
+              downsample_ratio, gt_score=None, use_label_smooth=True, name=None, scale_x_y=1.0):
+    """YOLOv3 loss (reference yolov3_loss_op): per-cell box regression
+    (xy: bce, wh: l1), objectness with ignore threshold, class bce.
+    Single-scale form over the masked anchors."""
+    import jax
+    import jax.numpy as jnp
+
+    def fn(xv, gb, gl, *rest):
+        gs = rest[0] if gt_score is not None else None
+        N, _, Hc, Wc = xv.shape
+        A = len(anchor_mask)
+        pred = xv.reshape(N, A, 5 + class_num, Hc, Wc)
+        px = jax.nn.sigmoid(pred[:, :, 0])
+        py = jax.nn.sigmoid(pred[:, :, 1])
+        pw = pred[:, :, 2]
+        phh = pred[:, :, 3]
+        pobj = pred[:, :, 4]
+        pcls = pred[:, :, 5:]
+        an = np.asarray(anchors, np.float32).reshape(-1, 2)[list(anchor_mask)]
+        inp = Hc * downsample_ratio
+        B = gb.shape[1]
+        # target assignment (host-free, vectorized): each gt lands in one
+        # cell + best anchor by wh-IoU
+        gx = gb[:, :, 0] * Wc
+        gy = gb[:, :, 1] * Hc
+        gw = gb[:, :, 2] * inp
+        gh = gb[:, :, 3] * inp
+        valid = (gb[:, :, 2] > 0)
+        ci = jnp.clip(gx.astype(jnp.int32), 0, Wc - 1)
+        cj = jnp.clip(gy.astype(jnp.int32), 0, Hc - 1)
+        inter = (jnp.minimum(gw[..., None], an[:, 0]) * jnp.minimum(gh[..., None], an[:, 1]))
+        union = gw[..., None] * gh[..., None] + an[:, 0] * an[:, 1] - inter
+        best_a = jnp.argmax(inter / (union + 1e-9), axis=-1)  # [N, B]
+        smooth = 1.0 / max(class_num, 1) if use_label_smooth else 0.0
+
+        obj_t = jnp.zeros((N, A, Hc, Wc))
+        loss = jnp.zeros((N,))
+        bidx = jnp.arange(N)[:, None].repeat(B, 1)
+        sc = gs if gs is not None else jnp.ones((N, B))
+        tx = gx - jnp.floor(gx)
+        ty = gy - jnp.floor(gy)
+        tw = jnp.log(jnp.maximum(gw / an[best_a][..., 0], 1e-9))
+        th = jnp.log(jnp.maximum(gh / an[best_a][..., 1], 1e-9))
+        box_scale = 2.0 - gb[:, :, 2] * gb[:, :, 3]
+        sel = lambda t: t[bidx, best_a, cj, ci]  # [N, B]
+        bce = lambda z, t: jnp.maximum(z, 0) - z * t + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        mse = lambda a, b: jnp.abs(a - b)
+        lx = bce(jnp.log(sel(px) / (1 - sel(px) + 1e-9) + 1e-9), tx) * box_scale
+        ly = bce(jnp.log(sel(py) / (1 - sel(py) + 1e-9) + 1e-9), ty) * box_scale
+        lw = mse(sel(pw), tw) * box_scale
+        lh = mse(sel(phh), th) * box_scale
+        pc = pcls[bidx, best_a, :, cj, ci]  # [N, B, class_num]
+        tcls = jax.nn.one_hot(gl.reshape(N, B), class_num) * (1 - 2 * smooth) + smooth
+        lc = bce(pc, tcls).sum(-1)
+        per_gt = (lx + ly + lw + lh + lc) * valid * sc
+        obj_t = obj_t.at[bidx, best_a, cj, ci].max(valid.astype(jnp.float32))
+        lobj = bce(pobj, obj_t)
+        # ignore mask: cells whose prediction IoUs any gt above thresh but
+        # are not assigned keep zero objectness loss — approximated by the
+        # assigned-cell mask (full IoU map costs [N,A,H,W,B]); the assigned
+        # positives dominate the gradient signal.
+        loss = per_gt.sum(1) + lobj.sum((1, 2, 3))
+        return loss
+
+    args = [ensure_tensor(x), ensure_tensor(gt_box), ensure_tensor(gt_label)]
+    if gt_score is not None:
+        args.append(ensure_tensor(gt_score))
+    return op(fn, *args, _name="yolo_loss")
+
+
+def read_file(filename, name=None):
+    """File bytes as a uint8 tensor (reference read_file op)."""
+    import jax.numpy as jnp
+
+    from ..framework.core import _wrap_value
+
+    data = np.fromfile(filename, dtype=np.uint8)
+    return _wrap_value(jnp.asarray(data))
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """JPEG bytes tensor -> [C, H, W] uint8 tensor (reference decode_jpeg;
+    host-side via PIL — the reference decodes on CPU/nvjpeg)."""
+    import io
+
+    import jax.numpy as jnp
+    from PIL import Image
+
+    from ..framework.core import _wrap_value, unwrap
+
+    raw = bytes(np.asarray(unwrap(ensure_tensor(x))).astype(np.uint8))
+    img = Image.open(io.BytesIO(raw))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode == "rgb":
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return _wrap_value(jnp.asarray(arr))
+
+
+class RoIAlign:
+    """Layer form of roi_align (reference vision/ops.py RoIAlign)."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size, self.spatial_scale = output_size, spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return roi_align(x, boxes, boxes_num, self.output_size, self.spatial_scale)
+
+
+class RoIPool:
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size, self.spatial_scale = output_size, spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self.output_size, self.spatial_scale)
+
+
+class PSRoIPool:
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size, self.spatial_scale = output_size, spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self.output_size, self.spatial_scale)
+
+
+class DeformConv2D:
+    """Layer form of deform_conv2d holding weight/bias (reference
+    vision/ops.py DeformConv2D)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0,
+                 dilation=1, deformable_groups=1, groups=1, weight_attr=None, bias_attr=None):
+        import jax.numpy as jnp
+
+        from ..framework.core import _wrap_value
+        from ..framework.random import split_key
+
+        kh, kw = (kernel_size, kernel_size) if isinstance(kernel_size, int) else kernel_size
+        import jax
+
+        k = split_key()
+        fan = in_channels * kh * kw
+        self.weight = _wrap_value(jax.random.normal(k, (out_channels, in_channels, kh, kw),
+                                                    jnp.float32) / np.sqrt(fan), stop_gradient=False)
+        self.bias = None if bias_attr is False else _wrap_value(
+            jnp.zeros((out_channels,), jnp.float32), stop_gradient=False)
+        self.stride, self.padding, self.dilation = stride, padding, dilation
+        self.deformable_groups, self.groups = deformable_groups, groups
+
+    def __call__(self, x, offset, mask=None):
+        return deform_conv2d(x, offset, self.weight, self.bias, self.stride,
+                             self.padding, self.dilation, self.deformable_groups,
+                             self.groups, mask)
+
+
+__all__ += ["psroi_pool", "deform_conv2d", "yolo_loss", "read_file", "decode_jpeg",
+            "RoIAlign", "RoIPool", "PSRoIPool", "DeformConv2D"]

@@ -77,3 +77,273 @@ class Resize:
         yi = (np.arange(th) * h // th).clip(0, h - 1)
         xi = (np.arange(tw) * w // tw).clip(0, w - 1)
         return img[:, yi][:, :, xi]
+
+
+# -- round-4 transform tail (reference vision/transforms/transforms.py) ------
+# Built on the HWC/CHW-agnostic functionals in vision/functional.py.
+
+from . import functional as Fv  # noqa: E402
+from .functional import (  # noqa: E402,F401 — functional forms live here too
+    adjust_brightness,
+    adjust_contrast,
+    adjust_hue,
+    adjust_saturation,
+    affine,
+    center_crop,
+    crop,
+    erase,
+    hflip,
+    normalize,
+    pad,
+    perspective,
+    resize,
+    rotate,
+    to_grayscale,
+    to_tensor,
+    vflip,
+)
+
+
+def _img_hw(img):
+    """(h, w) of an image in this pipeline's conventions: Tensors are CHW
+    (the vision/functional.py contract, any channel count); ndarrays are HWC
+    unless the leading axis looks like 1/3 channels."""
+    from ..framework.core import Tensor
+
+    if isinstance(img, Tensor):
+        sh = tuple(img.shape)
+        return int(sh[-2]), int(sh[-1])
+    arr = np.asarray(img)
+    if arr.ndim == 3 and arr.shape[0] in (1, 3) and arr.shape[-1] not in (1, 3):
+        return arr.shape[1], arr.shape[2]
+    return arr.shape[0], arr.shape[1]
+
+
+class BaseTransform:
+    """Transform base (reference BaseTransform): keys select which inputs
+    get transformed; single-image transforms just implement _apply_image."""
+
+    def __init__(self, keys=None):
+        self.keys = keys or ("image",)
+
+    def _apply_image(self, img):
+        raise NotImplementedError
+
+    def __call__(self, inputs):
+        if isinstance(inputs, tuple):
+            out = [self._apply_image(v) if k == "image" else v
+                   for k, v in zip(self.keys, inputs)]
+            out.extend(inputs[len(self.keys):])  # extras pass through
+            return tuple(out)
+        return self._apply_image(inputs)
+
+
+class Transpose(BaseTransform):
+    def __init__(self, order=(2, 0, 1), keys=None):
+        super().__init__(keys)
+        self.order = order
+
+    def _apply_image(self, img):
+        return np.transpose(np.asarray(img), self.order)
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size, keys=None):
+        super().__init__(keys)
+        self.size = size
+
+    def _apply_image(self, img):
+        return Fv.center_crop(img, self.size)
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        super().__init__(keys)
+        self.padding, self.fill, self.mode = padding, fill, padding_mode
+
+    def _apply_image(self, img):
+        return Fv.pad(img, self.padding, self.fill, self.mode)
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1, keys=None):
+        super().__init__(keys)
+        self.n = num_output_channels
+
+    def _apply_image(self, img):
+        return Fv.to_grayscale(img, self.n)
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        f = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return Fv.adjust_brightness(img, f)
+
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        if value < 0:
+            raise ValueError("contrast value must be non-negative")
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        f = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return Fv.adjust_contrast(img, f)
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        f = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return Fv.adjust_saturation(img, f)
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        if not 0 <= value <= 0.5:
+            raise ValueError("hue value must be in [0, 0.5]")
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        return Fv.adjust_hue(img, np.random.uniform(-self.value, self.value))
+
+
+class ColorJitter(BaseTransform):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0, keys=None):
+        super().__init__(keys)
+        self.ts = [BrightnessTransform(brightness), ContrastTransform(contrast),
+                   SaturationTransform(saturation), HueTransform(hue)]
+
+    def _apply_image(self, img):
+        for t in np.random.permutation(self.ts):
+            img = t._apply_image(img)
+        return img
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if np.random.rand() < self.prob:
+            return Fv.vflip(img)
+        return img
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation="nearest", expand=False, center=None, fill=0, keys=None):
+        super().__init__(keys)
+        self.degrees = (-degrees, degrees) if np.isscalar(degrees) else tuple(degrees)
+        self.kw = dict(interpolation=interpolation, expand=expand, center=center, fill=fill)
+
+    def _apply_image(self, img):
+        angle = np.random.uniform(*self.degrees)
+        return Fv.rotate(img, angle, **self.kw)
+
+
+class RandomAffine(BaseTransform):
+    def __init__(self, degrees, translate=None, scale=None, shear=None, interpolation="nearest", fill=0, center=None, keys=None):
+        super().__init__(keys)
+        self.degrees = (-degrees, degrees) if np.isscalar(degrees) else tuple(degrees)
+        self.translate, self.scale_rng, self.shear = translate, scale, shear
+        self.interpolation, self.fill, self.center = interpolation, fill, center
+
+    def _apply_image(self, img):
+        h, w = _img_hw(img)
+        angle = np.random.uniform(*self.degrees)
+        tx = ty = 0
+        if self.translate:
+            tx = np.random.uniform(-self.translate[0], self.translate[0]) * w
+            ty = np.random.uniform(-self.translate[1], self.translate[1]) * h
+        sc = np.random.uniform(*self.scale_rng) if self.scale_rng else 1.0
+        if self.shear is None:
+            sh = (0, 0)
+        elif np.isscalar(self.shear):
+            sh = (np.random.uniform(-self.shear, self.shear), 0)
+        else:  # sequence form: (min, max) x-shear, or (xmin, xmax, ymin, ymax)
+            s = tuple(self.shear)
+            sh = (np.random.uniform(s[0], s[1]),
+                  np.random.uniform(s[2], s[3]) if len(s) == 4 else 0)
+        return Fv.affine(img, angle=angle, translate=(tx, ty), scale=sc, shear=sh,
+                         interpolation=self.interpolation, center=self.center, fill=self.fill)
+
+
+class RandomPerspective(BaseTransform):
+    def __init__(self, prob=0.5, distortion_scale=0.5, interpolation="nearest", fill=0, keys=None):
+        super().__init__(keys)
+        self.prob, self.d = prob, distortion_scale
+        self.interpolation, self.fill = interpolation, fill
+
+    def _apply_image(self, img):
+        if np.random.rand() >= self.prob:
+            return img
+        h, w = _img_hw(img)
+        dx, dy = self.d * w / 2, self.d * h / 2
+        start = [(0, 0), (w - 1, 0), (w - 1, h - 1), (0, h - 1)]
+        end = [(np.random.uniform(0, dx), np.random.uniform(0, dy)),
+               (w - 1 - np.random.uniform(0, dx), np.random.uniform(0, dy)),
+               (w - 1 - np.random.uniform(0, dx), h - 1 - np.random.uniform(0, dy)),
+               (np.random.uniform(0, dx), h - 1 - np.random.uniform(0, dy))]
+        return Fv.perspective(img, start, end, self.interpolation, self.fill)
+
+
+class RandomResizedCrop(BaseTransform):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3), interpolation="bilinear", keys=None):
+        super().__init__(keys)
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.scale, self.ratio, self.interpolation = scale, ratio, interpolation
+
+    def _apply_image(self, img):
+        h, w = _img_hw(img)
+        area = h * w
+        for _ in range(10):
+            target = np.random.uniform(*self.scale) * area
+            ar = np.exp(np.random.uniform(np.log(self.ratio[0]), np.log(self.ratio[1])))
+            cw = int(round(np.sqrt(target * ar)))
+            ch = int(round(np.sqrt(target / ar)))
+            if 0 < cw <= w and 0 < ch <= h:
+                top = np.random.randint(0, h - ch + 1)
+                left = np.random.randint(0, w - cw + 1)
+                return Fv.resize(Fv.crop(img, top, left, ch, cw), self.size, self.interpolation)
+        return Fv.resize(Fv.center_crop(img, min(h, w)), self.size, self.interpolation)
+
+
+class RandomErasing(BaseTransform):
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3), value=0, inplace=False, keys=None):
+        super().__init__(keys)
+        self.prob, self.scale, self.ratio = prob, scale, ratio
+        self.value, self.inplace = value, inplace
+
+    def _apply_image(self, img):
+        if np.random.rand() >= self.prob:
+            return img
+        h, w = _img_hw(img)
+        area = h * w
+        for _ in range(10):
+            target = np.random.uniform(*self.scale) * area
+            ar = np.random.uniform(*self.ratio)
+            eh = int(round(np.sqrt(target * ar)))
+            ew = int(round(np.sqrt(target / ar)))
+            if eh < h and ew < w:
+                i = np.random.randint(0, h - eh + 1)
+                j = np.random.randint(0, w - ew + 1)
+                return Fv.erase(img, i, j, eh, ew, self.value, self.inplace)
+        return img

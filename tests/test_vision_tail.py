@@ -1,0 +1,229 @@
+"""Vision API tail: transform functionals/classes + detection ops.
+
+Parity anchors: python/paddle/vision/transforms/functional.py,
+transforms/transforms.py, vision/ops.py (deform_conv2d, psroi_pool,
+yolo_loss, decode_jpeg).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.vision.transforms as T
+from paddle_tpu.vision import ops as V
+
+
+def _np(t):
+    return np.asarray(t.numpy() if hasattr(t, "numpy") else t)
+
+
+def test_flips_and_crops():
+    img = np.arange(2 * 4 * 4, dtype=np.uint8).reshape(4, 4, 2)
+    np.testing.assert_array_equal(T.hflip(img), img[:, ::-1])
+    np.testing.assert_array_equal(T.vflip(img), img[::-1])
+    c = T.crop(img, 1, 2, 2, 2)
+    np.testing.assert_array_equal(c, img[1:3, 2:4])
+    cc = T.center_crop(img, 2)
+    np.testing.assert_array_equal(cc, img[1:3, 1:3])
+    # CHW tensor path
+    t = paddle.to_tensor(np.transpose(img, (2, 0, 1)).astype("float32"))
+    np.testing.assert_array_equal(_np(T.hflip(t)), _np(t)[..., ::-1])
+
+
+def test_normalize_and_to_tensor():
+    img = np.full((2, 2, 3), 128, np.uint8)
+    t = T.to_tensor(img)
+    assert tuple(t.shape) == (3, 2, 2)
+    np.testing.assert_allclose(_np(t), 128 / 255.0, rtol=1e-4)
+    n = T.normalize(_np(t), mean=[0.5, 0.5, 0.5], std=[0.5, 0.5, 0.5])
+    np.testing.assert_allclose(n, (128 / 255.0 - 0.5) / 0.5, atol=1e-5)
+
+
+def test_resize_bilinear_and_nearest():
+    img = np.arange(16, dtype=np.float32).reshape(4, 4, 1)
+    up = T.resize(img, (8, 8))
+    assert up.shape == (8, 8, 1)
+    # average preserved under bilinear upsampling (interior-dominant)
+    assert abs(up.mean() - img.mean()) < 0.5
+    nn = T.resize(img, (2, 2), interpolation="nearest")
+    assert nn.shape == (2, 2, 1)
+    short = T.resize(np.zeros((4, 8, 1), np.float32), 2)
+    assert short.shape == (2, 4, 1)  # short side to 2, aspect kept
+
+
+def test_pad_modes():
+    img = np.ones((2, 2, 1), np.float32)
+    p = T.pad(img, 1)
+    assert p.shape == (4, 4, 1) and p[0, 0, 0] == 0
+    pr = T.pad(img, 1, padding_mode="reflect")
+    assert pr[0, 0, 0] == 1
+
+
+def test_adjusts():
+    img = np.full((2, 2, 3), 100, np.uint8)
+    np.testing.assert_array_equal(T.adjust_brightness(img, 2.0), np.full((2, 2, 3), 200, np.uint8))
+    same = T.adjust_contrast(img, 1.0)
+    np.testing.assert_array_equal(same, img)
+    g = T.to_grayscale(img, 3)
+    assert g.shape == img.shape
+    # hue by 0 is identity
+    rgb = np.random.default_rng(0).integers(0, 255, (3, 3, 3)).astype(np.uint8)
+    np.testing.assert_allclose(T.adjust_hue(rgb, 0.0), rgb, atol=2)
+    sat = T.adjust_saturation(rgb, 1.0)
+    np.testing.assert_allclose(sat, rgb, atol=1)
+
+
+def test_rotate_and_affine_identity():
+    img = np.random.default_rng(0).integers(0, 255, (5, 5, 1)).astype(np.uint8)
+    np.testing.assert_array_equal(T.rotate(img, 0), img)
+    r90 = T.rotate(img, 90)
+    assert r90.shape == img.shape
+    np.testing.assert_array_equal(T.affine(img), img)
+    ident = T.perspective(img, [(0, 0), (4, 0), (4, 4), (0, 4)],
+                          [(0, 0), (4, 0), (4, 4), (0, 4)])
+    np.testing.assert_array_equal(ident, img)
+
+
+def test_transform_classes():
+    np.random.seed(0)
+    img = np.random.default_rng(1).integers(0, 255, (8, 8, 3)).astype(np.uint8)
+    out = T.ColorJitter(0.4, 0.4, 0.4, 0.2)(img)
+    assert out.shape == img.shape
+    rc = T.RandomResizedCrop(4)(img)
+    assert rc.shape == (4, 4, 3)
+    er = T.RandomErasing(prob=1.0)(img.astype(np.float32))
+    assert (er == 0).any()
+    assert T.RandomVerticalFlip(prob=1.0)(img).shape == img.shape
+    assert T.RandomRotation(10)(img).shape == img.shape
+    assert T.RandomAffine(10, translate=(0.1, 0.1))(img).shape == img.shape
+    assert T.RandomPerspective(prob=1.0)(img).shape == img.shape
+    assert T.Grayscale()(img).shape == (8, 8, 1)
+    assert T.Pad(2)(img).shape == (12, 12, 3)
+    assert T.CenterCrop(4)(img).shape == (4, 4, 3)
+    assert T.Transpose()(img).shape == (3, 8, 8)
+    # tuple-input keyed transform
+    pair = T.CenterCrop(4, keys=("image", "label"))((img, 7))
+    assert pair[1] == 7 and pair[0].shape == (4, 4, 3)
+
+
+def test_deform_conv_zero_offset_matches_conv():
+    import paddle_tpu.nn.functional as F
+
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.standard_normal((1, 3, 6, 6)).astype("float32"))
+    w = paddle.to_tensor(rng.standard_normal((4, 3, 3, 3)).astype("float32"))
+    off = paddle.to_tensor(np.zeros((1, 2 * 9, 4, 4), np.float32))
+    got = _np(V.deform_conv2d(x, off, w))
+    want = _np(F.conv2d(x, w))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+    # v2 with all-ones mask identical
+    m = paddle.to_tensor(np.ones((1, 9, 4, 4), np.float32))
+    got2 = _np(V.deform_conv2d(x, off, w, mask=m))
+    np.testing.assert_allclose(got2, want, rtol=1e-4, atol=1e-4)
+
+
+def test_psroi_pool_shapes_and_values():
+    # 2x2 grid, 4 channels = 1 out channel x 2 x 2
+    x = paddle.to_tensor(np.stack([np.full((4, 4), float(i)) for i in range(4)])[None].astype("float32"))
+    boxes = paddle.to_tensor(np.array([[0.0, 0.0, 4.0, 4.0]], np.float32))
+    out = _np(V.psroi_pool(x, boxes, paddle.to_tensor(np.array([1], np.int32)), 2))
+    assert out.shape == (1, 1, 2, 2)
+    # bin (i,j) pools channel group i*2+j -> constant value i*2+j
+    np.testing.assert_allclose(out[0, 0], [[0, 1], [2, 3]], atol=1e-5)
+
+
+def test_yolo_loss_finite_and_assigned():
+    rng = np.random.default_rng(0)
+    N, A, C, Hc = 2, 3, 4, 5
+    x = paddle.to_tensor(rng.standard_normal((N, A * (5 + C), Hc, Hc)).astype("float32"))
+    gt_box = paddle.to_tensor(np.array([[[0.5, 0.5, 0.3, 0.4], [0, 0, 0, 0]]] * N, np.float32))
+    gt_label = paddle.to_tensor(np.zeros((N, 2), np.int64))
+    loss = _np(V.yolo_loss(x, gt_box, gt_label,
+                           anchors=[10, 13, 16, 30, 33, 23], anchor_mask=[0, 1, 2],
+                           class_num=C, ignore_thresh=0.7, downsample_ratio=32))
+    assert loss.shape == (N,) and np.isfinite(loss).all() and (loss > 0).all()
+
+
+def test_read_file_decode_jpeg_roundtrip(tmp_path):
+    from PIL import Image
+
+    # smooth gradient: JPEG-friendly so the round trip stays close
+    yy, xx = np.mgrid[0:10, 0:12]
+    arr = np.stack([yy * 20, xx * 15, (yy + xx) * 8], -1).astype(np.uint8)
+    p = str(tmp_path / "img.jpg")
+    Image.fromarray(arr).save(p, quality=95)
+    raw = V.read_file(p)
+    assert _np(raw).dtype == np.uint8 and _np(raw).size > 100
+    img = V.decode_jpeg(raw)
+    assert tuple(img.shape) == (3, 10, 12)
+    # lossy codec: just require closeness
+    assert np.abs(_np(img).astype(int).transpose(1, 2, 0) - arr.astype(int)).mean() < 12
+    gray = V.decode_jpeg(raw, mode="gray")
+    assert tuple(gray.shape) == (1, 10, 12)
+
+
+def test_roi_layer_forms():
+    x = paddle.to_tensor(np.random.default_rng(0).standard_normal((1, 4, 8, 8)).astype("float32"))
+    boxes = paddle.to_tensor(np.array([[0.0, 0.0, 7.0, 7.0]], np.float32))
+    bn = paddle.to_tensor(np.array([1], np.int32))
+    assert tuple(V.RoIAlign(2)(x, boxes, bn).shape) == (1, 4, 2, 2)
+    assert tuple(V.RoIPool(2)(x, boxes, bn).shape) == (1, 4, 2, 2)
+    assert tuple(V.PSRoIPool(2)(x, boxes, bn).shape) == (1, 1, 2, 2)
+    dc = V.DeformConv2D(4, 6, 3, padding=1)
+    off = paddle.to_tensor(np.zeros((1, 18, 8, 8), np.float32))
+    assert tuple(dc(x, off).shape) == (1, 6, 8, 8)
+
+
+def test_review_fixes():
+    # lu_unpack: 0-based pivots incl. identity-ish matrix + batched form
+    for M in (np.array([[4.0, 1.0], [0.5, 3.0]], np.float32),      # no swap
+              np.array([[0.0, 2.0], [3.0, 4.0]], np.float32)):     # swap
+        lu_t, piv = paddle.linalg.lu(paddle.to_tensor(M))
+        P, L, U = paddle.linalg.lu_unpack(lu_t, piv)
+        np.testing.assert_allclose(_np(P) @ _np(L) @ _np(U), M, atol=1e-5)
+    B = np.stack([np.array([[4.0, 1.0], [0.5, 3.0]], np.float32),
+                  np.array([[0.0, 2.0], [3.0, 4.0]], np.float32)])
+    lu_t, piv = paddle.linalg.lu(paddle.to_tensor(B))
+    P, L, U = paddle.linalg.lu_unpack(lu_t, piv)
+    np.testing.assert_allclose(np.einsum("bij,bjk,bkl->bil", _np(P), _np(L), _np(U)), B, atol=1e-5)
+
+    # psroi_pool uses boxes_num to pick the right image
+    x0 = np.zeros((4, 4, 4), np.float32)
+    x1 = np.stack([np.full((4, 4), float(i)) for i in range(4)])
+    x = paddle.to_tensor(np.stack([x0, x1]).astype("float32"))
+    boxes = paddle.to_tensor(np.array([[0, 0, 4, 4], [0, 0, 4, 4]], np.float32))
+    out = _np(V.psroi_pool(x, boxes, paddle.to_tensor(np.array([1, 1], np.int32)), 2))
+    np.testing.assert_allclose(out[0, 0], 0.0, atol=1e-6)          # from image 0
+    np.testing.assert_allclose(out[1, 0], [[0, 1], [2, 3]], atol=1e-5)  # image 1
+
+    # BaseTransform passes extra tuple elements through
+    img = np.zeros((8, 8, 3), np.uint8)
+    out = T.CenterCrop(4)((img, "label", 3))
+    assert out[1] == "label" and out[2] == 3 and out[0].shape == (4, 4, 3)
+
+    # hfftn with s shorter than ndim picks trailing axes
+    x3 = np.random.default_rng(0).standard_normal((2, 4, 4)).astype(np.float32)
+    out = _np(paddle.fft.ihfftn(paddle.to_tensor(x3), s=(4, 4)))
+    assert out.shape == (2, 4, 3)
+
+    # CyclicLR.lr_at traces
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.optimizer.lr import CyclicLR
+
+    cyc = CyclicLR(0.1, 0.5, 4)
+    traced = jax.jit(lambda s: cyc.lr_at(s))(jnp.asarray(4))
+    np.testing.assert_allclose(float(traced), 0.5, rtol=1e-6)
+
+    # RandomAffine sequence shear applies
+    np.random.seed(0)
+    ra = T.RandomAffine(0, shear=(30, 31))
+    g = np.zeros((7, 7, 1), np.uint8)
+    g[3, 3] = 255
+    sheared = ra(g)
+    assert sheared.shape == g.shape
+
+    # 4-channel CHW Tensor crops against real H/W
+    t4 = paddle.to_tensor(np.random.default_rng(0).standard_normal((4, 16, 20)).astype("float32"))
+    rc = T.RandomResizedCrop(8)(t4)
+    assert tuple(rc.shape) == (4, 8, 8)
