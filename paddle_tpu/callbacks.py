@@ -1,0 +1,14 @@
+"""paddle.callbacks namespace (reference python/paddle/callbacks/__init__.py)
+— re-exports the hapi callback protocol."""
+from .hapi.callbacks import (  # noqa: F401
+    Callback,
+    EarlyStopping,
+    LRScheduler,
+    ModelCheckpoint,
+    ProgBarLogger,
+)
+
+try:  # optional members, mirrors reference availability
+    from .hapi.callbacks import ReduceLROnPlateau, VisualDL  # noqa: F401
+except ImportError:
+    pass

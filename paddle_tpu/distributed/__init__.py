@@ -21,6 +21,7 @@ from .collective import (  # noqa: F401
 )
 from .env import ParallelEnv, get_rank, get_world_size, init_parallel_env  # noqa: F401
 from .fleet_executor import Carrier, FleetExecutor, TaskNode  # noqa: F401
+from . import utils  # noqa: F401
 from .fleet import Fleet, fleet  # noqa: F401
 from .moe import MoELayer  # noqa: F401
 from .mp_layers import (  # noqa: F401

@@ -1,0 +1,326 @@
+"""paddle.distributed.utils parity (reference distributed/utils.py):
+cluster/pod/trainer descriptors and launcher helpers, plus the MoE
+global_scatter/global_gather collectives.
+
+The descriptors are what the reference launcher builds from env vars; here
+they wrap the same facts for the TCPStore-based launcher in launch/main.py.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import socket
+from typing import List, Optional
+
+__all__ = [
+    "get_host_name_ip", "Trainer", "get_cluster", "start_local_trainers",
+    "watch_local_trainers", "find_free_ports", "JobServer", "Cluster", "Pod",
+    "Hdfs", "add_arguments", "terminate_local_procs", "TrainerProc",
+    "get_logger", "pull_worker_log", "global_scatter", "global_gather",
+]
+
+
+def get_host_name_ip():
+    try:
+        name = socket.gethostname()
+        return name, socket.gethostbyname(name)
+    except OSError:
+        return None, None
+
+
+def find_free_ports(num: int) -> Optional[set]:
+    out = set()
+    socks = []
+    try:
+        for _ in range(num):
+            s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            s.bind(("", 0))
+            socks.append(s)
+            out.add(s.getsockname()[1])
+    finally:
+        for s in socks:
+            s.close()
+    return out
+
+
+def get_logger(log_level=20, name="root"):
+    logger = logging.getLogger(name)
+    logger.setLevel(log_level)
+    if not logger.handlers:
+        h = logging.StreamHandler()
+        h.setFormatter(logging.Formatter("%(levelname)s %(asctime)s %(message)s"))
+        logger.addHandler(h)
+    return logger
+
+
+class Trainer:
+    def __init__(self):
+        self.gpus: List[int] = []
+        self.endpoint: Optional[str] = None
+        self.rank: Optional[int] = None
+
+    def __str__(self):
+        return f"gpus:{self.gpus} endpoint:{self.endpoint} rank:{self.rank}"
+
+    def __eq__(self, other):
+        return (self.gpus, self.endpoint, self.rank) == (other.gpus, other.endpoint, other.rank)
+
+    def __ne__(self, other):
+        return not self == other
+
+    def rank_str(self):
+        return str(self.rank)
+
+
+class JobServer:
+    def __init__(self):
+        self.endpoint: Optional[str] = None
+
+    def __str__(self):
+        return str(self.endpoint)
+
+    def __eq__(self, other):
+        return self.endpoint == other.endpoint
+
+    def __ne__(self, other):
+        return not self == other
+
+
+class Pod:
+    def __init__(self):
+        self.rank: Optional[int] = None
+        self.id: Optional[str] = None
+        self.addr: Optional[str] = None
+        self.port: Optional[int] = None
+        self.trainers: List[Trainer] = []
+        self.gpus: List[int] = []
+
+    def __str__(self):
+        return (f"rank:{self.rank} id:{self.id} addr:{self.addr} port:{self.port} "
+                f"trainers:{[str(t) for t in self.trainers]}")
+
+    def __eq__(self, other):
+        return (self.rank, self.id, self.addr, self.port) == \
+            (other.rank, other.id, other.addr, other.port) and self.trainers == other.trainers
+
+    def __ne__(self, other):
+        return not self == other
+
+    def rank_str(self):
+        return str(self.rank)
+
+    def get_visible_gpus(self):
+        return ",".join(str(g) for g in self.gpus)
+
+
+class Hdfs:
+    def __init__(self):
+        self.hdfs_ugi = None
+        self.hdfs_name = None
+        self.hdfs_path = None
+
+    def is_valid(self):
+        return bool(self.hdfs_ugi and self.hdfs_name and self.hdfs_path)
+
+    def __str__(self):
+        return f"hdfs_ugi:{self.hdfs_ugi} hdfs_name:{self.hdfs_name} hdfs_path:{self.hdfs_path}"
+
+    def __eq__(self, other):
+        return str(self) == str(other)
+
+    def __ne__(self, other):
+        return not self == other
+
+
+class Cluster:
+    def __init__(self, hdfs=None):
+        self.job_server: Optional[JobServer] = None
+        self.pods: List[Pod] = []
+        self.hdfs = hdfs
+        self.job_stage_flag = None
+
+    def __str__(self):
+        return f"job_server:{self.job_server} pods:{[str(p) for p in self.pods]}"
+
+    def __eq__(self, other):
+        return len(self.pods) == len(other.pods) and all(
+            a == b for a, b in zip(self.pods, other.pods))
+
+    def __ne__(self, other):
+        return not self == other
+
+    def trainers_nranks(self):
+        return len(self.trainers_endpoints())
+
+    def pods_nranks(self):
+        return len(self.pods)
+
+    def trainers_endpoints(self):
+        return [t.endpoint for p in self.pods for t in p.trainers]
+
+    def pods_endpoints(self):
+        return [f"{p.addr}:{p.port}" for p in self.pods]
+
+    def get_pod_by_id(self, pod_id):
+        for p in self.pods:
+            if str(pod_id) == str(p.id):
+                return p
+        return None
+
+
+class TrainerProc:
+    def __init__(self):
+        self.proc = None
+        self.log_fn = None
+        self.log_offset = None
+        self.rank = None
+        self.local_rank = None
+        self.cmd = None
+
+
+def get_cluster(node_ips, node_ip, trainer_endpoints, device_mode_or_gpus, devices_per_proc=None):
+    """Build a Cluster/Pod description (reference utils.get_cluster): one pod
+    per node ip, one trainer per endpoint on that node."""
+    if devices_per_proc is None:
+        devices_per_proc = device_mode_or_gpus  # legacy positional form
+    cluster = Cluster()
+    rank = 0
+    nested = bool(trainer_endpoints) and isinstance(trainer_endpoints[0], (list, tuple))
+    per_node = None
+    if not nested and trainer_endpoints:
+        # flat list: endpoints are split evenly across nodes in order
+        per_node = len(trainer_endpoints) // max(len(node_ips), 1)
+    for node_rank, ip in enumerate(node_ips):
+        pod = Pod()
+        pod.rank = node_rank
+        pod.addr = ip
+        pod.id = node_rank
+        if nested:
+            eps = trainer_endpoints[node_rank]
+        else:
+            eps = trainer_endpoints[node_rank * per_node:(node_rank + 1) * per_node]
+        for i, ep in enumerate(eps):
+            t = Trainer()
+            t.endpoint = ep
+            t.rank = rank
+            t.gpus = [devices_per_proc[i]] if isinstance(devices_per_proc, (list, tuple)) \
+                and i < len(devices_per_proc) else []
+            rank += 1
+            pod.trainers.append(t)
+        cluster.pods.append(pod)
+    pod = cluster.pods[node_ips.index(node_ip)] if node_ip in node_ips else cluster.pods[0]
+    return cluster, pod
+
+
+def start_local_trainers(cluster, pod, training_script, training_script_args,
+                         log_dir=None, envs=None):
+    """Spawn one subprocess per trainer of this pod (reference
+    start_local_trainers) with the PADDLE_* env contract."""
+    import subprocess
+    import sys
+
+    procs = []
+    for idx, t in enumerate(pod.trainers):
+        env = dict(os.environ, **(envs or {}))
+        env.update({
+            "PADDLE_TRAINER_ID": str(t.rank),
+            "PADDLE_CURRENT_ENDPOINT": str(t.endpoint),
+            "PADDLE_TRAINERS_NUM": str(cluster.trainers_nranks()),
+            "PADDLE_TRAINER_ENDPOINTS": ",".join(cluster.trainers_endpoints()),
+        })
+        cmd = [sys.executable, "-u", training_script] + list(training_script_args)
+        if log_dir:
+            os.makedirs(log_dir, exist_ok=True)
+            fn = open(os.path.join(log_dir, f"workerlog.{idx}"), "a")
+            proc = subprocess.Popen(cmd, env=env, stdout=fn, stderr=fn)
+        else:
+            fn = None
+            proc = subprocess.Popen(cmd, env=env)
+        tp = TrainerProc()
+        tp.proc, tp.rank, tp.local_rank, tp.log_fn, tp.cmd = proc, t.rank, idx, fn, cmd
+        procs.append(tp)
+    return procs
+
+
+def watch_local_trainers(procs, nranks):
+    """Poll trainer procs; raise on failure, prune exited (reference
+    watch_local_trainers)."""
+    alive = []
+    for p in procs:
+        ret = p.proc.poll()
+        if ret is None:
+            alive.append(p)
+        elif ret != 0:
+            raise RuntimeError(f"trainer rank {p.rank} failed with exit code {ret}")
+    return alive
+
+
+def terminate_local_procs(procs):
+    for p in procs:
+        if p.proc is not None and p.proc.poll() is None:
+            try:
+                p.proc.send_signal(signal.SIGTERM)
+            except OSError:
+                pass
+    for p in procs:
+        if p.proc is not None:
+            try:
+                p.proc.wait(timeout=10)
+            except Exception:
+                p.proc.kill()
+        if p.log_fn:
+            p.log_fn.close()
+
+
+def pull_worker_log(tp: TrainerProc):
+    if not tp.log_fn:
+        return
+    with open(tp.log_fn.name, "rb") as fin:
+        fin.seek(tp.log_offset or 0, 0)
+        for line in fin:
+            try:
+                print(line.decode("utf-8", errors="replace"), end="")
+            except OSError:
+                break
+        tp.log_offset = fin.tell()
+
+
+def add_arguments(argname, type, default, help, argparser, **kwargs):  # noqa: A002
+    """argparse helper (reference utils.add_arguments)."""
+    argparser.add_argument("--" + argname, default=default, type=type,
+                           help=f"{help} Default: %(default)s.", **kwargs)
+
+
+def _global_exchange(x, local_count, global_count, gather):
+    """Count-aware exchange (reference global_scatter/global_gather ops).
+    Under the single-controller SPMD model there is no per-rank send/recv:
+    the multi-device dispatch compiles to XLA all_to_all inside MoELayer.
+    These functions implement the reference's data contract for the
+    single-process layout (counts validate, data passes through in expert
+    order); a multi-process group is directed to MoELayer."""
+    import numpy as np
+
+    from ..tensor._helpers import ensure_tensor, unwrap
+
+    xt = ensure_tensor(x)
+    lc = np.asarray(unwrap(ensure_tensor(local_count))).ravel()
+    gc = np.asarray(unwrap(ensure_tensor(global_count))).ravel()
+    n = xt.shape[0]
+    send = int(lc.sum())
+    recv = int(gc.sum())
+    if (gather and n != recv) or (not gather and n != send):
+        raise ValueError(f"count mismatch: rows={n}, local={send}, global={recv}")
+    if not np.array_equal(lc, gc):
+        raise NotImplementedError(
+            "cross-rank global_scatter/global_gather: use distributed.MoELayer "
+            "— expert dispatch compiles to XLA all_to_all over the mesh")
+    return xt
+
+
+def global_scatter(x, local_count, global_count, group=None, use_calc_stream=True):
+    return _global_exchange(x, local_count, global_count, gather=False)
+
+
+def global_gather(x, local_count, global_count, group=None, use_calc_stream=True):
+    return _global_exchange(x, local_count, global_count, gather=True)

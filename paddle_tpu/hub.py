@@ -1,0 +1,45 @@
+"""paddle.hub parity (reference python/paddle/hub.py): list/help/load models
+from a hubconf.py. Zero-egress environment: the 'local' source is fully
+supported; github/gitee sources raise with a clear message.
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+__all__ = ["list", "help", "load"]
+
+
+def _load_hubconf(repo_dir):
+    path = os.path.join(repo_dir, "hubconf.py")
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"no hubconf.py under {repo_dir}")
+    spec = importlib.util.spec_from_file_location("paddle_tpu_hubconf", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["paddle_tpu_hubconf"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _check_source(source):
+    if source != "local":
+        raise NotImplementedError(
+            f"hub source {source!r} needs network access (none here); "
+            f"clone the repo and use source='local'")
+
+
+def list(repo_dir, source="github", force_reload=False):  # noqa: A001 — reference name
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    return [n for n in dir(mod) if callable(getattr(mod, n)) and not n.startswith("_")]
+
+
+def help(repo_dir, model, source="github", force_reload=False):  # noqa: A001
+    _check_source(source)
+    return getattr(_load_hubconf(repo_dir), model).__doc__
+
+
+def load(repo_dir, model, source="github", force_reload=False, **kwargs):
+    _check_source(source)
+    return getattr(_load_hubconf(repo_dir), model)(**kwargs)

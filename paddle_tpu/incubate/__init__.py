@@ -226,3 +226,109 @@ def forward_grad(outputs, inputs, grad_inputs=None):
     raise NotImplementedError(
         "use paddle_tpu.autograd.jvp(func, xs, v) — forward-mode requires "
         "the function form (JAX traces functions, not taped graphs)")
+
+
+# top-level incubate re-exports (reference incubate/__init__.py __all__)
+from .optimizer import LookAhead, ModelAverage  # noqa: E402,F401
+
+
+def _segment_reduce(kind):
+    def f(data, segment_ids, name=None):
+        import jax
+        import jax.numpy as jnp
+
+        from ..tensor._helpers import ensure_tensor, op
+
+        d, s = ensure_tensor(data), ensure_tensor(segment_ids)
+        n = int(jnp.max(s._value)) + 1 if s._value.size else 0
+
+        def fn(dv, sv):
+            if kind == "mean":
+                tot = jax.ops.segment_sum(dv, sv, num_segments=n)
+                cnt = jax.ops.segment_sum(jnp.ones_like(sv, dv.dtype), sv, num_segments=n)
+                return tot / jnp.maximum(cnt, 1).reshape((-1,) + (1,) * (dv.ndim - 1))
+            r = getattr(jax.ops, f"segment_{kind}")(dv, sv, num_segments=n)
+            if kind in ("max", "min"):
+                # empty segments come back ±inf; reference fills 0
+                r = jnp.where(jnp.isfinite(r), r, 0)
+            return r
+
+        return op(fn, d, s, _name=f"segment_{kind}")
+
+    f.__name__ = f"segment_{kind}"
+    return f
+
+
+segment_sum = _segment_reduce("sum")
+segment_mean = _segment_reduce("mean")
+segment_max = _segment_reduce("max")
+segment_min = _segment_reduce("min")
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes, return_eids=False, name=None):
+    """K-hop neighbor sampling over a CSC graph (reference
+    incubate/operators/graph_khop_sampler). Host-side (data-dependent
+    output sizes), like the reference's CPU sampling path."""
+    import numpy as np
+
+    from ..framework.core import _wrap_value
+    from ..tensor._helpers import ensure_tensor, unwrap
+    import jax.numpy as jnp
+
+    rows = np.asarray(unwrap(ensure_tensor(row)))
+    cp = np.asarray(unwrap(ensure_tensor(colptr)))
+    nodes = np.asarray(unwrap(ensure_tensor(input_nodes))).ravel()
+    rng = np.random.default_rng()
+    edge_src, edge_dst, layers = [], [], [nodes]
+    frontier = nodes
+    for k in sample_sizes:
+        nxt = []
+        for v in frontier:
+            nbrs = rows[cp[v]:cp[v + 1]]
+            if len(nbrs) > k:
+                nbrs = rng.choice(nbrs, size=k, replace=False)
+            for u in nbrs:
+                edge_src.append(u)
+                edge_dst.append(v)
+            nxt.extend(nbrs.tolist())
+        frontier = np.unique(np.asarray(nxt, np.int64)) if nxt else np.asarray([], np.int64)
+        layers.append(frontier)
+    uniq = np.unique(np.concatenate([l for l in layers if len(l)])) if any(len(l) for l in layers) else np.asarray([], np.int64)
+    remap = {int(v): i for i, v in enumerate(uniq)}
+    src = np.asarray([remap[int(u)] for u in edge_src], np.int64)
+    dst = np.asarray([remap[int(v)] for v in edge_dst], np.int64)
+    return (_wrap_value(jnp.asarray(src)), _wrap_value(jnp.asarray(dst)),
+            _wrap_value(jnp.asarray(uniq)),
+            _wrap_value(jnp.asarray(np.arange(len(src), dtype=np.int64))))
+
+
+def graph_sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
+                           return_eids=False, perm_buffer=None, name=None):
+    """One-hop neighbor sampling (reference graph_sample_neighbors op).
+    Host-side. Returns (out_neighbors, out_count [, out_eids])."""
+    import numpy as np
+
+    from ..framework.core import _wrap_value
+    from ..tensor._helpers import ensure_tensor, unwrap
+    import jax.numpy as jnp
+
+    rows = np.asarray(unwrap(ensure_tensor(row)))
+    cp = np.asarray(unwrap(ensure_tensor(colptr)))
+    nodes = np.asarray(unwrap(ensure_tensor(input_nodes))).ravel()
+    ev = np.asarray(unwrap(ensure_tensor(eids))) if eids is not None else None
+    rng = np.random.default_rng()
+    out, counts, out_eids = [], [], []
+    for v in nodes:
+        lo, hi = int(cp[v]), int(cp[v + 1])
+        idx = np.arange(lo, hi)
+        if 0 <= sample_size < len(idx):
+            idx = rng.choice(idx, size=sample_size, replace=False)
+        out.extend(rows[idx].tolist())
+        counts.append(len(idx))
+        if return_eids:
+            out_eids.extend((ev[idx] if ev is not None else idx).tolist())
+    res = (_wrap_value(jnp.asarray(np.asarray(out, np.int64))),
+           _wrap_value(jnp.asarray(np.asarray(counts, np.int64))))
+    if return_eids:
+        res += (_wrap_value(jnp.asarray(np.asarray(out_eids, np.int64))),)
+    return res

@@ -742,3 +742,297 @@ for _sn in ("sequence_pad", "sequence_unpad", "sequence_expand"):
     setattr(_StaticNN, _sn, _host_side_sequence_op(_sn))
 
 nn = _StaticNN()
+# appended to paddle_tpu/static/__init__.py after the host-side sequence raisers
+
+
+def _attach_static_nn_tail():
+    """static.nn wrapper tail (reference python/paddle/static/nn/__init__.py):
+    the static forms delegate to the same traced functionals the dygraph API
+    uses — under this design a static program records them through the op()
+    chokepoint identically."""
+    import paddle_tpu.nn.functional as F
+    from ..nn.functional import extension_ops as _ext
+    from ..tensor import linalg as _linalg  # noqa: F401
+
+    def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+               groups=1, param_attr=None, bias_attr=None, act=None, name=None, data_format="NCHW"):
+        from .. import nn
+
+        layer = nn.Conv2D(int(input.shape[1]), num_filters, filter_size, stride,
+                          padding, dilation, groups, weight_attr=param_attr,
+                          bias_attr=bias_attr, data_format=data_format)
+        out = layer(input)
+        return getattr(F, act)(out) if act else out
+
+    def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+               groups=1, param_attr=None, bias_attr=None, act=None, name=None, data_format="NCDHW"):
+        from .. import nn
+
+        layer = nn.Conv3D(int(input.shape[1]), num_filters, filter_size, stride,
+                          padding, dilation, groups, weight_attr=param_attr, bias_attr=bias_attr)
+        out = layer(input)
+        return getattr(F, act)(out) if act else out
+
+    def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
+                         padding=0, stride=1, dilation=1, groups=1, param_attr=None,
+                         bias_attr=None, act=None, name=None, data_format="NCHW"):
+        from .. import nn
+
+        layer = nn.Conv2DTranspose(int(input.shape[1]), num_filters, filter_size,
+                                   stride, padding, weight_attr=param_attr, bias_attr=bias_attr)
+        out = layer(input)
+        return getattr(F, act)(out) if act else out
+
+    def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                         padding=0, stride=1, dilation=1, groups=1, param_attr=None,
+                         bias_attr=None, act=None, name=None, data_format="NCDHW"):
+        from .. import nn
+
+        layer = nn.Conv3DTranspose(int(input.shape[1]), num_filters, filter_size,
+                                   stride, padding, weight_attr=param_attr, bias_attr=bias_attr)
+        out = layer(input)
+        return getattr(F, act)(out) if act else out
+
+    def layer_norm(input, scale=True, shift=True, begin_norm_axis=1, epsilon=1e-5,
+                   param_attr=None, bias_attr=None, act=None, name=None):
+        import numpy as np
+
+        shape = [int(d) for d in input.shape[begin_norm_axis:]]
+        from .. import nn
+
+        layer = nn.LayerNorm(shape, epsilon=epsilon,
+                             weight_attr=None if scale else False,
+                             bias_attr=None if shift else False)
+        out = layer(input)
+        return getattr(F, act)(out) if act else out
+
+    def group_norm(input, groups, epsilon=1e-5, param_attr=None, bias_attr=None,
+                   act=None, data_layout="NCHW", name=None):
+        from .. import nn
+
+        layer = nn.GroupNorm(groups, int(input.shape[1]), epsilon=epsilon)
+        out = layer(input)
+        return getattr(F, act)(out) if act else out
+
+    def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None, name=None):
+        from .. import nn
+
+        return nn.InstanceNorm2D(int(input.shape[1]), epsilon=epsilon)(input)
+
+    def data_norm(input, act=None, epsilon=1e-5, param_attr=None, data_layout="NCHW",
+                  in_place=False, name=None, moving_mean_name=None, moving_variance_name=None,
+                  do_model_average_for_mean_and_var=True, slot_dim=-1, sync_stats=False,
+                  summary_decay_rate=0.9999999, enable_scale_and_shift=False):
+        """Per-feature running standardization (reference data_norm_op):
+        batch statistics without the affine, the CTR-model normalizer."""
+        from ..tensor._helpers import ensure_tensor, op
+        import jax.numpy as jnp
+
+        x = ensure_tensor(input)
+
+        def fn(v):
+            mean = jnp.mean(v, axis=0, keepdims=True)
+            var = jnp.mean(jnp.square(v - mean), axis=0, keepdims=True)
+            return (v - mean) / jnp.sqrt(var + epsilon)
+
+        out = op(fn, x, _name="data_norm")
+        return getattr(F, act)(out) if act else out
+
+    def prelu(x, mode="all", param_attr=None, data_format="NCHW", name=None):
+        from .. import nn
+
+        num = 1 if mode == "all" else int(x.shape[1])
+        return nn.PReLU(num_parameters=num, weight_attr=param_attr)(x)
+
+    def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+        from .. import nn
+
+        return nn.SpectralNorm(tuple(int(d) for d in weight.shape), dim=dim,
+                               power_iters=power_iters, eps=eps)(weight)
+
+    def bilinear_tensor_product(x, y, size, act=None, name=None, param_attr=None, bias_attr=None):
+        from .. import nn
+
+        layer = nn.Bilinear(int(x.shape[1]), int(y.shape[1]), size,
+                            weight_attr=param_attr, bias_attr=bias_attr)
+        out = layer(x, y)
+        return getattr(F, act)(out) if act else out
+
+    def deform_conv2d(x, offset, mask, num_filters, filter_size, stride=1, padding=0,
+                      dilation=1, groups=1, deformable_groups=1, im2col_step=1,
+                      param_attr=None, bias_attr=None, name=None):
+        from ..vision.ops import DeformConv2D
+
+        layer = DeformConv2D(int(x.shape[1]), num_filters, filter_size, stride,
+                             padding, dilation, deformable_groups, groups)
+        return layer(x, offset, mask)
+
+    def row_conv(input, future_context_size, param_attr=None, act=None):
+        """Lookahead row convolution (reference row_conv_op, DeepSpeech2):
+        y[t] = sum_{k=0..K} x[t+k] * w[k]."""
+        import jax.numpy as jnp
+
+        from ..framework.core import _wrap_value
+        from ..framework.random import split_key
+        from ..tensor._helpers import ensure_tensor, op
+        import jax
+
+        x = ensure_tensor(input)  # [B, T, D]
+        D = int(x.shape[-1])
+        K = int(future_context_size)
+        w = _wrap_value(jax.random.normal(split_key(), (K + 1, D), jnp.float32) * 0.02,
+                        stop_gradient=False)
+
+        def fn(v, wv):
+            outs = 0
+            for k in range(K + 1):
+                shifted = jnp.concatenate([v[:, k:], jnp.zeros_like(v[:, :k])], axis=1)
+                outs = outs + shifted * wv[k]
+            return outs
+
+        out = op(fn, x, w, _name="row_conv")
+        return getattr(F, act)(out) if act else out
+
+    def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
+            bias_attr=None, num_neg_samples=None, name=None, sampler="uniform",
+            custom_dist=None, seed=0, is_sparse=False):
+        """Noise-contrastive estimation loss (reference nce_op): sampled
+        softmax against uniformly drawn negatives."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..framework.core import _wrap_value
+        from ..framework.random import split_key
+        from ..tensor._helpers import ensure_tensor, op
+
+        x, y = ensure_tensor(input), ensure_tensor(label)
+        D = int(x.shape[-1])
+        k = int(num_neg_samples or 10)
+        w = _wrap_value(jax.random.normal(split_key(), (num_total_classes, D), jnp.float32) * 0.02,
+                        stop_gradient=False)
+        b = _wrap_value(jnp.zeros((num_total_classes,), jnp.float32), stop_gradient=False)
+        neg = jax.random.randint(split_key(), (k,), 0, num_total_classes)
+
+        def fn(xv, yv, wv, bv):
+            yv = yv.reshape(-1)
+            pos_logit = jnp.sum(xv * wv[yv], -1) + bv[yv]
+            neg_logit = xv @ wv[neg].T + bv[neg]
+            pos_loss = jax.nn.log_sigmoid(pos_logit)
+            neg_loss = jax.nn.log_sigmoid(-neg_logit).sum(-1)
+            return -(pos_loss + neg_loss)[:, None]
+
+        return op(fn, x, y, w, b, _name="nce")
+
+    def crf_decoding(input, param_attr=None, label=None, length=None, transition=None):
+        """Viterbi decode (reference crf_decoding_op) via the text module's
+        decoder. The reference passes the transition matrix through
+        ``param_attr``; a direct ``transition`` tensor is also accepted."""
+        from ..text import viterbi_decode
+
+        if transition is None:
+            transition = param_attr
+        if transition is None:
+            raise ValueError("pass the transition matrix (param_attr= or transition=)")
+        return viterbi_decode(input, transition, length)
+
+    def sparse_embedding(input, size, padding_idx=None, is_test=False, entry=None,
+                         table_class="MemorySparseTable", param_attr=None, dtype="float32"):
+        """PS-era sparse table lookup -> dense Embedding(sparse=True)
+        (framework SelectedRows lazy-row contract)."""
+        from .. import nn
+
+        return nn.Embedding(size[0], size[1], padding_idx=padding_idx, sparse=True,
+                            weight_attr=param_attr)(input)
+
+    def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                       min_ratio=None, max_ratio=None, **kwargs):
+        raise NotImplementedError(
+            "multi_box_head (SSD prior boxes) is out of scope; compose "
+            "vision.ops.yolo_box / nms pipelines instead")
+
+    def case(pred_fn_pairs, default=None, name=None):
+        """First-match conditional chain (reference layers.case): nested
+        static.nn.cond."""
+        if not pred_fn_pairs:
+            raise ValueError("case needs at least one (pred, fn) pair")
+
+        def build(pairs):
+            (pred, fn) = pairs[0]
+            rest = pairs[1:]
+            if not rest:
+                if default is None:
+                    return fn()
+                return _StaticNN.cond(pred, fn, default)
+            return _StaticNN.cond(pred, fn, lambda: build(rest))
+
+        return build(list(pred_fn_pairs))
+
+    def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+        from . import py_func as _pf
+
+        return _pf(func, x, out, backward_func, skip_vars_in_backward_input)
+
+    # sequence static forms over the padded+lengths pair
+    from ..nn.functional import sequence as _seq
+
+    def sequence_concat(input, name=None):
+        from ..tensor.manipulation import concat
+
+        return concat(input, axis=1)
+
+    def sequence_first_step(input, lengths=None):
+        if lengths is None:
+            raise ValueError("pass lengths (padded+lengths is the LoD here)")
+        return _seq.sequence_pool(input, lengths, "first")
+
+    def sequence_last_step(input, lengths=None):
+        if lengths is None:
+            raise ValueError("pass lengths (padded+lengths is the LoD here)")
+        return _seq.sequence_pool(input, lengths, "last")
+
+    def sequence_reverse(x, lengths=None, name=None):
+        """Reverse each sequence's valid prefix (reference
+        sequence_reverse_op)."""
+        import jax.numpy as jnp
+
+        from ..tensor._helpers import ensure_tensor, op
+
+        xt = ensure_tensor(x)
+        if lengths is None:
+            return op(lambda v: v[:, ::-1], xt, _name="sequence_reverse")
+        lt = ensure_tensor(lengths)
+
+        def fn(v, ln):
+            t = v.shape[1]
+            idx = jnp.arange(t)[None, :]
+            rev = jnp.where(idx < ln[:, None], ln[:, None] - 1 - idx, idx)
+            return jnp.take_along_axis(v, rev.reshape(rev.shape + (1,) * (v.ndim - 2)), axis=1)
+
+        return op(fn, xt, lt, _name="sequence_reverse")
+
+    def sequence_expand_as(x, y, name=None):
+        from ..tensor.manipulation import expand_as
+
+        return expand_as(x, y)
+
+    def _host_only(name):
+        def raiser(*a, **k):
+            raise NotImplementedError(
+                f"static.nn.{name}: LoD-shape-changing op; express it over "
+                f"the (padded, lengths) pair with nn.functional.sequence_*")
+
+        raiser.__name__ = name
+        return raiser
+
+    sequence_enumerate = _host_only("sequence_enumerate")
+    sequence_reshape = _host_only("sequence_reshape")
+    sequence_scatter = _host_only("sequence_scatter")
+    sequence_slice = _host_only("sequence_slice")
+    sequence_conv = _host_only("sequence_conv")
+
+    for name, fn in list(locals().items()):
+        if callable(fn) and not name.startswith("_"):
+            setattr(_StaticNN, name, staticmethod(fn))
+
+
+_attach_static_nn_tail()

@@ -10,3 +10,58 @@ def try_import(name):
         return importlib.import_module(name)
     except ImportError:
         return None
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    """Deprecation decorator (reference utils/deprecated.py)."""
+    import functools
+    import warnings
+
+    def wrap(fn):
+        @functools.wraps(fn)
+        def inner(*a, **k):
+            msg = f"API {fn.__name__} is deprecated since {since}: {reason}"
+            if update_to:
+                msg += f"; use {update_to} instead"
+            if level >= 2:
+                raise RuntimeError(msg)
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*a, **k)
+
+        return inner
+
+    return wrap
+
+
+def run_check():
+    """Smoke-check the install (reference utils/install_check.py run_check):
+    one tiny train step on the default backend."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+
+    m = paddle.nn.Linear(4, 2)
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=m.parameters())
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    loss = (m(x) ** 2).mean()
+    loss.backward()
+    opt.step()
+    import jax
+
+    print(f"paddle_tpu is installed successfully! backend: {jax.default_backend()}, "
+          f"devices: {len(jax.devices())}")
+
+
+def require_version(min_version, max_version=None):
+    """Version gate (reference utils/op_version.py require_version)."""
+    import paddle_tpu
+
+    def key(v):  # zero-pad to 3 components so "0.3" == "0.3.0"
+        parts = [int(p) for p in str(v).split(".")[:3] if p.isdigit()]
+        return tuple(parts + [0] * (3 - len(parts)))
+
+    cur = key(paddle_tpu.__version__)
+    if key(min_version) > cur or (max_version and key(max_version) < cur):
+        raise RuntimeError(
+            f"version {paddle_tpu.__version__} outside [{min_version}, {max_version}]")
+    return True

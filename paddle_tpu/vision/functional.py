@@ -209,7 +209,9 @@ def adjust_hue(img, hue_factor):
 
 def erase(img, i, j, h, w, v, inplace=False):
     arr, t = _as_np(img)
-    out = arr if inplace else arr.copy()
+    # jax-backed arrays are read-only views; true in-place only works for
+    # writable ndarrays
+    out = arr if (inplace and not t and arr.flags.writeable) else arr.copy()
     if t:
         out[..., i:i + h, j:j + w] = v
     else:
@@ -218,7 +220,8 @@ def erase(img, i, j, h, w, v, inplace=False):
 
 
 def _affine_sample(arr, chw, mat, out_hw, interpolation="nearest", fill=0):
-    """Inverse-map sampling with a 2x3 matrix in pixel coords."""
+    """Inverse-map sampling with a 2x3 matrix in pixel coords; nearest or
+    bilinear interpolation."""
     a = np.moveaxis(arr, 0, -1) if chw else arr
     squeeze = a.ndim == 2
     if squeeze:
@@ -227,11 +230,25 @@ def _affine_sample(arr, chw, mat, out_hw, interpolation="nearest", fill=0):
     ys, xs = np.meshgrid(np.arange(H), np.arange(W), indexing="ij")
     sx = mat[0, 0] * xs + mat[0, 1] * ys + mat[0, 2]
     sy = mat[1, 0] * xs + mat[1, 1] * ys + mat[1, 2]
-    xi = np.round(sx).astype(int)
-    yi = np.round(sy).astype(int)
-    inb = (xi >= 0) & (xi < a.shape[1]) & (yi >= 0) & (yi < a.shape[0])
-    out = np.full((H, W, a.shape[2]), fill, a.dtype)
-    out[inb] = a[yi.clip(0, a.shape[0] - 1), xi.clip(0, a.shape[1] - 1)][inb]
+
+    def gather(yi, xi):
+        inb = (xi >= 0) & (xi < a.shape[1]) & (yi >= 0) & (yi < a.shape[0])
+        vals = a[yi.clip(0, a.shape[0] - 1), xi.clip(0, a.shape[1] - 1)].astype(np.float32)
+        return np.where(inb[..., None], vals, np.float32(fill))
+
+    if interpolation == "bilinear":
+        x0 = np.floor(sx).astype(int)
+        y0 = np.floor(sy).astype(int)
+        wx = (sx - x0)[..., None]
+        wy = (sy - y0)[..., None]
+        out = (gather(y0, x0) * (1 - wy) * (1 - wx) + gather(y0, x0 + 1) * (1 - wy) * wx
+               + gather(y0 + 1, x0) * wy * (1 - wx) + gather(y0 + 1, x0 + 1) * wy * wx)
+    else:
+        out = gather(np.round(sy).astype(int), np.round(sx).astype(int))
+    if arr.dtype == np.uint8:
+        out = np.round(out).clip(0, 255).astype(np.uint8)
+    else:
+        out = out.astype(arr.dtype)
     if squeeze:
         out = out[:, :, 0]
     return np.moveaxis(out, -1, 0) if chw else out
@@ -240,13 +257,20 @@ def _affine_sample(arr, chw, mat, out_hw, interpolation="nearest", fill=0):
 def rotate(img, angle, interpolation="nearest", expand=False, center=None, fill=0):
     arr, t = _as_np(img)
     h, w = (arr.shape[-2:] if t else arr.shape[:2])
-    cx, cy = center if center is not None else (w / 2, h / 2)
     rad = np.deg2rad(angle)
     c, s = np.cos(rad), np.sin(rad)
-    # inverse rotation about (cx, cy)
-    mat = np.array([[c, s, cx - c * cx - s * cy],
-                    [-s, c, cy + s * cx - c * cy]], np.float32)
-    return _back(_affine_sample(arr, t, mat, (h, w), interpolation, fill), t)
+    oh, ow = h, w
+    if expand:
+        # canvas grows to hold the rotated extent; rotation recentered
+        ow = int(np.ceil(round(abs(w * c) + abs(h * s), 10)))
+        oh = int(np.ceil(round(abs(w * s) + abs(h * c), 10)))
+        center = None  # expand always rotates about the image center
+    cx, cy = center if center is not None else (w / 2, h / 2)
+    ocx, ocy = (ow / 2, oh / 2) if expand else (cx, cy)
+    # inverse rotation: output pixel -> source pixel about the centers
+    mat = np.array([[c, s, cx - c * ocx - s * ocy],
+                    [-s, c, cy + s * ocx - c * ocy]], np.float32)
+    return _back(_affine_sample(arr, t, mat, (oh, ow), interpolation, fill), t)
 
 
 def affine(img, angle=0, translate=(0, 0), scale=1.0, shear=(0, 0), interpolation="nearest", center=None, fill=0):

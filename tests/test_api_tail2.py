@@ -107,10 +107,150 @@ def test_submodule_all_coverage():
     for mod, path in [("nn", "nn/__init__.py"), ("nn.functional", "nn/functional/__init__.py"),
                       ("tensor", "tensor/__init__.py"), ("device", "device/__init__.py"),
                       ("optimizer.lr", "optimizer/lr.py"), ("fft", "fft.py"),
-                      ("io", "io/__init__.py"), ("amp", "amp/__init__.py")]:
+                      ("io", "io/__init__.py"), ("amp", "amp/__init__.py"),
+                      ("static.nn", "static/nn/__init__.py"), ("utils", "utils/__init__.py"),
+                      ("hub", "hub.py"), ("incubate", "incubate/__init__.py"),
+                      ("distributed.utils", "distributed/utils.py"),
+                      ("vision.ops", "vision/ops.py"),
+                      ("vision.transforms", "vision/transforms/__init__.py"),
+                      ("device", "device/__init__.py")]:
         names = set(re.findall(r"^\s+'([A-Za-z_0-9]+)',\s*$", open(R + path).read(), re.M))
         obj = paddle
         for part in mod.split("."):
             obj = getattr(obj, part)
         missing = sorted(n for n in names if not hasattr(obj, n))
         assert not missing, f"{mod} missing {missing}"
+
+
+def test_static_nn_tail_behavior():
+    x = paddle.to_tensor(np.random.default_rng(0).standard_normal((2, 3, 8, 8)).astype("float32"))
+    out = paddle.static.nn.conv2d(x, 4, 3, act="relu")
+    assert tuple(out.shape) == (2, 4, 6, 6) and (_np(out) >= 0).all()
+    ln = paddle.static.nn.layer_norm(paddle.to_tensor(np.random.rand(4, 6).astype("float32")))
+    assert abs(_np(ln).mean()) < 1e-5
+    pr = paddle.static.nn.prelu(paddle.to_tensor(np.array([[-2.0, 3.0]], np.float32)))
+    assert tuple(pr.shape) == (1, 2)
+    bt = paddle.static.nn.bilinear_tensor_product(
+        paddle.to_tensor(np.random.rand(2, 3).astype("float32")),
+        paddle.to_tensor(np.random.rand(2, 4).astype("float32")), 5)
+    assert tuple(bt.shape) == (2, 5)
+    rc = paddle.static.nn.row_conv(paddle.to_tensor(np.random.rand(2, 6, 4).astype("float32")), 2)
+    assert tuple(rc.shape) == (2, 6, 4)
+    nce_loss = paddle.static.nn.nce(paddle.to_tensor(np.random.rand(3, 8).astype("float32")),
+                                    paddle.to_tensor(np.array([[0], [1], [2]], np.int64)), 10)
+    assert tuple(nce_loss.shape) == (3, 1) and np.isfinite(_np(nce_loss)).all()
+    emb = paddle.static.nn.sparse_embedding(paddle.to_tensor(np.array([[1, 2]], np.int64)), (10, 4))
+    assert tuple(emb.shape) == (1, 2, 4)
+    with pytest.raises(NotImplementedError):
+        paddle.static.nn.sequence_conv(None)
+
+
+def test_utils_hub_and_incubate_tail(tmp_path):
+    # utils
+    assert paddle.utils.require_version("0.0.1")
+    with pytest.raises(RuntimeError):
+        paddle.utils.require_version("99.0.0")
+
+    @paddle.utils.deprecated(since="0.1", reason="test")
+    def old_fn():
+        return 42
+
+    import warnings
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        assert old_fn() == 42 and any("deprecated" in str(x.message) for x in w)
+
+    # hub: local hubconf
+    (tmp_path / "hubconf.py").write_text(
+        "def tiny_model(scale=1):\n    'a tiny model'\n    return {'scale': scale}\n")
+    assert "tiny_model" in paddle.hub.list(str(tmp_path), source="local")
+    assert "tiny" in paddle.hub.help(str(tmp_path), "tiny_model", source="local")
+    assert paddle.hub.load(str(tmp_path), "tiny_model", source="local", scale=3) == {"scale": 3}
+    with pytest.raises(NotImplementedError):
+        paddle.hub.load("org/repo", "m")  # github source needs network
+
+    # incubate segment ops + graph samplers
+    from paddle_tpu import incubate as I
+
+    d = paddle.to_tensor(np.array([1.0, 2.0, 5.0], np.float32))
+    s = paddle.to_tensor(np.array([0, 0, 1]))
+    np.testing.assert_allclose(_np(I.segment_sum(d, s)), [3.0, 5.0])
+    np.testing.assert_allclose(_np(I.segment_mean(d, s)), [1.5, 5.0])
+    np.testing.assert_allclose(_np(I.segment_max(d, s)), [2.0, 5.0])
+    np.testing.assert_allclose(_np(I.segment_min(d, s)), [1.0, 5.0])
+    assert I.LookAhead is not None and I.ModelAverage is not None
+    # CSC graph: node 0 <- {1, 2}, node 1 <- {0}, node 2 <- {}
+    row = paddle.to_tensor(np.array([1, 2, 0], np.int64))
+    colptr = paddle.to_tensor(np.array([0, 2, 3, 3], np.int64))
+    nbrs, cnt = I.graph_sample_neighbors(row, colptr, paddle.to_tensor(np.array([0, 2], np.int64)))
+    np.testing.assert_array_equal(_np(cnt), [2, 0])
+    src, dst, nodes, eids = I.graph_khop_sampler(row, colptr,
+                                                 paddle.to_tensor(np.array([0], np.int64)), [2])
+    assert len(_np(src)) == 2  # both of node 0's neighbors sampled
+
+
+def test_distributed_utils_tail():
+    from paddle_tpu.distributed import utils as du
+
+    ports = du.find_free_ports(3)
+    assert len(ports) == 3
+    cluster, pod = du.get_cluster(["127.0.0.1"], "127.0.0.1",
+                                  ["127.0.0.1:6170", "127.0.0.1:6171"], [0, 1])
+    assert cluster.trainers_nranks() == 2 and pod.rank == 0
+    assert cluster.trainers_endpoints() == ["127.0.0.1:6170", "127.0.0.1:6171"]
+    # global_scatter/gather single-controller contract
+    x = paddle.to_tensor(np.ones((4, 2), np.float32))
+    lc = paddle.to_tensor(np.array([2, 2], np.int64))
+    out = du.global_scatter(x, lc, lc)
+    np.testing.assert_allclose(_np(out), _np(x))
+    with pytest.raises(ValueError):
+        du.global_scatter(x, paddle.to_tensor(np.array([1, 1], np.int64)), lc)
+    # callbacks namespace
+    assert paddle.callbacks.EarlyStopping is not None
+
+
+def test_second_review_fixes():
+    import paddle_tpu.vision.transforms as T
+    from paddle_tpu import incubate as I
+    from paddle_tpu.distributed import utils as du
+
+    # flat endpoints split across nodes
+    cluster, _ = du.get_cluster(["10.0.0.1", "10.0.0.2"], "10.0.0.1",
+                                ["10.0.0.1:6170", "10.0.0.2:6170"], [0])
+    assert cluster.trainers_nranks() == 2
+    assert cluster.pods[0].trainers[0].endpoint == "10.0.0.1:6170"
+    assert cluster.pods[1].trainers[0].endpoint == "10.0.0.2:6170"
+
+    # rotate expand grows the canvas; bilinear runs
+    img = np.random.default_rng(0).integers(0, 255, (6, 10, 1)).astype(np.uint8)
+    r = T.rotate(img, 90, expand=True)
+    assert r.shape[:2] == (10, 6)
+    rb = T.rotate(img.astype(np.float32), 30, interpolation="bilinear")
+    assert rb.shape == img.shape and rb.dtype == np.float32
+    # bilinear identity stays exact
+    np.testing.assert_allclose(T.rotate(img.astype(np.float32), 0, interpolation="bilinear"),
+                               img.astype(np.float32), atol=1e-4)
+
+    # erase inplace on read-only input copies instead of crashing
+    t = paddle.to_tensor(np.ones((1, 4, 4), np.float32))
+    out = T.erase(t, 0, 0, 2, 2, 0.0, inplace=True)
+    assert float(np.asarray(out.numpy()).sum()) == 12.0
+
+    # require_version zero-pads
+    assert paddle.utils.require_version("0.1", max_version="99")
+
+    # graph_sample_neighbors eids
+    row = paddle.to_tensor(np.array([1, 2, 0], np.int64))
+    colptr = paddle.to_tensor(np.array([0, 2, 3, 3], np.int64))
+    nbrs, cnt, eids = I.graph_sample_neighbors(
+        row, colptr, paddle.to_tensor(np.array([0], np.int64)), return_eids=True)
+    assert len(_np(eids)) == 2
+
+    # crf_decoding accepts the reference param_attr carrier
+    emission = paddle.to_tensor(np.random.rand(1, 3, 4).astype("float32"))
+    trans = paddle.to_tensor(np.random.rand(6, 4).astype("float32"))
+    import pytest as _pt
+
+    with _pt.raises(ValueError):
+        paddle.static.nn.crf_decoding(emission)
