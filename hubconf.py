@@ -1,0 +1,26 @@
+"""paddle.hub entry for this repo: `paddle.hub.load('/path/to/repo', 'resnet50', source='local')`."""
+
+
+def _vision(name):
+    def factory(pretrained=False, **kwargs):
+        import paddle_tpu as paddle
+
+        return getattr(paddle.vision.models, name)(**kwargs)
+
+    factory.__name__ = name
+    factory.__doc__ = f"paddle_tpu.vision.models.{name}"
+    return factory
+
+
+lenet = _vision("LeNet")
+resnet18 = _vision("resnet18")
+resnet50 = _vision("resnet50")
+vgg16 = _vision("vgg16")
+mobilenet_v2 = _vision("mobilenet_v2")
+
+
+def gpt_tiny(**kwargs):
+    """Tiny GPT for smoke tests (models/gpt.py GPTConfig.tiny)."""
+    from paddle_tpu.models.gpt import GPTConfig, GPTForPretraining
+
+    return GPTForPretraining(GPTConfig.tiny())

@@ -187,10 +187,12 @@ def get_cluster(node_ips, node_ip, trainer_endpoints, device_mode_or_gpus, devic
     cluster = Cluster()
     rank = 0
     nested = bool(trainer_endpoints) and isinstance(trainer_endpoints[0], (list, tuple))
-    per_node = None
-    if not nested and trainer_endpoints:
-        # flat list: endpoints are split evenly across nodes in order
-        per_node = len(trainer_endpoints) // max(len(node_ips), 1)
+    trainer_endpoints = trainer_endpoints or []
+    # flat list: endpoints are split evenly across nodes in order
+    per_node = len(trainer_endpoints) // max(len(node_ips), 1) if not nested else 0
+    if not nested and trainer_endpoints and per_node == 0:
+        raise ValueError(f"{len(trainer_endpoints)} endpoints cannot cover "
+                         f"{len(node_ips)} nodes")
     for node_rank, ip in enumerate(node_ips):
         pod = Pod()
         pod.rank = node_rank
@@ -244,15 +246,18 @@ def start_local_trainers(cluster, pod, training_script, training_script_args,
 
 
 def watch_local_trainers(procs, nranks):
-    """Poll trainer procs; raise on failure, prune exited (reference
-    watch_local_trainers)."""
+    """Poll trainer procs; raise on failure, prune (and close logs of)
+    cleanly exited ones (reference watch_local_trainers)."""
     alive = []
     for p in procs:
         ret = p.proc.poll()
         if ret is None:
             alive.append(p)
-        elif ret != 0:
-            raise RuntimeError(f"trainer rank {p.rank} failed with exit code {ret}")
+        else:
+            if p.log_fn:
+                p.log_fn.close()
+            if ret != 0:
+                raise RuntimeError(f"trainer rank {p.rank} failed with exit code {ret}")
     return alive
 
 

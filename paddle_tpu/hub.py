@@ -11,14 +11,21 @@ import sys
 __all__ = ["list", "help", "load"]
 
 
-def _load_hubconf(repo_dir):
-    path = os.path.join(repo_dir, "hubconf.py")
+_hubconf_cache: dict = {}
+
+
+def _load_hubconf(repo_dir, force_reload=False):
+    path = os.path.abspath(os.path.join(repo_dir, "hubconf.py"))
     if not os.path.exists(path):
         raise FileNotFoundError(f"no hubconf.py under {repo_dir}")
-    spec = importlib.util.spec_from_file_location("paddle_tpu_hubconf", path)
+    if not force_reload and path in _hubconf_cache:
+        return _hubconf_cache[path]
+    key = f"paddle_tpu_hubconf_{abs(hash(path)):x}"  # per-repo module identity
+    spec = importlib.util.spec_from_file_location(key, path)
     mod = importlib.util.module_from_spec(spec)
-    sys.modules["paddle_tpu_hubconf"] = mod
+    sys.modules[key] = mod
     spec.loader.exec_module(mod)
+    _hubconf_cache[path] = mod
     return mod
 
 
@@ -31,15 +38,15 @@ def _check_source(source):
 
 def list(repo_dir, source="github", force_reload=False):  # noqa: A001 — reference name
     _check_source(source)
-    mod = _load_hubconf(repo_dir)
+    mod = _load_hubconf(repo_dir, force_reload)
     return [n for n in dir(mod) if callable(getattr(mod, n)) and not n.startswith("_")]
 
 
 def help(repo_dir, model, source="github", force_reload=False):  # noqa: A001
     _check_source(source)
-    return getattr(_load_hubconf(repo_dir), model).__doc__
+    return getattr(_load_hubconf(repo_dir, force_reload), model).__doc__
 
 
 def load(repo_dir, model, source="github", force_reload=False, **kwargs):
     _check_source(source)
-    return getattr(_load_hubconf(repo_dir), model)(**kwargs)
+    return getattr(_load_hubconf(repo_dir, force_reload), model)(**kwargs)

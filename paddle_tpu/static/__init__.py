@@ -795,8 +795,6 @@ def _attach_static_nn_tail():
 
     def layer_norm(input, scale=True, shift=True, begin_norm_axis=1, epsilon=1e-5,
                    param_attr=None, bias_attr=None, act=None, name=None):
-        import numpy as np
-
         shape = [int(d) for d in input.shape[begin_norm_axis:]]
         from .. import nn
 
