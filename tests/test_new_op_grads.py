@@ -1,0 +1,79 @@
+"""Numeric-vs-analytic gradient checks (OpTest methodology, op_test.py) for
+the round-4 op tail: CTC, margin CE, hsigmoid, deform conv, grid_sample,
+renorm, sequence pool/softmax, fold, qdq-STE envelope."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from tests.op_test import check_grad
+
+
+def test_ctc_loss_grad():
+    rng = np.random.default_rng(0)
+    logits = rng.standard_normal((4, 2, 3)).astype(np.float32) * 0.5
+    labels = paddle.to_tensor(np.array([[1, 2], [2, 1]], np.int64))
+    il = paddle.to_tensor(np.array([4, 4]))
+    ll = paddle.to_tensor(np.array([2, 2]))
+    check_grad(lambda lg: F.ctc_loss(lg, labels, il, ll, reduction="sum"), [logits])
+
+
+def test_margin_cross_entropy_grad():
+    rng = np.random.default_rng(1)
+    cos = (rng.standard_normal((3, 6)) * 0.4).clip(-0.9, 0.9).astype(np.float32)
+    y = paddle.to_tensor(np.array([0, 3, 5], np.int64))
+    check_grad(lambda lg: F.margin_cross_entropy(lg, y, reduction="sum"), [cos],
+               atol=1e-2, rtol=1e-2)
+
+
+def test_hsigmoid_grad():
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal((3, 5)).astype(np.float32) * 0.5
+    w = rng.standard_normal((5, 5)).astype(np.float32) * 0.5
+    lab = paddle.to_tensor(np.array([[0], [2], [4]], np.int64))
+    check_grad(lambda xv, wv: F.hsigmoid_loss(xv, lab, 6, wv), [x, w])
+
+
+def test_deform_conv_grad():
+    from paddle_tpu.vision.ops import deform_conv2d
+
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((1, 2, 5, 5)).astype(np.float32)
+    off = (rng.standard_normal((1, 8, 4, 4)) * 0.3).astype(np.float32)
+    w = rng.standard_normal((3, 2, 2, 2)).astype(np.float32)
+    check_grad(lambda xv, ov, wv: deform_conv2d(xv, ov, wv).sum(), [x, off, w],
+               atol=2e-2, rtol=2e-2, delta=1e-3)
+
+
+def test_grid_sample_grad():
+    rng = np.random.default_rng(4)
+    x = rng.standard_normal((1, 2, 4, 4)).astype(np.float32)
+    grid = (rng.uniform(-0.8, 0.8, (1, 3, 3, 2))).astype(np.float32)
+    check_grad(lambda xv, gv: F.grid_sample(xv, gv).sum(), [x, grid],
+               atol=2e-2, rtol=2e-2)
+
+
+def test_renorm_grad():
+    rng = np.random.default_rng(5)
+    x = rng.standard_normal((3, 4)).astype(np.float32) * 2
+    check_grad(lambda v: paddle.renorm(v, 2.0, 0, 1.0).sum(), [x], atol=1e-2, rtol=1e-2)
+
+
+def test_sequence_pool_softmax_grads():
+    rng = np.random.default_rng(6)
+    x = rng.standard_normal((2, 4, 3)).astype(np.float32)
+    lens = paddle.to_tensor(np.array([2, 4]))
+    for mode in ("average", "sqrt", "max"):
+        check_grad(lambda v, m=mode: F.sequence_pool(v, lens, m).sum(), [x])
+    check_grad(lambda v: F.sequence_softmax(v, lens).sum(), [x])
+
+
+def test_fold_grad():
+    rng = np.random.default_rng(7)
+    cols = rng.standard_normal((1, 8, 4)).astype(np.float32)
+    check_grad(lambda v: F.fold(v, (4, 4), 2, strides=2).sum(), [cols])
+
+
+def test_pixel_shuffle_grad():
+    rng = np.random.default_rng(8)
+    x = rng.standard_normal((1, 4, 2, 2)).astype(np.float32)
+    check_grad(lambda v: F.pixel_shuffle(v, 2).sum(), [x])
