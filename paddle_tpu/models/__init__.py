@@ -5,6 +5,12 @@ from .bert import (  # noqa: F401
     BertForPretraining,
     BertPretrainingCriterion,
 )
+from .ernie import (  # noqa: F401
+    ErnieConfig,
+    ErnieForPretraining,
+    ErnieModel,
+    ErniePretrainingCriterion,
+)
 from .gpt import (  # noqa: F401
     GPTConfig,
     GPTModel,

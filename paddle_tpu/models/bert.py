@@ -42,21 +42,22 @@ class BertConfig:
         self.dropout = dropout
         self.initializer_range = initializer_range
 
-    @staticmethod
-    def base(**kw):
-        return BertConfig(**kw)
+    # classmethods so subclasses (ErnieConfig) inherit the family shapes
+    @classmethod
+    def base(cls, **kw):
+        return cls(**kw)
 
-    @staticmethod
-    def large(**kw):
+    @classmethod
+    def large(cls, **kw):
         cfg = dict(hidden_size=1024, num_layers=24, num_heads=16)
         cfg.update(kw)
-        return BertConfig(**cfg)
+        return cls(**cfg)
 
-    @staticmethod
-    def tiny(**kw):
+    @classmethod
+    def tiny(cls, **kw):
         cfg = dict(vocab_size=512, hidden_size=64, num_layers=2, num_heads=4, max_seq_len=128)
         cfg.update(kw)
-        return BertConfig(**cfg)
+        return cls(**cfg)
 
 
 class BertSelfAttention(nn.Layer):

@@ -1,0 +1,107 @@
+"""ERNIE model family — the hybrid-parallel workhorse of BASELINE config #5.
+
+Parity anchors: the ERNIE encoder exercised throughout the reference's
+distributed tests (e.g. python/paddle/fluid/tests/unittests/
+static_model_parallel_fused_attention.py and the fleet hybrid suites train
+ERNIE-shaped transformers): a BERT-style bidirectional encoder with an
+extra TASK-TYPE embedding table, pretrained with masked-LM plus
+sentence-order prediction. Architecture reuses the mp-annotated BERT
+blocks (models/bert.py) — same TPU-first sharding story: vocab-parallel
+embeddings, column/row-parallel attention/FFN, fused flash path.
+"""
+from __future__ import annotations
+
+from .. import nn
+from ..nn import functional as F
+from ..nn import initializer as I
+from .bert import BertConfig, BertEmbeddings, BertLayer, BertPretrainingCriterion
+
+
+class ErnieConfig(BertConfig):
+    def __init__(self, task_type_vocab_size=3, use_task_id=True, **kw):
+        kw.setdefault("vocab_size", 18000)  # ERNIE 1.0 zh vocab
+        super().__init__(**kw)
+        self.task_type_vocab_size = task_type_vocab_size
+        self.use_task_id = use_task_id
+
+    # base/large/tiny inherit from BertConfig's classmethod factories
+
+    @classmethod
+    def ernie3_xbase(cls, **kw):
+        """ERNIE 3.0 hybrid-benchmark shape (BASELINE config #5 dense
+        trunk: h=3072, L=12)."""
+        cfg = dict(hidden_size=3072, num_layers=12, num_heads=24, max_seq_len=512)
+        cfg.update(kw)
+        return cls(**cfg)
+
+
+class ErnieEmbeddings(BertEmbeddings):
+    """BERT embeddings + the ERNIE task-type table."""
+
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__(cfg)
+        self.task_type_embeddings = None
+        if cfg.use_task_id:
+            self.task_type_embeddings = nn.Embedding(
+                cfg.task_type_vocab_size, cfg.hidden_size,
+                weight_attr=I.Normal(0.0, cfg.initializer_range))
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None, task_type_ids=None):
+        from ..tensor.creation import arange, zeros_like
+
+        if position_ids is None:
+            position_ids = arange(0, input_ids.shape[1], dtype="int32")
+        if token_type_ids is None:
+            token_type_ids = zeros_like(input_ids)
+        h = (self.word_embeddings(input_ids) + self.position_embeddings(position_ids)
+             + self.token_type_embeddings(token_type_ids))
+        if self.task_type_embeddings is not None:
+            if task_type_ids is None:
+                task_type_ids = zeros_like(input_ids)
+            h = h + self.task_type_embeddings(task_type_ids)
+        return self.dropout(self.norm(h))
+
+
+class ErnieModel(nn.Layer):
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.embeddings = ErnieEmbeddings(cfg)
+        self.layers = nn.LayerList([BertLayer(cfg) for _ in range(cfg.num_layers)])
+        self.pooler = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attn_mask=None, task_type_ids=None):
+        from ..tensor.math import tanh
+
+        h = self.embeddings(input_ids, token_type_ids, position_ids, task_type_ids)
+        for layer in self.layers:
+            h = layer(h, attn_mask)
+        pooled = tanh(self.pooler(h[:, 0]))
+        return h, pooled
+
+
+class ErnieForPretraining(nn.Layer):
+    """Masked-LM head (tied decoder) + sentence-order-prediction head."""
+
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__()
+        self.ernie = ErnieModel(cfg)
+        self.transform = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+        self.transform_norm = nn.LayerNorm(cfg.hidden_size)
+        self.sop = nn.Linear(cfg.hidden_size, 2)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attn_mask=None, task_type_ids=None):
+        from ..tensor.linalg import matmul
+
+        h, pooled = self.ernie(input_ids, token_type_ids, position_ids, attn_mask, task_type_ids)
+        h = self.transform_norm(F.gelu(self.transform(h), approximate=True))
+        mlm_logits = matmul(h, self.ernie.embeddings.word_embeddings.weight, transpose_y=True)
+        sop_logits = self.sop(pooled)
+        return mlm_logits, sop_logits
+
+
+class ErniePretrainingCriterion(BertPretrainingCriterion):
+    """MLM CE + SOP CE — same structure as the BERT criterion (the SOP
+    target replaces NSP)."""

@@ -149,7 +149,7 @@ class TestSequenceParallelGPT:
 
     def test_gpt_step_sep2_matches_single(self):
         import paddle_tpu.nn as nn
-        from paddle_tpu.distributed.fleet import Fleet
+        from paddle_tpu.distributed import fleet as fsingleton
         from paddle_tpu.distributed.strategy import DistributedStrategy
         from paddle_tpu.jit import TrainStep
         from paddle_tpu.models.gpt import GPTConfig, GPTForPretraining, GPTPretrainingCriterion
@@ -171,7 +171,7 @@ class TestSequenceParallelGPT:
         strat = DistributedStrategy()
         strat.hybrid_configs = {"dp_degree": 2, "mp_degree": 2, "pp_degree": 1,
                                 "sharding_degree": 1, "sep_degree": 2}
-        f = Fleet()
+        f = fsingleton  # the singleton: mp activation constraints read it
         f.init(is_collective=True, strategy=strat)
         assert dict(f.mesh.shape)["sep"] == 2
         m2 = build()
