@@ -63,6 +63,10 @@ class GPTConfig:
         stacked=True,
         recompute=False,
         recompute_granularity="full",
+        moe_num_experts=0,
+        moe_every=2,
+        moe_top_k=2,
+        moe_capacity_factor=1.25,
     ):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
@@ -80,6 +84,17 @@ class GPTConfig:
         # 'selective' saves matmul outputs and recomputes the rest (parity:
         # paddle recompute_granularity full vs full_attn/core_attn)
         self.recompute_granularity = recompute_granularity
+        # GPT-MoE (GShard / ERNIE-3.0-style sparse FFN): every moe_every-th
+        # block swaps its dense FFN for a MoELayer. Requires stacked=False
+        # (the [L,...]-stacked trunk assumes homogeneous layers).
+        self.moe_num_experts = moe_num_experts
+        self.moe_every = moe_every
+        self.moe_top_k = moe_top_k
+        self.moe_capacity_factor = moe_capacity_factor
+        if moe_num_experts and stacked:
+            raise ValueError("GPT-MoE needs stacked=False (heterogeneous layers)")
+        if moe_num_experts and moe_every < 1:
+            raise ValueError(f"moe_every must be >= 1, got {moe_every}")
 
     @staticmethod
     def gpt3_1p3b(**kw):
@@ -146,14 +161,22 @@ class GPTAttention(nn.Layer):
 class GPTBlock(nn.Layer):
     """Pre-LN decoder block (attn + gelu MLP), mp-sharded."""
 
-    def __init__(self, cfg: GPTConfig):
+    def __init__(self, cfg: GPTConfig, use_moe=False):
         super().__init__()
         init = I.Normal(0.0, cfg.initializer_range)
         self.norm1 = nn.LayerNorm(cfg.hidden_size)
         self.attn = GPTAttention(cfg)
         self.norm2 = nn.LayerNorm(cfg.hidden_size)
-        self.ffn1 = ColumnParallelLinear(cfg.hidden_size, cfg.ffn_hidden_size, weight_attr=init, gather_output=False)
-        self.ffn2 = RowParallelLinear(cfg.ffn_hidden_size, cfg.hidden_size, weight_attr=init, input_is_parallel=True)
+        self.moe = None
+        if use_moe:
+            from ..distributed.moe import MoELayer
+
+            self.moe = MoELayer(cfg.hidden_size, cfg.ffn_hidden_size,
+                                num_experts=cfg.moe_num_experts, top_k=cfg.moe_top_k,
+                                capacity_factor=cfg.moe_capacity_factor)
+        else:
+            self.ffn1 = ColumnParallelLinear(cfg.hidden_size, cfg.ffn_hidden_size, weight_attr=init, gather_output=False)
+            self.ffn2 = RowParallelLinear(cfg.ffn_hidden_size, cfg.hidden_size, weight_attr=init, input_is_parallel=True)
         self.dropout = nn.Dropout(cfg.dropout)
 
     def gen_cache(self, x):
@@ -165,7 +188,10 @@ class GPTBlock(nn.Layer):
             x = x + self.dropout(att)
         else:
             x = x + self.dropout(self.attn(self.norm1(x)))
-        x = x + self.dropout(self.ffn2(F.gelu(self.ffn1(self.norm2(x)), approximate=True)))
+        if self.moe is not None:
+            x = x + self.dropout(self.moe(self.norm2(x)))
+        else:
+            x = x + self.dropout(self.ffn2(F.gelu(self.ffn1(self.norm2(x)), approximate=True)))
         if cache is not None:
             return x, cache
         return x
@@ -542,7 +568,10 @@ class GPTModel(nn.Layer):
         if cfg.stacked:
             self.layers = GPTBlockStack(cfg)
         else:
-            self.layers = nn.LayerList([GPTBlock(cfg) for _ in range(cfg.num_layers)])
+            self.layers = nn.LayerList([
+                GPTBlock(cfg, use_moe=bool(cfg.moe_num_experts)
+                         and (i + 1) % cfg.moe_every == 0)
+                for i in range(cfg.num_layers)])
         self.final_norm = nn.LayerNorm(cfg.hidden_size)
 
     def forward(self, input_ids, position_ids=None):
@@ -553,6 +582,18 @@ class GPTModel(nn.Layer):
             for blk in self.layers:
                 h = blk(h)
         return self.final_norm(h)
+
+    @property
+    def moe_aux_loss(self):
+        """Sum of the MoE gates' load-balancing losses from the last
+        forward (GPT-MoE blocks only); add `model.moe_aux_loss * coef` to
+        the training loss (GShard aux objective)."""
+        total = None
+        if not isinstance(self.layers, GPTBlockStack):
+            for blk in self.layers:
+                if getattr(blk, "moe", None) is not None:
+                    total = blk.moe.aux_loss if total is None else total + blk.moe.aux_loss
+        return total
 
     # per-layer GPTBlock param path <-> stacked GPTBlockStack param name
     _PER_LAYER_TO_STACKED = {
@@ -630,7 +671,12 @@ class GPTForPretraining(nn.Layer):
 
         # tied head: h @ wte^T; vocab axis stays mp-sharded for the
         # vocab-parallel loss (c_softmax_with_cross_entropy parity)
-        return matmul(h, self.gpt.embeddings.word_embeddings.weight, transpose_y=True)
+        logits = matmul(h, self.gpt.embeddings.word_embeddings.weight, transpose_y=True)
+        if self.gpt.cfg.moe_num_experts:
+            # GPT-MoE: the GShard balancing loss rides the outputs so the
+            # criterion (and any compiled step) sees it — no side channel
+            return logits, self.gpt.moe_aux_loss
+        return logits
 
     def generate(self, input_ids, max_new_tokens=32, do_sample=False, temperature=1.0, top_k=0, top_p=1.0, seed=0, eos_token_id=None):
         """Autoregressive decoding over a fixed-size KV cache, compiled as
@@ -758,17 +804,28 @@ class GPTForPretraining(nn.Layer):
 
 
 class GPTPretrainingCriterion(nn.Layer):
-    """Next-token cross entropy with optional loss mask, mean over tokens."""
+    """Next-token cross entropy with optional loss mask, mean over tokens.
+    For GPT-MoE outputs ``(logits, aux)`` the GShard balancing loss is added
+    with ``moe_aux_coef`` (reference MoE training objective)."""
 
-    def __init__(self):
+    def __init__(self, moe_aux_coef=0.01):
         super().__init__()
         self.parallel_ce = ParallelCrossEntropy()
+        self.moe_aux_coef = moe_aux_coef
 
     def forward(self, logits, labels, loss_mask=None):
         from ..tensor.math import mean, multiply, sum as t_sum
         from ..tensor.manipulation import reshape
 
+        aux = None
+        if isinstance(logits, (tuple, list)):
+            logits, aux = logits
         per_tok = self.parallel_ce(logits, labels)
+        if aux is not None:
+            if loss_mask is not None:
+                m = reshape(loss_mask, per_tok.shape)
+                return t_sum(multiply(per_tok, m)) / t_sum(m) + aux * self.moe_aux_coef
+            return mean(per_tok) + aux * self.moe_aux_coef
         if loss_mask is not None:
             m = reshape(loss_mask, per_tok.shape)
             return t_sum(multiply(per_tok, m)) / t_sum(m)

@@ -163,3 +163,34 @@ def test_export_decoder_predictor_round_trip():
         ids3 = np.random.default_rng(3).integers(0, cfg.vocab_size, (3, 8)).astype("int32")
         (t3,) = pred.run([ids3, np.int32(0)])
         assert np.asarray(t3).shape == (3, 13)
+
+
+def test_gpt_moe_variant_trains():
+    """GPT-MoE: every 2nd block's FFN is a GShard MoE (stacked=False trunk);
+    forward/backward flow, aux loss is exposed, and a compiled TrainStep
+    descends with the aux objective added."""
+    from paddle_tpu.jit import TrainStep
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=4, num_heads=4,
+                    max_seq_len=32, stacked=False, moe_num_experts=4, moe_every=2)
+    m = GPTForPretraining(cfg)
+    trunk = m.gpt.layers
+    assert [blk.moe is not None for blk in trunk] == [False, True, False, True]
+
+    ids = paddle.to_tensor(np.random.default_rng(0).integers(0, 128, (2, 16)).astype("int32"))
+    logits, aux = m(ids)  # MoE models return (logits, aux): no side channel
+    assert np.isfinite(float(aux.numpy()))
+
+    # the standard criterion consumes the aux term directly
+    step = TrainStep(m, paddle.optimizer.AdamW(learning_rate=1e-3),
+                     GPTPretrainingCriterion(moe_aux_coef=0.01))
+    losses = [float(step(ids, ids)["loss"]) for _ in range(10)]
+    assert losses[-1] < losses[0], losses
+
+    import pytest
+
+    with pytest.raises(ValueError):
+        GPTConfig(moe_num_experts=4)  # stacked trunk must refuse
+    with pytest.raises(ValueError):
+        GPTConfig(stacked=False, moe_num_experts=4, moe_every=0)
