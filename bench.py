@@ -31,6 +31,13 @@ def _measure(flash_flat: bool):
     from paddle_tpu.models.gpt import GPTConfig, GPTForPretraining, GPTPretrainingCriterion
 
     _REGISTRY["FLAGS_flash_flat"] = flash_flat
+    if flash_flat:
+        # apply block sizes tuned by `tpu_runbook.py sweep` (no-op if absent)
+        from paddle_tpu.incubate import autotune
+
+        autotune.load_tuned(shape=(8, 1024, 16, 64),
+                            cache_path=os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                                    ".autotune_cache.json"))
     d0 = jax.devices()[0]
     # the axon tunnel reports platform 'axon' with device_kind 'TPU v5 lite'
     on_tpu = d0.platform in ("tpu", "axon") or "TPU" in getattr(d0, "device_kind", "")
