@@ -1,0 +1,34 @@
+"""The examples/ scripts stay runnable (subprocess smoke, CPU mesh)."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(script):
+    env = dict(os.environ, PYTHONPATH="", PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu")
+    r = subprocess.run([sys.executable, os.path.join(ROOT, "examples", script)],
+                       capture_output=True, text=True, timeout=900, env=env, cwd=ROOT)
+    assert r.returncode == 0, f"{script} failed:\n{r.stdout[-2000:]}\n{r.stderr[-2000:]}"
+    return r.stdout
+
+
+@pytest.mark.slow
+def test_train_gpt_example():
+    out = _run("train_gpt.py")
+    assert "checkpoint saved" in out
+
+
+@pytest.mark.slow
+def test_finetune_classifier_example():
+    out = _run("finetune_classifier.py")
+    assert "served int8 logits" in out
+
+
+@pytest.mark.slow
+def test_serve_text_example():
+    out = _run("serve_text.py")
+    assert "->" in out
