@@ -1,0 +1,21 @@
+#!/bin/bash
+# Fire the full on-chip verification + measurement sequence the moment the
+# tunnel answers. Serial (one TPU process at a time); everything logs to
+# tpu_results.log for BASELINE.md transcription.
+set -u
+cd /root/repo
+LOG=tpu_results.log
+run() {
+  echo "=== $* === $(date -u +%H:%M:%S)" | tee -a $LOG
+  timeout "${T:-900}" "$@" 2>&1 | grep -v xla_bridge | tee -a $LOG
+}
+echo "==== session $(date -u) ====" | tee -a $LOG
+T=600  run python tpu_runbook.py flat      # kernel parity (incl. new hg shapes)
+T=700  run python tpu_runbook.py step      # flagship A/B: flag off vs on
+T=1500 run python tpu_runbook.py sweep     # block-size tune (persists cache)
+T=700  run python tpu_runbook.py step      # re-A/B with tuned blocks
+T=700  run python tpu_runbook.py decode    # decode throughput row
+T=2400 run python bench_1p3b.py tpu        # BASELINE row 4
+T=1200 run python bench_1p3b.py tpu-ernie  # BASELINE row 5
+T=1500 run python bench.py                 # headline (self-selecting)
+echo "==== done $(date -u) ====" | tee -a $LOG
