@@ -580,8 +580,17 @@ class GPTModel(nn.Layer):
             h = self.layers(h)
         else:
             for blk in self.layers:
-                h = blk(h)
+                h = self._block_maybe_remat(blk, h)
         return self.final_norm(h)
+
+    def _block_maybe_remat(self, blk, h):
+        # honor cfg.recompute on the per-layer trunk too (the stacked path
+        # remats inside GPTBlockStack)
+        if not self.cfg.recompute:
+            return blk(h)
+        from ..distributed.recompute import recompute as _rc
+
+        return _rc(blk, h)
 
     @property
     def moe_aux_loss(self):

@@ -194,3 +194,21 @@ def test_gpt_moe_variant_trains():
         GPTConfig(moe_num_experts=4)  # stacked trunk must refuse
     with pytest.raises(ValueError):
         GPTConfig(stacked=False, moe_num_experts=4, moe_every=0)
+
+
+def test_per_layer_trunk_honors_recompute():
+    from paddle_tpu.jit import TrainStep
+
+    paddle.seed(0)
+    base = dict(vocab_size=64, hidden_size=16, num_layers=2, num_heads=2,
+                max_seq_len=16, stacked=False)
+    ids = paddle.to_tensor(np.random.default_rng(0).integers(0, 64, (2, 8)).astype("int32"))
+
+    def losses(recompute):
+        paddle.seed(0)
+        m = GPTForPretraining(GPTConfig(**base, recompute=recompute))
+        step = TrainStep(m, paddle.optimizer.SGD(learning_rate=0.1), GPTPretrainingCriterion())
+        return [float(step(ids, ids)["loss"]) for _ in range(3)]
+
+    # remat changes memory, not math: losses identical
+    np.testing.assert_allclose(losses(False), losses(True), rtol=1e-5)
