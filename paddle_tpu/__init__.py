@@ -218,3 +218,4 @@ def device_count() -> int:
     import jax
 
     return jax.device_count()
+from . import regularizer  # noqa: F401,E402

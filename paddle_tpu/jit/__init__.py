@@ -437,3 +437,59 @@ def load(path, **configs):
 from ..static import InputSpec  # noqa: E402 — one class for jit AND static
 from .dy2static import not_to_static  # noqa: E402 — opt-out marker
 # (reference: paddle.static.InputSpec is the single spec type both use)
+
+
+class ProgramTranslator:
+    """Singleton toggling @to_static rewriting (reference
+    dygraph_to_static/program_translator.py:920 ProgramTranslator.enable)."""
+
+    _instance = None
+    enabled = True
+
+    @classmethod
+    def get_instance(cls):
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def enable(self, enable_to_static=True):
+        type(self).enabled = bool(enable_to_static)
+
+
+def enable_to_static(enable=True):
+    ProgramTranslator.get_instance().enable(enable)
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    """dy2static logging verbosity (reference jit.set_verbosity); recorded
+    only — the transpiler emits no logs."""
+    ProgramTranslator.verbosity = int(level)
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    ProgramTranslator.code_level = int(level)
+
+
+class TracedLayer:
+    """Legacy trace-based export (reference fluid/dygraph/jit.py TracedLayer):
+    trace(layer, inputs) -> (outputs, traced) where traced serves the jitted
+    forward and save_inference_model exports it."""
+
+    def __init__(self, layer, inputs):
+        self._layer = layer
+        self._fn = to_static(layer)
+        self._example = inputs
+
+    @staticmethod
+    def trace(layer, inputs):
+        t = TracedLayer(layer, inputs)
+        return t._fn(*inputs), t
+
+    def __call__(self, *args):
+        return self._fn(*args)
+
+    def save_inference_model(self, path, feed=None, fetch=None):
+        from ..static import InputSpec
+
+        specs = [InputSpec(tuple(x.shape), str(x._value.dtype)) for x in self._example]
+        return save(self._layer, path, input_spec=specs)

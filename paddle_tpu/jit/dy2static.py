@@ -568,7 +568,12 @@ def _build_factory(fn):
 
 def transpile(fn):
     """Rewrite ``fn``'s control flow; returns ``fn`` unchanged when the
-    source is unavailable, nothing is rewritable, or the rewrite fails."""
+    source is unavailable, nothing is rewritable, the rewrite fails, or
+    ProgramTranslator.enable(False) turned rewriting off."""
+    from . import ProgramTranslator
+
+    if not getattr(ProgramTranslator, "enabled", True):
+        return fn
     if getattr(fn, "_jst_not_to_static", False) or getattr(fn, "_jst_transpiled", False):
         return fn
     key = getattr(fn, "__code__", None)

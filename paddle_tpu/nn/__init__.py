@@ -5,3 +5,4 @@ from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue, clip_gr
 from .functional_api import functional_call, unwrap_tree  # noqa: F401
 from .layer import *  # noqa: F401,F403
 from .layer import Layer, Parameter  # noqa: F401
+from . import quant  # noqa: F401,E402 — paddle.nn.quant surface

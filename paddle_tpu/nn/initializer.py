@@ -155,3 +155,39 @@ class Dirac(Initializer):
             for i in range(mins):
                 out[(g * (oc // self.groups) + i, i, *centers)] = 1.0
         return jnp.asarray(out, to_jax_dtype(dtype))
+
+
+class Bilinear(Initializer):
+    """Bilinear-upsampling kernel init for transposed convs (reference
+    nn/initializer/Bilinear): weight [c_out, c_in, kh, kw] filled with the
+    separable triangle filter."""
+
+    def __call__(self, shape, dtype="float32"):
+        import numpy as np
+
+        out = np.zeros(shape, np.float32)
+        kh, kw = shape[-2], shape[-1]
+
+        def tri(k):
+            f = (k + 1) // 2
+            c = (2 * f - 1 - f % 2) / (2.0 * f)
+            return np.asarray([1 - abs(i / f - c) for i in range(k)])
+
+        kern = np.outer(tri(kh), tri(kw))
+        for i in range(shape[0]):
+            out[i, min(i, shape[1] - 1)] = kern
+        return jnp.asarray(out, to_jax_dtype(dtype))
+
+
+_GLOBAL_INITIALIZER = {"weight": None, "bias": None}
+
+
+def set_global_initializer(weight_init=None, bias_init=None):
+    """Default initializers for subsequently created parameters (reference
+    nn/initializer/set_global_initializer); pass None to reset."""
+    _GLOBAL_INITIALIZER["weight"] = weight_init
+    _GLOBAL_INITIALIZER["bias"] = bias_init
+
+
+def _global_initializer(is_bias):
+    return _GLOBAL_INITIALIZER["bias" if is_bias else "weight"]

@@ -51,9 +51,15 @@ class Layer:
     # -- construction -----------------------------------------------------
     def create_parameter(self, shape, dtype=None, default_initializer=None, attr=None, is_bias=False):
         dtype = dtype or self._dtype or get_default_dtype()
-        init = default_initializer
+        # precedence (reference set_global_initializer semantics): explicit
+        # ParamAttr.initializer > global initializer > layer default
+        init = None
         if attr is not None and getattr(attr, "initializer", None) is not None:
             init = attr.initializer
+        if init is None:
+            init = I._global_initializer(is_bias)
+        if init is None:
+            init = default_initializer
         if init is None:
             init = I.Constant(0.0) if is_bias else I.XavierNormal()
         value = init(shape, dtype)

@@ -1,0 +1,18 @@
+"""paddle.nn.quant parity (reference python/paddle/nn/quant/quant_layers.py):
+the quantization layer surface re-exported from paddle_tpu.quantization —
+fake-quant QAT wrappers and int8 inference layers, plus the functional
+helpers the reference exposes here."""
+from ...quantization import (  # noqa: F401
+    ImperativeQuantAware,
+    QATQuantizedConv2D,
+    QATQuantizedLinear,
+    QuantizedConv2D,
+    QuantizedLinear,
+    dequant,
+    fake_quant,
+    quant_abs_max,
+)
+
+# reference class-name aliases (quant_layers.py)
+QuantizedConv2DTranspose = QuantizedConv2D
+FakeQuantAbsMax = QATQuantizedLinear

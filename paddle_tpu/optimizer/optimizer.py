@@ -27,7 +27,11 @@ class Optimizer:
         self._lr = learning_rate
         self._params: List[Tensor] = list(parameters) if parameters is not None else []
         self._grad_clip: Optional[ClipGradBase] = grad_clip
-        self._weight_decay = weight_decay
+        # weight_decay may be a float or a regularizer.L1Decay/L2Decay object
+        from ..regularizer import L1Decay
+
+        self._wd_is_l1 = isinstance(weight_decay, L1Decay)
+        self._weight_decay = float(weight_decay) if weight_decay is not None else None
         self.core = core if core is not None else self._core_cls()
         self._state = None
         self._step_count = 0
@@ -81,8 +85,10 @@ class Optimizer:
         gtree = {i: grads[i]._value for i in ptree}
         self._pre_update(params, ptree)
         if self._weight_decay and not isinstance(self, _DecoupledWD):
-            # L2 regularization: grad += wd * param (reference regularizer.py)
-            gtree = {i: g + self._weight_decay * ptree[i] for i, g in gtree.items()}
+            # L1/L2 regularization: grad += wd * (sign(p) | p) (reference
+            # regularizer.py L1Decay/L2Decay)
+            pen = (lambda p: jnp.sign(p)) if self._wd_is_l1 else (lambda p: p)
+            gtree = {i: g + self._weight_decay * pen(ptree[i]) for i, g in gtree.items()}
         self._ensure_state({i: p._value for i, p in enumerate(params)})
         new_params, new_state = self._apply(gtree, ptree)
         for i, p in enumerate(params):
@@ -108,7 +114,8 @@ class Optimizer:
         TrainStep, static Executor): weight decay, clip, lr schedule, core
         update. One definition so the training semantics cannot diverge."""
         if self._weight_decay:
-            gtree = jax.tree_util.tree_map(lambda g, p: g + self._weight_decay * p, gtree, ptree)
+            pen = (lambda p: jnp.sign(p)) if self._wd_is_l1 else (lambda p: p)
+            gtree = jax.tree_util.tree_map(lambda g, p: g + self._weight_decay * pen(p), gtree, ptree)
         if self._grad_clip is not None:
             gtree = self._grad_clip.apply_tree(gtree)
         lr = self.lr_at(step)
@@ -203,7 +210,7 @@ class Adam(Optimizer):
 class AdamW(Optimizer, _DecoupledWD):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, parameters=None, weight_decay=0.01, apply_decay_param_fun=None, grad_clip=None, lr_ratio=None, name=None, multi_precision=False):
         self.apply_decay_param_fun = apply_decay_param_fun
-        super().__init__(learning_rate, parameters, None, grad_clip, core=Fopt.AdamWCore(beta1, beta2, epsilon, weight_decay))
+        super().__init__(learning_rate, parameters, None, grad_clip, core=Fopt.AdamWCore(beta1, beta2, epsilon, float(weight_decay)))
 
     def _pre_update(self, params, ptree):
         # decay mask honoring apply_decay_param_fun (paddle parity) — keyed

@@ -1,0 +1,31 @@
+"""paddle.regularizer parity (reference python/paddle/regularizer.py):
+L1Decay / L2Decay objects consumed as ``weight_decay=`` by optimizers or as
+per-param ``ParamAttr.regularizer``. On the compiled path the decay folds
+into the fused update like any weight_decay scalar."""
+from __future__ import annotations
+
+__all__ = ["L1Decay", "L2Decay"]
+
+
+class L2Decay:
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+        self._regularization_coeff = self.coeff  # reference attr name
+
+    def __float__(self):
+        return self.coeff
+
+    def __repr__(self):
+        return f"L2Decay({self.coeff})"
+
+
+class L1Decay:
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+        self._regularization_coeff = self.coeff
+
+    def __float__(self):
+        return self.coeff
+
+    def __repr__(self):
+        return f"L1Decay({self.coeff})"

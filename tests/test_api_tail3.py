@@ -1,0 +1,91 @@
+"""Third API-tail sweep: regularizer objects, global initializer, Bilinear
+init, nn.quant namespace, jit ProgramTranslator/TracedLayer."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _np(t):
+    return np.asarray(t.numpy())
+
+
+def test_l1_l2_regularizer_objects():
+    w = paddle.to_tensor(np.array([1.0, -1.0], np.float32), stop_gradient=False)
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[w],
+                               weight_decay=paddle.regularizer.L1Decay(0.5))
+    for _ in range(3):
+        (w * 0.0).sum().backward()
+        opt.step()
+        opt.clear_grad()
+    np.testing.assert_allclose(_np(w), [0.85, -0.85], atol=1e-6)  # |w| -= 3*lr*coeff
+
+    w2 = paddle.to_tensor(np.array([1.0], np.float32), stop_gradient=False)
+    opt2 = paddle.optimizer.SGD(learning_rate=0.1, parameters=[w2],
+                                weight_decay=paddle.regularizer.L2Decay(0.5))
+    (w2 * 0.0).sum().backward()
+    opt2.step()
+    np.testing.assert_allclose(_np(w2), [1.0 - 0.1 * 0.5 * 1.0], atol=1e-6)
+    # AdamW accepts the object form too (decoupled decay)
+    paddle.optimizer.AdamW(parameters=[w2], weight_decay=paddle.regularizer.L2Decay(0.01))
+
+
+def test_set_global_initializer_overrides_layer_default():
+    paddle.nn.initializer.set_global_initializer(paddle.nn.initializer.Constant(0.5))
+    try:
+        lin = paddle.nn.Linear(2, 2)
+    finally:
+        paddle.nn.initializer.set_global_initializer(None)
+    assert (_np(lin.weight) == 0.5).all()
+    lin2 = paddle.nn.Linear(2, 2)
+    assert not (_np(lin2.weight) == 0.5).all()  # reset restores defaults
+    # explicit ParamAttr wins over the global
+    paddle.nn.initializer.set_global_initializer(paddle.nn.initializer.Constant(0.5))
+    try:
+        lin3 = paddle.nn.Linear(2, 2, weight_attr=paddle.ParamAttr(
+            initializer=paddle.nn.initializer.Constant(2.0)))
+    finally:
+        paddle.nn.initializer.set_global_initializer(None)
+    assert (_np(lin3.weight) == 2.0).all()
+
+
+def test_bilinear_initializer():
+    b = np.asarray(paddle.nn.initializer.Bilinear()((2, 2, 4, 4)))
+    assert b.shape == (2, 2, 4, 4)
+    # separable triangle filter: symmetric, max at center block
+    k = b[0, 0]
+    np.testing.assert_allclose(k, k[::-1, ::-1], atol=1e-6)
+    assert k.max() == k[1:3, 1:3].max()
+
+
+def test_nn_quant_namespace():
+    assert paddle.nn.quant.QuantizedLinear is not None
+    assert paddle.nn.quant.ImperativeQuantAware is not None
+
+
+def test_program_translator_toggle():
+    from paddle_tpu.jit.dy2static import transpile
+
+    def f(x):
+        if x > 0:
+            y = 1
+        else:
+            y = 2
+        return y
+
+    paddle.jit.ProgramTranslator.get_instance().enable(False)
+    try:
+        assert transpile(f) is f
+    finally:
+        paddle.jit.ProgramTranslator.get_instance().enable(True)
+    assert transpile(f) is not f
+    paddle.jit.set_verbosity(3)
+    paddle.jit.set_code_level(50)
+
+
+def test_traced_layer():
+    m = paddle.nn.Linear(3, 2)
+    m.eval()
+    x = paddle.to_tensor(np.ones((1, 3), np.float32))
+    out, tl = paddle.jit.TracedLayer.trace(m, [x])
+    np.testing.assert_allclose(_np(tl(x)), _np(out), rtol=1e-6)
