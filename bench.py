@@ -78,12 +78,14 @@ def _measure(flash_flat: bool):
     return tokens_per_sec, config_key, on_tpu
 
 
-def _measure_in_subprocess(which: str):
+def _measure_in_subprocess(which: str, timeout: float):
     """One measurement per process: TPU runtimes hold per-process device
-    locks, so the parent must not initialize a backend before its children."""
+    locks, so the parent must not initialize a backend before its children.
+    Caps (compile dominates; steps take seconds) keep probe + classic +
+    flat well inside the driver's window."""
     env = dict(os.environ, BENCH_ONE=which)
     r = subprocess.run([sys.executable, os.path.abspath(__file__)], env=env,
-                       capture_output=True, text=True, timeout=900)
+                       capture_output=True, text=True, timeout=timeout)
     line = [l for l in r.stdout.splitlines() if l.startswith("{")][-1]
     d = json.loads(line)
     return d["value"], d["config"], d["on_tpu"]
@@ -95,16 +97,42 @@ def main():
         print(json.dumps({"value": tps, "config": config_key, "on_tpu": on_tpu}))
         return
 
+    from __graft_entry__ import _probe_default_backend
+
+    def _fail(reason: str):
+        # fail FAST and parseably — never hang into the driver's timeout
+        print(json.dumps({"metric": "gpt_pretrain_throughput", "value": None,
+                          "unit": "tokens/sec/chip", "vs_baseline": None,
+                          "error": reason}))
+
+    verdict = _probe_default_backend(timeout=75.0)
+    if verdict is False:
+        _fail("tpu_unreachable")
+        return
+
     chosen = "classic"
-    try:
-        tokens_per_sec, config_key, on_tpu = _measure_in_subprocess("classic")
-    except Exception:
-        # subprocess machinery unavailable — single in-process measurement
+    if verdict is None:
+        # could not spawn a probe child — subprocess machinery unavailable,
+        # so measure once in-process (a hang here is unavoidable but this
+        # path only exists where fork/exec fails, e.g. sandboxed CPU runs)
         tokens_per_sec, config_key, on_tpu = _measure(flash_flat=False)
         on_tpu = False  # device now locked by this process: skip the flat run
+    else:
+        try:
+            tokens_per_sec, config_key, on_tpu = _measure_in_subprocess("classic", timeout=520)
+        except subprocess.TimeoutExpired:
+            # the probe only bounds backend init, not model compile; a hung
+            # compile must surface as a sentinel, never as an in-process retry
+            _fail("bench_timeout")
+            return
+        except Exception:
+            # child crashed / emitted no JSON (e.g. tunnel dropped mid-run):
+            # never retry in-process — that reintroduces the unbounded hang
+            _fail("bench_error")
+            return
     if on_tpu:
         try:
-            flat_tps, flat_cfg, _ = _measure_in_subprocess("flat")
+            flat_tps, flat_cfg, _ = _measure_in_subprocess("flat", timeout=240)
             if flat_cfg == config_key and flat_tps > tokens_per_sec:
                 tokens_per_sec, chosen = flat_tps, "flash_flat"
         except Exception:
