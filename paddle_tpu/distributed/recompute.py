@@ -23,11 +23,40 @@ POLICIES = {
 
 def recompute(function, *args, policy="nothing_saveable", **kwargs):
     """Eager-compatible recompute: runs ``function`` (Tensor-level) under a
-    remat boundary when traced; in pure eager it simply calls through (the
-    tape already stores residuals per op, so eager recompute is a no-op —
-    memory thrift comes on the jit path, matching how the reference's
-    recompute only matters under large models)."""
-    return function(*args, **kwargs)
+    ``jax.checkpoint`` boundary when any input is traced (i.e. under jit);
+    in pure eager it simply calls through (the tape already stores residuals
+    per op, so eager recompute is a no-op — memory thrift comes on the jit
+    path, matching how the reference's recompute only matters under large
+    models). Parameters the function closes over stay saveable constants of
+    the remat segment — only activations are recomputed."""
+    vals = unwrap_tree(list(args))
+    kwvals = unwrap_tree(dict(kwargs))
+
+    def _traced(v):
+        return any(isinstance(l, jax.core.Tracer) for l in jax.tree_util.tree_leaves(v))
+
+    # only traced args cross the checkpoint boundary; everything else (bools,
+    # ints, concrete arrays) rides the closure so functions that branch on
+    # flag arguments keep working — mirrors how the reference recompute
+    # accepts mixed tensor/non-tensor args (fleet/utils/recompute.py:346)
+    dyn_i = [i for i, v in enumerate(vals) if _traced(v)]
+    dyn_k = [k for k, v in kwvals.items() if _traced(v)]
+    if not dyn_i and not dyn_k:
+        return function(*args, **kwargs)
+    pol = POLICIES.get(policy, None) if isinstance(policy, str) else policy
+
+    def _arr_fn(dyn_args, dyn_kwargs):
+        full = list(args)
+        for i, v in zip(dyn_i, dyn_args):
+            full[i] = _wrap_tree(v)
+        kw = dict(kwargs)
+        for k in dyn_k:
+            kw[k] = _wrap_tree(dyn_kwargs[k])
+        return unwrap_tree(function(*full, **kw))
+
+    out = jax.checkpoint(_arr_fn, policy=pol)(
+        [vals[i] for i in dyn_i], {k: kwvals[k] for k in dyn_k})
+    return _wrap_tree(out)
 
 
 def remat(fn, policy="nothing_saveable", prevent_cse=True, static_argnums=()):

@@ -190,9 +190,11 @@ def get_cluster(node_ips, node_ip, trainer_endpoints, device_mode_or_gpus, devic
     trainer_endpoints = trainer_endpoints or []
     # flat list: endpoints are split evenly across nodes in order
     per_node = len(trainer_endpoints) // max(len(node_ips), 1) if not nested else 0
-    if not nested and trainer_endpoints and per_node == 0:
-        raise ValueError(f"{len(trainer_endpoints)} endpoints cannot cover "
-                         f"{len(node_ips)} nodes")
+    if not nested and trainer_endpoints and (
+            per_node == 0 or len(trainer_endpoints) % max(len(node_ips), 1) != 0):
+        raise ValueError(f"{len(trainer_endpoints)} endpoints cannot be split "
+                         f"evenly over {len(node_ips)} nodes; pass a nested "
+                         f"per-node endpoint list for uneven layouts")
     for node_rank, ip in enumerate(node_ips):
         pod = Pod()
         pod.rank = node_rank

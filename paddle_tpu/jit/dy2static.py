@@ -21,7 +21,9 @@ Supported rewrites:
   are preserved for concrete values via lambdas).
 
 Anything else (returns inside branches, tuple-target for loops, try/except,
-…) is left untouched: concrete-value code runs exactly as before, and a
+in-place mutation in a branch — subscript/attribute stores and mutating
+method calls like ``lst.append``/``d.update``/``t.add_``, …) is left
+untouched: concrete-value code runs exactly as before, and a
 tensor-dependent condition in unsupported shapes raises JAX's
 TracerBoolConversionError pointing at the static.nn bridges.
 
@@ -298,6 +300,44 @@ class _EscapeScan(ast.NodeVisitor):
             self.found = True
         self.generic_visit(node)
 
+    # known-mutating method calls are in-place side effects like subscript
+    # stores: under a traced predicate BOTH rewritten branch bodies run at
+    # trace time, so the mutation would apply for the untaken branch too —
+    # refuse the rewrite (native execution keeps Python semantics; a traced
+    # predicate then raises TracerBoolConversionError instead of going
+    # silently wrong). Matched conservatively to avoid refusing pure calls
+    # that share a name (x.add(y), paddle.update_hub): plain names like
+    # append/update only count as bare expression statements (result
+    # discarded — pure calls there would be dead code), while paddle-style
+    # trailing-underscore inplace methods (t.add_) count anywhere. A
+    # value-used mutator (y = lst.pop()) still slips through — Python can't
+    # distinguish that statically.
+    _MUTATING = frozenset({
+        "append", "extend", "insert", "remove", "pop", "clear", "sort",
+        "reverse", "update", "setdefault", "popitem", "add", "discard"})
+
+    @classmethod
+    def _is_inplace_call(cls, node):
+        f = node.func
+        return (isinstance(f, ast.Attribute) and f.attr.endswith("_")
+                and not f.attr.startswith("_"))
+
+    @classmethod
+    def _is_mutating_stmt(cls, node):
+        return (isinstance(node, ast.Expr) and isinstance(node.value, ast.Call)
+                and isinstance(node.value.func, ast.Attribute)
+                and node.value.func.attr in cls._MUTATING)
+
+    def visit_Call(self, node):
+        if self._is_inplace_call(node):
+            self.found = True
+        self.generic_visit(node)
+
+    def visit_Expr(self, node):
+        if self._is_mutating_stmt(node):
+            self.found = True
+        self.generic_visit(node)
+
     # break/continue inside a nested loop belong to that loop; returns/yields
     # still escape, so keep walking loop bodies but clear break/continue
     # significance by handling loops with a child scanner.
@@ -316,6 +356,12 @@ class _EscapeScan(ast.NodeVisitor):
                 return
             if (isinstance(sub, (ast.Subscript, ast.Attribute))
                     and isinstance(sub.ctx, ast.Store)):
+                self.found = True
+                return
+            if isinstance(sub, ast.Call) and self._is_inplace_call(sub):
+                self.found = True
+                return
+            if self._is_mutating_stmt(sub):
                 self.found = True
                 return
 

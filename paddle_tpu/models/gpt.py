@@ -585,12 +585,14 @@ class GPTModel(nn.Layer):
 
     def _block_maybe_remat(self, blk, h):
         # honor cfg.recompute on the per-layer trunk too (the stacked path
-        # remats inside GPTBlockStack)
+        # remats inside GPTBlockStack); granularity maps as in _stack_forward
         if not self.cfg.recompute:
             return blk(h)
         from ..distributed.recompute import recompute as _rc
 
-        return _rc(blk, h)
+        policy = ("dots_saveable" if self.cfg.recompute_granularity == "selective"
+                  else "nothing_saveable")
+        return _rc(blk, h, policy=policy)
 
     @property
     def moe_aux_loss(self):

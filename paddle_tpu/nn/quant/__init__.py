@@ -2,17 +2,60 @@
 the quantization layer surface re-exported from paddle_tpu.quantization —
 fake-quant QAT wrappers and int8 inference layers, plus the functional
 helpers the reference exposes here."""
+import jax.numpy as jnp
+
 from ...quantization import (  # noqa: F401
     ImperativeQuantAware,
     QATQuantizedConv2D,
     QATQuantizedLinear,
     QuantizedConv2D,
     QuantizedLinear,
+    _qdq_ste,
     dequant,
     fake_quant,
     quant_abs_max,
 )
+from ...tensor._helpers import ensure_tensor, op
+from ..layer.base import Layer
 
-# reference class-name aliases (quant_layers.py)
-QuantizedConv2DTranspose = QuantizedConv2D
-FakeQuantAbsMax = QATQuantizedLinear
+
+class FakeQuantAbsMax(Layer):
+    """Standalone abs-max fake-quant layer (reference quant_layers.py
+    FakeQuantAbsMax): quantize-dequantize the input by its own abs-max
+    scale, straight-through in backward. Reference-compatible constructor
+    (name/moving_rate/dtype accepted; abs-max needs no moving average)."""
+
+    def __init__(self, name=None, moving_rate=0.9, quant_bits=8,
+                 dtype="float32", quant_on_weight=False, reduce_type=None):
+        super().__init__()
+        if not 2 <= int(quant_bits) <= 8:
+            raise ValueError("FakeQuantAbsMax supports quant_bits in [2, 8] "
+                             f"(int8 QDQ grid), got {quant_bits}")
+        self.quant_bits = int(quant_bits)
+
+    def forward(self, x):
+        bound = float(2 ** (self.quant_bits - 1) - 1)
+
+        def fn(v):
+            # _qdq_ste carries the straight-through vjp; its ±127 clip is a
+            # no-op here because the dynamic abs-max scale already bounds
+            # round(|v|/s) by `bound` <= 127
+            s = jnp.maximum(jnp.abs(v).max(), 1e-8) / bound
+            return _qdq_ste(v, s)
+
+        return op(fn, ensure_tensor(x), _name="fake_quantize_abs_max")
+
+
+class QuantizedConv2DTranspose(Layer):
+    """Reference-compatible placeholder (quant_layers.py
+    QuantizedConv2DTranspose). Int8 transposed conv is not implemented —
+    QuantizedConv2D quantizes on the wrong channel axis for transposed
+    weights, so aliasing it would be silently wrong."""
+
+    def __init__(self, layer, weight_bits=8, activation_bits=8, *args, **kwargs):
+        super().__init__()
+        raise NotImplementedError(
+            "QuantizedConv2DTranspose is not implemented in paddle_tpu: "
+            "Conv2DTranspose weights are [in, out, kh, kw], so per-channel "
+            "int8 scales need axis=1, which QuantizedConv2D does not do. "
+            "Keep the layer in float, or quantize the surrounding layers.")

@@ -413,11 +413,28 @@ def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num, ignore_thres
         per_gt = (lx + ly + lw + lh + lc) * valid * sc
         obj_t = obj_t.at[bidx, best_a, cj, ci].max(valid.astype(jnp.float32))
         lobj = bce(pobj, obj_t)
-        # ignore mask: cells whose prediction IoUs any gt above thresh but
-        # are not assigned keep zero objectness loss — approximated by the
-        # assigned-cell mask (full IoU map costs [N,A,H,W,B]); the assigned
-        # positives dominate the gradient signal.
-        loss = per_gt.sum(1) + lobj.sum((1, 2, 3))
+        # ignore mask (reference yolov3_loss_op.h CalcObjnessLoss): an
+        # unassigned cell whose best decoded-box IoU over any gt exceeds
+        # ignore_thresh contributes no objectness loss. The full IoU map is
+        # [N,A,H,W,B] — small at YOLO head sizes (A=3, 13..52 grids).
+        cellx = (jnp.arange(Wc) + px) / Wc
+        celly = (jnp.arange(Hc)[:, None] + py) / Hc
+        bw = jnp.exp(pw) * an[:, 0][None, :, None, None] / inp
+        bh = jnp.exp(phh) * an[:, 1][None, :, None, None] / inp
+        px1, py1 = cellx - bw / 2, celly - bh / 2
+        px2, py2 = cellx + bw / 2, celly + bh / 2
+        g1 = gb[:, :, :2] - gb[:, :, 2:4] / 2  # [N,B,2] corners
+        g2 = gb[:, :, :2] + gb[:, :, 2:4] / 2
+        gtb = lambda t: t[:, None, None, None, :]  # [N,B] -> broadcastable
+        iw = jnp.maximum(jnp.minimum(px2[..., None], gtb(g2[:, :, 0]))
+                         - jnp.maximum(px1[..., None], gtb(g1[:, :, 0])), 0.0)
+        ih = jnp.maximum(jnp.minimum(py2[..., None], gtb(g2[:, :, 1]))
+                         - jnp.maximum(py1[..., None], gtb(g1[:, :, 1])), 0.0)
+        inter_p = iw * ih
+        union_p = (bw * bh)[..., None] + gtb(gb[:, :, 2] * gb[:, :, 3]) - inter_p
+        best_iou = jnp.max(inter_p / (union_p + 1e-9) * gtb(valid), axis=-1)
+        keep = jnp.maximum(obj_t, (best_iou <= ignore_thresh).astype(lobj.dtype))
+        loss = per_gt.sum(1) + (lobj * keep).sum((1, 2, 3))
         return loss
 
     args = [ensure_tensor(x), ensure_tensor(gt_box), ensure_tensor(gt_label)]

@@ -221,6 +221,10 @@ def test_second_review_fixes():
     assert cluster.trainers_nranks() == 2
     assert cluster.pods[0].trainers[0].endpoint == "10.0.0.1:6170"
     assert cluster.pods[1].trainers[0].endpoint == "10.0.0.2:6170"
+    # uneven flat endpoint lists must raise, not silently drop the remainder
+    with pytest.raises(ValueError):
+        du.get_cluster(["10.0.0.1", "10.0.0.2"], "10.0.0.1",
+                       ["10.0.0.1:6170", "10.0.0.1:6171", "10.0.0.2:6170"], [0])
 
     # rotate expand grows the canvas; bilinear runs
     img = np.random.default_rng(0).integers(0, 255, (6, 10, 1)).astype(np.uint8)

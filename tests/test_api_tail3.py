@@ -63,6 +63,22 @@ def test_nn_quant_namespace():
     assert paddle.nn.quant.ImperativeQuantAware is not None
 
 
+def test_nn_quant_fake_quant_abs_max():
+    # reference-compatible constructor (standalone layer, not a Linear wrapper)
+    fq = paddle.nn.quant.FakeQuantAbsMax(name="fq", moving_rate=0.9, quant_bits=8)
+    x = paddle.to_tensor(np.linspace(-2, 2, 9).astype("float32"))
+    y = np.asarray(fq(x).numpy())
+    # QDQ: max magnitude preserved, values on the int8 grid of scale 2/127
+    assert abs(y).max() == pytest.approx(2.0, abs=1e-6)
+    np.testing.assert_allclose(y, np.round(y / (2 / 127)) * (2 / 127), atol=1e-6)
+
+
+def test_nn_quant_conv2d_transpose_not_aliased():
+    conv = paddle.nn.Conv2DTranspose(3, 4, 3)
+    with pytest.raises(NotImplementedError, match="Conv2DTranspose"):
+        paddle.nn.quant.QuantizedConv2DTranspose(conv)
+
+
 def test_program_translator_toggle():
     from paddle_tpu.jit.dy2static import transpile
 

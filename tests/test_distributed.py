@@ -181,3 +181,28 @@ class TestRecompute:
         g1 = jax.grad(lambda x: f(x).sum())(jnp.ones((4,)))
         g2 = jax.grad(lambda x: remat(f)(x).sum())(jnp.ones((4,)))
         np.testing.assert_allclose(g1, g2, atol=1e-7)
+
+
+def test_recompute_mixed_static_args_under_jit():
+    """Public recompute() must accept non-tensor flag args under jit: only
+    traced leaves cross the checkpoint boundary, flags ride the closure."""
+    import jax
+
+    from paddle_tpu.distributed import recompute
+
+    def seg(x, double):
+        if double:  # a traced bool here would raise TracerBoolConversionError
+            return x * 2
+        return x
+
+    def loss(xv):
+        t = paddle.to_tensor(xv)
+        out = recompute(seg, t, True)
+        return (out._value ** 2).sum()
+
+    g = jax.grad(loss)(jnp.asarray([1.0, 2.0]))
+    np.testing.assert_allclose(np.asarray(g), [8.0, 16.0], rtol=1e-6)
+    # and the remat boundary is really there
+    jaxpr = jax.make_jaxpr(loss)(jnp.asarray([1.0, 2.0]))
+    assert any("remat" in e.primitive.name or "checkpoint" in e.primitive.name
+               for e in jaxpr.jaxpr.eqns)

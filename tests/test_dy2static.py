@@ -316,3 +316,68 @@ def test_walrus_boolop_untouched():
 
     g = transpile(f)
     assert g(2) == (True, 3)
+
+
+def test_mutating_method_call_in_branch_refused():
+    """A branch that mutates through a method call (lst.append, d.update,
+    t.add_) must be left native: under a traced predicate both rewritten
+    branch bodies would run at trace time and the mutation would apply for
+    the untaken branch too. Native = exact Python semantics for concrete
+    predicates; a traced predicate then raises instead of going wrong."""
+    def f(x, flag):
+        lst = [0]
+        if flag > 2:
+            lst.append(x)
+            y = x + 1
+        else:
+            y = x - 1
+        return y, len(lst)
+
+    g = transpile(f)
+    for flag in (1, 5):
+        assert f(7, flag) == g(7, flag)  # concrete: mutation only when taken
+
+    def h(d, flag):
+        if flag > 2:
+            d.update(a=1)
+            y = 1
+        else:
+            y = 2
+        return y
+
+    gh = transpile(h)
+    d1, d2 = {}, {}
+    assert h(d1, 1) == gh(d2, 1)
+    assert d1 == d2 == {}  # untaken branch left no side effect
+
+    def inplace(t, flag):
+        if flag > 2:
+            t.add_(paddle.to_tensor(np.float32(1)))
+            y = 1
+        else:
+            y = 2
+        return y
+
+    gi = transpile(inplace)
+    t = paddle.to_tensor(np.float32(3))
+    assert gi(t, 1) == 2
+    assert float(t.numpy()) == 3.0  # tensor untouched on the untaken branch
+
+
+def test_pure_calls_named_like_mutators_still_rewritten():
+    """x.add(y) / paddle.add(x, y) used for their VALUE are pure — the
+    mutating-call refusal must not catch them (only bare expression
+    statements and trailing-underscore inplace methods count)."""
+    def f(x):
+        if paddle.sum(x) > 0:
+            y = x.add(x)
+        else:
+            y = x - 5
+        return y
+
+    g = transpile(f)
+    x = paddle.to_tensor(np.array([1.5, 2.5], np.float32))
+    np.testing.assert_allclose(_np(g(x)), [3.0, 5.0])
+    # traced predicate: still compiles through lax.cond
+    step = paddle.jit.to_static(f)
+    np.testing.assert_allclose(_np(step(x)), [3.0, 5.0])

@@ -212,3 +212,36 @@ def test_per_layer_trunk_honors_recompute():
 
     # remat changes memory, not math: losses identical
     np.testing.assert_allclose(losses(False), losses(True), rtol=1e-5)
+
+
+def test_per_layer_recompute_inserts_remat_eqn():
+    """cfg.recompute on the per-layer trunk must actually insert
+    jax.checkpoint boundaries (one per block) into the traced computation —
+    a pass-through would still satisfy the loss-equality test above."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.jit import _pure_model_call
+
+    base = dict(vocab_size=64, hidden_size=16, num_layers=2, num_heads=2,
+                max_seq_len=16, stacked=False)
+
+    def count_remat(recompute, granularity="full"):
+        paddle.seed(0)
+        m = GPTForPretraining(GPTConfig(**base, recompute=recompute,
+                                        recompute_granularity=granularity))
+        m.eval()
+        params = {**m.param_arrays(), **m.buffer_arrays()}
+        ids = jnp.zeros((2, 8), jnp.int32)
+
+        def f(params, ids):
+            out, _ = _pure_model_call(m, params, (ids,), {}, False, None)
+            return out
+
+        jaxpr = jax.make_jaxpr(f)(params, ids)
+        return sum(1 for eqn in jaxpr.jaxpr.eqns
+                   if "remat" in eqn.primitive.name or "checkpoint" in eqn.primitive.name)
+
+    assert count_remat(False) == 0
+    assert count_remat(True, "full") == base["num_layers"]
+    assert count_remat(True, "selective") == base["num_layers"]

@@ -143,6 +143,22 @@ def test_yolo_loss_finite_and_assigned():
     assert loss.shape == (N,) and np.isfinite(loss).all() and (loss > 0).all()
 
 
+def test_yolo_loss_ignore_thresh_masks_objectness():
+    """ignore_thresh is live: ignoring all unassigned cells (thresh<0 makes
+    every overlapping prediction 'high IoU') must strictly reduce the loss
+    vs ignoring none (thresh=1 keeps every unassigned cell's penalty)."""
+    rng = np.random.default_rng(1)
+    N, A, C, Hc = 2, 3, 4, 5
+    x = paddle.to_tensor(rng.standard_normal((N, A * (5 + C), Hc, Hc)).astype("float32"))
+    gt_box = paddle.to_tensor(np.array([[[0.5, 0.5, 0.6, 0.7], [0, 0, 0, 0]]] * N, np.float32))
+    gt_label = paddle.to_tensor(np.zeros((N, 2), np.int64))
+    kw = dict(anchors=[10, 13, 16, 30, 33, 23], anchor_mask=[0, 1, 2],
+              class_num=C, downsample_ratio=32)
+    keep_all = _np(V.yolo_loss(x, gt_box, gt_label, ignore_thresh=1.0, **kw))
+    drop_overlapping = _np(V.yolo_loss(x, gt_box, gt_label, ignore_thresh=-1.0, **kw))
+    assert (drop_overlapping < keep_all).all()
+
+
 def test_read_file_decode_jpeg_roundtrip(tmp_path):
     from PIL import Image
 
