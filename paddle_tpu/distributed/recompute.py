@@ -43,7 +43,7 @@ def recompute(function, *args, policy="nothing_saveable", **kwargs):
     dyn_k = [k for k, v in kwvals.items() if _traced(v)]
     if not dyn_i and not dyn_k:
         return function(*args, **kwargs)
-    pol = POLICIES.get(policy, None) if isinstance(policy, str) else policy
+    pol = _policy(policy)
 
     def _arr_fn(dyn_args, dyn_kwargs):
         full = list(args)
@@ -59,7 +59,18 @@ def recompute(function, *args, policy="nothing_saveable", **kwargs):
     return _wrap_tree(out)
 
 
+def _policy(policy):
+    """Resolve a policy name; unknown strings raise instead of silently
+    degrading to full remat (a typo like 'dots_savable' would otherwise
+    change memory/compute behavior with no error)."""
+    if not isinstance(policy, str):
+        return policy
+    if policy not in POLICIES:
+        raise ValueError(f"unknown recompute policy {policy!r}; "
+                         f"expected one of {sorted(POLICIES)}")
+    return POLICIES[policy]
+
+
 def remat(fn, policy="nothing_saveable", prevent_cse=True, static_argnums=()):
     """Array-level remat wrapper for functional/jit code paths."""
-    pol = POLICIES.get(policy, None) if isinstance(policy, str) else policy
-    return jax.checkpoint(fn, policy=pol, prevent_cse=prevent_cse, static_argnums=static_argnums)
+    return jax.checkpoint(fn, policy=_policy(policy), prevent_cse=prevent_cse, static_argnums=static_argnums)

@@ -37,17 +37,37 @@ def default_collate_fn(batch):
 
 
 class DataLoader:
-    def __init__(self, dataset, feed_list=None, places=None, return_list=True, batch_sampler=None, batch_size=1, shuffle=False, drop_last=False, collate_fn=None, num_workers=0, use_buffer_reader=True, prefetch_factor=2, use_shared_memory=True, timeout=0, worker_init_fn=None, persistent_workers=False):
+    """num_workers>0 runs workers as THREADS by default (numpy/PIL release
+    the GIL, and threads avoid fork/pickle constraints); pass
+    ``worker_mode="process"`` for fork-based worker PROCESSES with
+    shared-memory transport — the reference's multiprocess architecture
+    (dataloader_iter.py:342) — for GIL-bound (pure-Python) augmentation
+    pipelines. ``persistent_workers``/``timeout``/``worker_init_fn`` apply
+    to process mode."""
+
+    def __init__(self, dataset, feed_list=None, places=None, return_list=True, batch_sampler=None, batch_size=1, shuffle=False, drop_last=False, collate_fn=None, num_workers=0, use_buffer_reader=True, prefetch_factor=2, use_shared_memory=True, timeout=0, worker_init_fn=None, persistent_workers=False, worker_mode="thread"):
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
         self.prefetch_factor = max(prefetch_factor, 1)
         self.use_buffer_reader = use_buffer_reader
+        self.use_shared_memory = use_shared_memory
+        self.timeout = timeout
+        self.worker_init_fn = worker_init_fn
+        self.persistent_workers = persistent_workers
+        if worker_mode not in ("thread", "process"):
+            raise ValueError(f"worker_mode must be 'thread' or 'process', got {worker_mode!r}")
+        self.worker_mode = worker_mode
+        self._pool = None  # persistent WorkerPool (process mode)
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
             self.batch_sampler = None
             self.batch_size = batch_size
             self.drop_last = drop_last
+            if worker_mode == "process":
+                raise ValueError("worker_mode='process' needs a map-style "
+                                 "dataset (IterableDataset iterates in-order "
+                                 "in the main process; use threads)")
         elif batch_sampler is not None:
             self.batch_sampler = batch_sampler
         else:
@@ -66,11 +86,48 @@ class DataLoader:
             it = self._iter_iterable()
         elif self.num_workers == 0:
             it = (self._fetch(indices) for indices in self.batch_sampler)
+        elif self.worker_mode == "process":
+            it = self._iter_multiprocess()
         else:
             it = self._iter_threaded()
         if self._prefetch_to_device():
             it = self._iter_device_prefetch(it)
         yield from it
+
+    def _iter_multiprocess(self):
+        from .mp_worker import WorkerPool
+
+        pool = self._pool
+        if pool is None or pool._closed:
+            pool = WorkerPool(self.dataset, self.collate_fn, self.num_workers,
+                              worker_init_fn=self.worker_init_fn,
+                              use_shm=self.use_shared_memory,
+                              timeout=self.timeout,
+                              prefetch_factor=self.prefetch_factor)
+        if self.persistent_workers:
+            self._pool = pool
+            try:
+                yield from pool.run_epoch(self.batch_sampler)
+            except Exception:
+                self._pool = None  # pool is shut down: respawn next epoch
+                raise
+        else:
+            try:
+                yield from pool.run_epoch(self.batch_sampler)
+            finally:
+                pool.shutdown()
+
+    def shutdown(self):
+        """Stop persistent process workers (no-op otherwise)."""
+        if self._pool is not None:
+            self._pool.shutdown()
+            self._pool = None
+
+    def __del__(self):
+        try:
+            self.shutdown()
+        except Exception:
+            pass
 
     def _prefetch_to_device(self):
         """use_buffer_reader parity (reader.py:275): feed batches to the

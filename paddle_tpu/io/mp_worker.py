@@ -1,0 +1,291 @@
+"""Multiprocess DataLoader workers.
+
+Parity: the reference's ``_DataLoaderIterMultiProcess``
+(python/paddle/fluid/dataloader/dataloader_iter.py:342) + ``_worker_loop``
+(dataloader/worker.py) + shared-memory LoDTensor transport
+(fluid/memory/allocation/mmap_allocator). TPU-first shape of the same idea:
+
+- worker processes pull ``(batch_idx, indices)`` off an index queue, run
+  ``collate_fn([dataset[i] for i in indices])``, and ship each numpy array
+  back through a POSIX shared-memory segment (``multiprocessing.
+  shared_memory``) so large batches never pass through a pickle pipe;
+- the parent restores order by batch index, detects dead workers instead of
+  blocking forever, re-raises worker exceptions with their tracebacks, and
+  supports persistent workers across epochs;
+- workers never touch jax: fork inherits the parent's initialized backend,
+  and the child exits with ``os._exit`` to skip jax/XLA atexit hooks.
+"""
+from __future__ import annotations
+
+import os
+import queue as pyqueue
+import traceback
+
+import numpy as np
+
+_POLL_S = 2.0  # liveness-check cadence while waiting on the data queue
+
+
+class WorkerInfo:
+    """Worker-side view for IterableDataset sharding (reference
+    dataloader/worker.py WorkerInfo / paddle.io.get_worker_info)."""
+
+    def __init__(self, id, num_workers, dataset=None, seed=None):
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+        self.seed = seed
+
+
+_worker_info: WorkerInfo | None = None
+
+
+def get_worker_info():
+    """Inside a worker process: its WorkerInfo; in the main process: None."""
+    return _worker_info
+
+
+class _ShmRef:
+    """Descriptor for one ndarray parked in a shared-memory segment."""
+
+    __slots__ = ("name", "shape", "dtype")
+
+    def __init__(self, name, shape, dtype):
+        self.name, self.shape, self.dtype = name, shape, str(dtype)
+
+
+def _pack(obj, use_shm):
+    """Replace ndarray leaves with _ShmRef descriptors (arrays copied into
+    fresh shm segments); small/non-array leaves travel inline."""
+    if use_shm and isinstance(obj, np.ndarray) and obj.nbytes > 0:
+        from multiprocessing import shared_memory
+
+        shm = shared_memory.SharedMemory(create=True, size=obj.nbytes)
+        np.ndarray(obj.shape, obj.dtype, buffer=shm.buf)[...] = obj
+        ref = _ShmRef(shm.name, obj.shape, obj.dtype)
+        shm.close()  # segment lives until the parent unlinks it
+        return ref
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_pack(v, use_shm) for v in obj)
+    if isinstance(obj, dict):
+        return {k: _pack(v, use_shm) for k, v in obj.items()}
+    return obj
+
+
+def _unpack(obj):
+    if isinstance(obj, _ShmRef):
+        from multiprocessing import shared_memory
+
+        shm = shared_memory.SharedMemory(name=obj.name)
+        try:
+            arr = np.array(np.ndarray(obj.shape, obj.dtype, buffer=shm.buf))
+        finally:
+            shm.close()
+            shm.unlink()
+        return arr
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_unpack(v) for v in obj)
+    if isinstance(obj, dict):
+        return {k: _unpack(v) for k, v in obj.items()}
+    return obj
+
+
+def _discard(obj):
+    """Unlink shm segments of a batch that will never be consumed."""
+    if isinstance(obj, _ShmRef):
+        from multiprocessing import shared_memory
+
+        try:
+            shm = shared_memory.SharedMemory(name=obj.name)
+            shm.close()
+            shm.unlink()
+        except Exception:
+            pass
+    elif isinstance(obj, (list, tuple)):
+        for v in obj:
+            _discard(v)
+    elif isinstance(obj, dict):
+        for v in obj.values():
+            _discard(v)
+
+
+def _worker_loop(dataset, collate_fn, index_q, data_q, worker_id, num_workers,
+                 worker_init_fn, use_shm):
+    global _worker_info
+    _worker_info = WorkerInfo(worker_id, num_workers, dataset)
+    try:
+        try:
+            if worker_init_fn is not None:
+                worker_init_fn(worker_id)
+        except Exception as exc:
+            # key=None marks a fatal worker-level failure (not tied to a
+            # batch) so the parent reports the real traceback, not a
+            # misleading "killed (OOM/segfault)" hint
+            data_q.put((None, None,
+                        f"worker {worker_id} init failed — {type(exc).__name__}: "
+                        f"{exc}\n{traceback.format_exc()}"))
+            data_q.close()
+            data_q.join_thread()  # flush before os._exit kills the feeder
+            os._exit(1)
+        while True:
+            item = index_q.get()
+            if item is None:
+                break
+            key, indices = item  # key = (epoch, batch_idx)
+            try:
+                batch = collate_fn([dataset[i] for i in indices])
+                data_q.put((key, _pack(batch, use_shm), None))
+            except Exception as exc:  # ship the traceback, keep serving
+                data_q.put((key, None,
+                            f"{type(exc).__name__}: {exc}\n{traceback.format_exc()}"))
+    except (KeyboardInterrupt, SystemExit):
+        pass
+    finally:
+        data_q.cancel_join_thread()
+        os._exit(0)  # skip jax/XLA atexit hooks inherited through fork
+
+
+class WorkerPool:
+    """Persistent fork-based worker pool + ordered batch iteration."""
+
+    def __init__(self, dataset, collate_fn, num_workers, worker_init_fn=None,
+                 use_shm=True, timeout=0, prefetch_factor=2):
+        import multiprocessing as mp
+
+        ctx = mp.get_context("fork" if "fork" in mp.get_all_start_methods() else "spawn")
+        self._ctx = ctx
+        try:
+            # start the resource tracker BEFORE forking so workers inherit it:
+            # shm segments registered by workers then unregister cleanly when
+            # the parent unlinks them (otherwise each worker spawns its own
+            # tracker, which warns about already-unlinked segments at exit)
+            from multiprocessing import resource_tracker
+
+            resource_tracker.ensure_running()
+        except Exception:
+            pass
+        self._timeout = timeout
+        self._prefetch = max(1, prefetch_factor)
+        self._index_q = ctx.Queue()
+        self._data_q = ctx.Queue()
+        self._closed = False
+        self._epoch = 0  # tags queue traffic so a half-consumed epoch's
+        self._workers = []  # leftovers can't leak into the next one
+        for wid in range(num_workers):
+            p = ctx.Process(
+                target=_worker_loop,
+                args=(dataset, collate_fn, self._index_q, self._data_q,
+                      wid, num_workers, worker_init_fn, use_shm),
+                daemon=True)
+            p.start()
+            self._workers.append(p)
+
+    def run_epoch(self, index_batches):
+        """Generator: feed the index queue, yield collated batches in order.
+
+        Backpressure: at most ``num_workers * prefetch_factor`` index batches
+        are outstanding (reference keeps the same bound), so workers can't
+        park an unbounded epoch's worth of batches in /dev/shm ahead of a
+        slow consumer. Results tagged with an older epoch (a previous
+        iterator abandoned mid-epoch) are discarded, shm segments included.
+        """
+        self._epoch += 1
+        epoch = self._epoch
+        batches = [list(ix) for ix in index_batches]
+        total = len(batches)
+        window = min(len(self._workers) * self._prefetch, total)
+        sent = 0
+        for sent in range(window):
+            self._index_q.put(((epoch, sent), batches[sent]))
+        sent = window
+        reorder, next_idx, received = {}, 0, 0
+        waited = 0.0
+
+        def _fail(msg):
+            for payload, _ in reorder.values():
+                _discard(payload)
+            self.shutdown()
+            raise RuntimeError(msg)
+
+        while next_idx < total:
+            while next_idx in reorder:
+                payload, err = reorder.pop(next_idx)
+                next_idx += 1
+                if err is not None:
+                    _fail(f"DataLoader worker raised:\n{err}")
+                yield _unpack(payload)
+            if next_idx >= total:
+                break
+            try:
+                key, payload, err = self._data_q.get(timeout=_POLL_S)
+                if key is None:  # fatal worker-level failure (e.g. init)
+                    _discard(payload)
+                    _fail(f"DataLoader worker failed:\n{err}")
+                ep, bidx = key
+                if ep != epoch:
+                    _discard(payload)
+                    continue
+                waited = 0.0
+                received += 1
+                reorder[bidx] = (payload, err)
+                if sent < total:  # top up the window per receive
+                    self._index_q.put(((epoch, sent), batches[sent]))
+                    sent += 1
+            except pyqueue.Empty:
+                waited += _POLL_S
+                dead = [p for p in self._workers if not p.is_alive()]
+                if dead:
+                    # a worker may have flushed its own fatal report just
+                    # before dying — prefer that over the generic hint
+                    try:
+                        while True:
+                            key, payload, err = self._data_q.get_nowait()
+                            if key is None:
+                                _discard(payload)
+                                _fail(f"DataLoader worker failed:\n{err}")
+                            ep, bidx = key
+                            if ep != epoch:
+                                _discard(payload)
+                            else:
+                                reorder[bidx] = (payload, err)
+                    except pyqueue.Empty:
+                        pass
+                    p = dead[0]
+                    _fail(f"DataLoader worker (pid {p.pid}) exited unexpectedly "
+                          f"with code {p.exitcode}. This usually means the "
+                          "worker was killed (OOM/segfault) — rerun with "
+                          "num_workers=0 to debug in the main process.")
+                if self._timeout and waited >= self._timeout:
+                    _fail(f"DataLoader timed out after {self._timeout}s waiting "
+                          f"for batch {next_idx} ({received}/{total} received)")
+
+    def shutdown(self):
+        if self._closed:
+            return
+        self._closed = True
+        # stop workers FIRST (data_q is unbounded so their in-flight puts
+        # can't block), then drain every unconsumed shm batch — draining
+        # before the join would miss batches workers finish during it
+        for _ in self._workers:
+            try:
+                self._index_q.put(None)
+            except Exception:
+                pass
+        for p in self._workers:
+            p.join(timeout=5)
+            if p.is_alive():
+                p.terminate()
+        try:
+            while True:
+                _, payload, _ = self._data_q.get_nowait()
+                _discard(payload)
+        except Exception:
+            pass
+        self._index_q.close()
+        self._data_q.close()
+
+    def __del__(self):
+        try:
+            self.shutdown()
+        except Exception:
+            pass
