@@ -29,7 +29,8 @@ def recompute(function, *args, policy="nothing_saveable", **kwargs):
     path, matching how the reference's recompute only matters under large
     models). Parameters the function closes over stay saveable constants of
     the remat segment — only activations are recomputed."""
-    vals = unwrap_tree(list(args))
+    pol = _policy(policy)  # validate BEFORE the eager early-return so a
+    vals = unwrap_tree(list(args))  # typo'd name fails on first call
     kwvals = unwrap_tree(dict(kwargs))
 
     def _traced(v):
@@ -43,7 +44,6 @@ def recompute(function, *args, policy="nothing_saveable", **kwargs):
     dyn_k = [k for k, v in kwvals.items() if _traced(v)]
     if not dyn_i and not dyn_k:
         return function(*args, **kwargs)
-    pol = _policy(policy)
 
     def _arr_fn(dyn_args, dyn_kwargs):
         full = list(args)

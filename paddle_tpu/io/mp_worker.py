@@ -169,6 +169,7 @@ class WorkerPool:
         self._index_q = ctx.Queue()
         self._data_q = ctx.Queue()
         self._closed = False
+        self._active = False  # one in-flight epoch per pool
         self._epoch = 0  # tags queue traffic so a half-consumed epoch's
         self._workers = []  # leftovers can't leak into the next one
         for wid in range(num_workers):
@@ -189,6 +190,13 @@ class WorkerPool:
         slow consumer. Results tagged with an older epoch (a previous
         iterator abandoned mid-epoch) are discarded, shm segments included.
         """
+        if self._active:
+            raise RuntimeError(
+                "this DataLoader's process-worker pool is already serving an "
+                "iterator; with persistent_workers only one epoch can be "
+                "in flight (exhaust or close the first iterator, or use "
+                "thread mode for concurrent iteration)")
+        self._active = True
         self._epoch += 1
         epoch = self._epoch
         batches = [list(ix) for ix in index_batches]
@@ -198,15 +206,26 @@ class WorkerPool:
         for sent in range(window):
             self._index_q.put(((epoch, sent), batches[sent]))
         sent = window
-        reorder, next_idx, received = {}, 0, 0
-        waited = 0.0
+        reorder = {}
 
         def _fail(msg):
-            for payload, _ in reorder.values():
-                _discard(payload)
             self.shutdown()
             raise RuntimeError(msg)
 
+        try:
+            yield from self._epoch_loop(epoch, batches, total, sent, reorder, _fail)
+        finally:
+            # early close / error: unlink shm parked in the reorder buffer,
+            # it is unreachable from both the queue and the next epoch
+            for payload, _ in reorder.values():
+                _discard(payload)
+            reorder.clear()
+            self._active = False
+
+    def _epoch_loop(self, epoch, batches, total, sent, reorder, _fail):
+        received = 0
+        next_idx = 0
+        waited = 0.0
         while next_idx < total:
             while next_idx in reorder:
                 payload, err = reorder.pop(next_idx)

@@ -68,13 +68,13 @@ def sequence_pad(sequences, pad_value=0.0, maxlen=None, name=None):
 def sequence_unpad(x, length, name=None):
     """(padded [b, maxlen, ...], lengths [b]) -> list of [len_i, ...] tensors
     (reference sequence_unpad returns the LoDTensor; a list is its eager
-    form). Host-side: output shapes are data-dependent."""
+    form). Output shapes are data-dependent so the LENGTHS are read on the
+    host, but each slice stays a tape op — gradients flow back into the
+    padded input (zeros in the padding region)."""
     x = ensure_tensor(x)
     lens = np.asarray(ensure_tensor(length)._value, np.int64)
-    arr = np.asarray(x._value)
-    from ...framework.core import _wrap_value
-
-    return [_wrap_value(jnp.asarray(arr[i, : int(l)])) for i, l in enumerate(lens)]
+    return [op(lambda v, _i=int(i), _l=int(l): v[_i, :_l], x, _name="sequence_unpad")
+            for i, l in enumerate(lens)]
 
 
 def sequence_pool(x, lengths, pool_type="average", name=None):
@@ -131,7 +131,8 @@ def sequence_expand(x, lengths, name=None):
     per-token positions). Host-side sizes (data-dependent output shape)."""
     x = ensure_tensor(x)
     lens = np.asarray(ensure_tensor(lengths)._value, np.int64)
-    from ...framework.core import _wrap_value
-
-    return _wrap_value(jnp.repeat(x._value, jnp.asarray(lens), axis=0,
-                                  total_repeat_length=int(lens.sum())))
+    # sizes are host-side (data-dependent output shape) but the repeat is a
+    # tape op so gradients sum back over each row's repeats
+    return op(lambda v: jnp.repeat(v, jnp.asarray(lens), axis=0,
+                                   total_repeat_length=int(lens.sum())),
+              x, _name="sequence_expand")

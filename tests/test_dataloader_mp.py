@@ -189,3 +189,18 @@ def test_persistent_loader_recovers_after_worker_error():
     assert len(out) == 4
     loader.shutdown()
     good.shutdown()
+
+
+def test_concurrent_iterators_on_persistent_pool_refused():
+    loader = DataLoader(_ArrayDS(n=16), batch_size=4, num_workers=2,
+                        worker_mode="process", persistent_workers=True)
+    try:
+        it1 = iter(loader)
+        next(it1)
+        it2 = iter(loader)
+        with pytest.raises(RuntimeError, match="already serving"):
+            next(it2)
+        rest = list(it1)  # first iterator still completes its epoch
+        assert len(rest) == 3
+    finally:
+        loader.shutdown()
