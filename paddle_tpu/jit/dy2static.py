@@ -11,21 +11,26 @@ XLA-native bridges ``static.nn.cond`` / ``static.nn.while_loop`` (traced
 condition — compiles to lax.cond / lax.while_loop).
 
 Supported rewrites:
-- ``if``/``elif``/``else`` whose branches assign variables (no
-  return/break/continue inside the branch),
+- ``if``/``elif``/``else`` whose branches assign variables,
 - ``while`` loops (loop-carried variables inferred from branch stores),
 - ``for <name> in range(...)`` — runtime dispatch between a native Python
   loop (concrete bounds: trace-unrolled, exact semantics) and a
   while-loop form (traced bounds),
+- ``break``/``continue`` in while/for-range loops (de-sugared into
+  flag-guarded form, reference break_continue_transformer.py),
+- ``return`` inside if branches (flag + continuation-into-else form,
+  reference return_transformer.py) — all paths must return values of the
+  same structure when the predicate is traced,
 - ``and`` / ``or`` / ``not`` over tensors (Python short-circuit semantics
   are preserved for concrete values via lambdas).
 
-Anything else (returns inside branches, tuple-target for loops, try/except,
-in-place mutation in a branch — subscript/attribute stores and mutating
-method calls like ``lst.append``/``d.update``/``t.add_``, …) is left
-untouched: concrete-value code runs exactly as before, and a
-tensor-dependent condition in unsupported shapes raises JAX's
-TracerBoolConversionError pointing at the static.nn bridges.
+Anything else (returns inside loops, tuple-target for loops, try/except,
+break/continue inside try/with, in-place mutation in a branch —
+subscript/attribute stores and mutating method calls like
+``lst.append``/``d.update``/``t.add_``, …) is left untouched:
+concrete-value code runs exactly as before, and a tensor-dependent
+condition in unsupported shapes raises JAX's TracerBoolConversionError
+pointing at the static.nn bridges.
 
 Transformation is best-effort: if the source is unavailable (C extensions,
 REPL, lambdas) the original function is used unchanged.
@@ -137,11 +142,21 @@ def convert_while(cond_fn, body_fn, init, names):
             vals = tuple(body_fn(*vals))
             b = _concrete_bool(cond_fn(*vals))
             if b is None:
+                if any(n.startswith(("_jst_brk", "_jst_cont")) for n in names):
+                    # a de-sugared break/continue flag became traced: the
+                    # flag-form body is pure over its loop vars (escape-
+                    # scanned), so discard the partial run and re-execute
+                    # the whole loop in traced form from init
+                    return _traced_while(cond_fn, body_fn, init, names)
                 raise TypeError(
                     "while condition became a traced tensor mid-loop; a "
                     "tensor-dependent while must start from tensor loop vars "
                     "(static.nn.while_loop)")
         return vals
+    return _traced_while(cond_fn, body_fn, init, names)
+
+
+def _traced_while(cond_fn, body_fn, init, names):
     from ..static import nn as _snn
     from ..tensor._helpers import ensure_tensor
 
@@ -194,7 +209,18 @@ def maybe_range(*args):
         start, stop, step = args[0], args[1], 1
     else:
         start, stop, step = args
+    if not _is_traced(step) and int(step) == 0:
+        raise ValueError("range() arg 3 must not be zero")
     return ("t", (start, stop, step))
+
+
+def concrete_true(flag):
+    """bool(flag) when concrete, False when traced — lets an unrolled loop
+    exit natively the moment a de-sugared break flag is concretely True,
+    while traced flags keep unrolling (the guards mask the dead
+    iterations)."""
+    b = _concrete_bool(flag)
+    return bool(b) if b is not None else False
 
 
 def is_py(r):
@@ -419,6 +445,174 @@ def _tuple_of(names, ctx=None):
     return ast.Tuple(elts=[_name(n, ctx or ast.Load()) for n in names], ctx=ctx or ast.Load())
 
 
+# -- break/continue de-sugaring ---------------------------------------------
+#
+# Reference: dygraph_to_static/break_continue_transformer.py. A loop whose
+# top-level body contains break/continue is rewritten into a pure
+# flag-guarded form FIRST; the ordinary if/while machinery then compiles the
+# flags (concrete flags run native Python, traced flags become lax control
+# flow):
+#
+#   _brk = False; _cont = False
+#   while (not _brk) and cond:
+#       _cont = False
+#       ... break -> _brk = True ; continue -> _cont = True ...
+#       if not (_brk or _cont): <rest of body>
+
+
+def _loop_escape_here(stmts):
+    """break/continue belonging to THIS loop level: walk statements without
+    descending into nested loops or function/class scopes."""
+    for s in stmts:
+        if isinstance(s, (ast.Break, ast.Continue)):
+            return True
+        if isinstance(s, (ast.For, ast.While, ast.AsyncFor, ast.FunctionDef,
+                          ast.AsyncFunctionDef, ast.ClassDef)):
+            continue
+        if isinstance(s, ast.If):
+            if _loop_escape_here(s.body) or _loop_escape_here(s.orelse):
+                return True
+            continue
+        for sub in ast.walk(s):
+            if isinstance(sub, (ast.Break, ast.Continue)):
+                return True  # break inside try/with: unsupported shape
+    return False
+
+
+def _flag_assign(name, value):
+    return ast.Assign(targets=[_name(name, ast.Store())], value=ast.Constant(value=value))
+
+
+def _guard_block(stmts, brk, cont):
+    """Rewrite one statement block: break/continue become flag sets, the
+    statements after a flag-setting `if` are wrapped in a not-flag guard.
+    Returns None when the block has an unsupported shape (break inside
+    try/with)."""
+    out = []
+    for idx, s in enumerate(stmts):
+        if isinstance(s, ast.Break):
+            out.append(_flag_assign(brk, True))
+            return out  # statements after an unconditional break are dead
+        if isinstance(s, ast.Continue):
+            out.append(_flag_assign(cont, True))
+            return out
+        if isinstance(s, ast.If) and (_loop_escape_here(s.body) or _loop_escape_here(s.orelse)):
+            b = _guard_block(s.body, brk, cont)
+            o = _guard_block(s.orelse, brk, cont)
+            if b is None or o is None:
+                return None
+            out.append(ast.If(test=s.test, body=b or [ast.Pass()], orelse=o))
+            rest = stmts[idx + 1:]
+            if rest:
+                sub = _guard_block(rest, brk, cont)
+                if sub is None:
+                    return None
+                guard = ast.UnaryOp(op=ast.Not(), operand=ast.BoolOp(
+                    op=ast.Or(), values=[_name(brk), _name(cont)]))
+                out.append(ast.If(test=guard, body=sub, orelse=[]))
+            return out
+        if isinstance(s, (ast.For, ast.While, ast.AsyncFor, ast.FunctionDef,
+                          ast.AsyncFunctionDef, ast.ClassDef)):
+            out.append(s)  # nested loop/scope: its break/continue is its own
+            continue
+        for sub in ast.walk(s):
+            if isinstance(sub, (ast.Break, ast.Continue)):
+                return None  # e.g. inside try/with — refuse
+        out.append(s)
+    return out
+
+
+# -- return-in-branch de-sugaring -------------------------------------------
+#
+# Reference: dygraph_to_static/return_transformer.py. `return` inside an if
+# branch becomes `_jst_done = True; _jst_rv = value`; when the branch always
+# returns, the statements after the `if` become its else (continuation into
+# else — no undefined-value merge), otherwise they are wrapped in an
+# `if not _jst_done:` guard. The function ends with `return _jst_rv`.
+
+_RET_DONE, _RET_RV = "_jst_done", "_jst_rv"
+
+
+def _always_returns(stmts):
+    for s in stmts:
+        if isinstance(s, ast.Return):
+            return True
+        if isinstance(s, ast.If) and s.orelse and _always_returns(s.body) and _always_returns(s.orelse):
+            return True
+    return False
+
+
+def _branch_returns(stmts):
+    """(has_return_inside_an_if, unsupported)."""
+    has = False
+    for s in stmts:
+        if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            continue
+        if isinstance(s, (ast.For, ast.While, ast.AsyncFor, ast.Try, ast.With,
+                          ast.AsyncWith)):
+            for sub in ast.walk(s):
+                if isinstance(sub, ast.Return):
+                    return False, True  # return inside loop/try: unsupported
+            continue
+        if isinstance(s, ast.If):
+            h1, u1 = _branch_returns(s.body)
+            h2, u2 = _branch_returns(s.orelse)
+            if u1 or u2:
+                return False, True
+            has = has or h1 or h2 or any(isinstance(b, ast.Return) for b in s.body + s.orelse)
+    return has, False
+
+
+def _rewrite_returns(stmts):
+    """Rewrite a block: returns become flag+value sets. Returns (block,
+    changed)."""
+    out = []
+    for idx, s in enumerate(stmts):
+        if isinstance(s, ast.Return):
+            out.append(_flag_assign(_RET_DONE, True))
+            out.append(ast.Assign(targets=[_name(_RET_RV, ast.Store())],
+                                  value=s.value or ast.Constant(value=None)))
+            return out, True
+        if isinstance(s, ast.If):
+            b, c1 = _rewrite_returns(s.body)
+            o, c2 = _rewrite_returns(s.orelse)
+            if c1 or c2:
+                rest = stmts[idx + 1:]
+                if rest and _always_returns(s.body) and not s.orelse:
+                    # continuation-into-else: every value path assigns _jst_rv
+                    o, _ = _rewrite_returns(rest)
+                    out.append(ast.If(test=s.test, body=b, orelse=o))
+                    return out, True
+                out.append(ast.If(test=s.test, body=b, orelse=o))
+                if rest:
+                    sub, _ = _rewrite_returns(rest)
+                    out.append(ast.If(test=ast.UnaryOp(op=ast.Not(), operand=_name(_RET_DONE)),
+                                      body=sub, orelse=[]))
+                return out, True
+            out.append(s)
+            continue
+        out.append(s)
+    return out, False
+
+
+def _desugar_returns(fdef):
+    """Apply the return transform to a function body when it has returns
+    inside if branches (and none inside loops/try). Returns True if
+    rewritten."""
+    has, unsupported = _branch_returns(fdef.body)
+    if not has or unsupported:
+        return False
+    body, _ = _rewrite_returns(fdef.body)
+    fdef.body = ([_flag_assign(_RET_DONE, False),
+                  ast.Assign(targets=[_name(_RET_RV, ast.Store())],
+                             value=ast.Constant(value=None))]
+                 + body + [ast.Return(value=_name(_RET_RV))])
+    for s in fdef.body:
+        ast.copy_location(s, fdef)
+        ast.fix_missing_locations(s)
+    return True
+
+
 class _Transformer(ast.NodeTransformer):
     def __init__(self):
         self.n = 0
@@ -490,8 +684,38 @@ class _Transformer(ast.NodeTransformer):
     # -- while ---------------------------------------------------------------
 
     def visit_While(self, node):
+        des = self._desugar_loop(node)
+        if des is not None:
+            self.changed = True
+            out = []
+            for s in des:  # fresh statements: run the full rewrite over them
+                r = self.visit(s)
+                out.extend(r if isinstance(r, list) else [r])
+            return out
         self.generic_visit(node)
         return self._rewrite_while(node)
+
+    def _desugar_loop(self, node):
+        """While with top-level break/continue -> flag-guarded pure form
+        (then rewritten by the ordinary machinery). None when inapplicable."""
+        if getattr(node, "_jst_skip", False) or node.orelse:
+            return None
+        if not _loop_escape_here(node.body):
+            return None
+        uid = self._uid()
+        brk, cont = f"_jst_brk{uid}", f"_jst_cont{uid}"
+        guarded = _guard_block(node.body, brk, cont)
+        if guarded is None:
+            return None
+        test = ast.BoolOp(op=ast.And(), values=[
+            ast.UnaryOp(op=ast.Not(), operand=_name(brk)), node.test])
+        wl = ast.While(test=test,
+                       body=[_flag_assign(cont, False)] + guarded, orelse=[])
+        stmts = [_flag_assign(brk, False), _flag_assign(cont, False), wl]
+        for s in stmts:
+            ast.copy_location(s, node)
+            ast.fix_missing_locations(s)
+        return stmts
 
     def _rewrite_while(self, node):
         if getattr(node, "_jst_skip", False) or node.orelse:
@@ -526,6 +750,7 @@ class _Transformer(ast.NodeTransformer):
 
     def visit_For(self, node):
         self.generic_visit(node)
+        has_bc = (not node.orelse) and _loop_escape_here(node.body)
         if (node.orelse or not isinstance(node.target, ast.Name)
                 or not isinstance(node.iter, ast.Call)
                 or not isinstance(node.iter.func, ast.Name)
@@ -533,7 +758,7 @@ class _Transformer(ast.NodeTransformer):
                 or node.iter.keywords
                 or not 1 <= len(node.iter.args) <= 3
                 or any(isinstance(a, ast.Starred) for a in node.iter.args)
-                or _escapes(node.body)
+                or (_escapes(node.body) and not has_bc)
                 # a body that rebinds the target diverges from for semantics
                 # in the while-form (the rebound value would be carried)
                 or node.target.id in _stores(node.body)):
@@ -543,25 +768,74 @@ class _Transformer(ast.NodeTransformer):
         tgt = node.target.id
         r_assign = ast.Assign(targets=[_name(rname, ast.Store())],
                               value=_jst_call("maybe_range", list(node.iter.args)))
-        # python path: the original loop over the concrete range
+        pre = []
+        if has_bc:
+            # de-sugar break/continue to flags. Concrete-bounds path: a
+            # statically-unrolled loop whose per-iteration body is masked by
+            # the flags — with concrete flags convert_ifelse dispatches
+            # natively (exact Python break/continue semantics); with a
+            # TRACED break predicate the guards become lax.cond, which keeps
+            # the loop differentiable (reverse-mode through lax.while_loop
+            # is impossible, so the canonical loop-with-break example must
+            # unroll). Traced-bounds path: flag-carried while, forward-only.
+            brk, cont = f"_jst_brk{uid}", f"_jst_cont{uid}"
+            guarded = _guard_block(copy.deepcopy(node.body), brk, cont)
+            if guarded is None or _escapes(guarded):
+                return node
+            pre = [_flag_assign(brk, False), _flag_assign(cont, False)]
+            # rewrite the guard content NOW; the assembled loop is not
+            # re-visited (its native early-exit break must stay native)
+            guard_if = ast.If(test=ast.UnaryOp(op=ast.Not(), operand=_name(brk)),
+                              body=copy.deepcopy(guarded), orelse=[])
+            ast.copy_location(guard_if, node)
+            ast.fix_missing_locations(guard_if)
+            visited_guard = self.visit(guard_if)
+            visited_guard = visited_guard if isinstance(visited_guard, list) else [visited_guard]
+            # native early exit once the break flag is CONCRETELY true —
+            # restores Python's post-loop target value and skips dead
+            # iterations; a traced flag keeps unrolling behind the guards
+            early = ast.If(test=_jst_call("concrete_true", [_name(brk)]),
+                           body=[ast.Break()], orelse=[])
+            early._jst_skip = True
+            py_body = [early, _flag_assign(cont, False)] + visited_guard
+        else:
+            py_body = copy.deepcopy(node.body)
+        # python path: loop over the concrete range
         py_loop = ast.For(target=ast.Name(id=tgt, ctx=ast.Store()),
                           iter=_jst_call("py_range", [_name(rname)]),
-                          body=copy.deepcopy(node.body), orelse=[])
-        # traced path: while-form, rewritten through the while machinery
+                          body=py_body, orelse=[])
+        py_loop._jst_skip = True
+        # traced-bounds path: while-form, rewritten through the while
+        # machinery; with break/continue the step stays UNguarded so
+        # `continue` still advances the loop variable
         init = ast.Assign(targets=[_name(tgt, ast.Store())],
                           value=_jst_call("range_start", [_name(rname)]))
         step = ast.Assign(
             targets=[_name(tgt, ast.Store())],
             value=ast.BinOp(left=_name(tgt), op=ast.Add(),
                             right=_jst_call("range_step", [_name(rname)])))
-        wl = ast.While(test=_jst_call("range_cond", [_name(tgt), _name(rname)]),
-                       body=copy.deepcopy(node.body) + [step], orelse=[])
-        rewritten = self._rewrite_while(wl)
+        test = _jst_call("range_cond", [_name(tgt), _name(rname)])
+        if has_bc:
+            wl_body = [_flag_assign(cont, False)] + copy.deepcopy(guarded)
+            test = ast.BoolOp(op=ast.And(), values=[
+                ast.UnaryOp(op=ast.Not(), operand=_name(brk)), test])
+        else:
+            wl_body = copy.deepcopy(node.body)
+        wl = ast.While(test=test, body=wl_body + [step], orelse=[])
+        for s in pre + [py_loop, wl]:
+            ast.copy_location(s, node)
+            ast.fix_missing_locations(s)
+        if has_bc:
+            py_loop = self.visit(py_loop)  # fresh guard ifs need a full pass
+            rewritten = self.visit(wl)
+        else:
+            rewritten = self._rewrite_while(wl)
+        py_stmts = py_loop if isinstance(py_loop, list) else [py_loop]
         traced_stmts = [init] + (rewritten if isinstance(rewritten, list) else [rewritten])
         dispatch = ast.If(test=_jst_call("is_py", [_name(rname)]),
-                          body=[py_loop], orelse=traced_stmts)
+                          body=py_stmts, orelse=traced_stmts)
         dispatch._jst_skip = True
-        stmts = [r_assign, dispatch]
+        stmts = [r_assign] + pre + [dispatch]
         for s in stmts:
             ast.copy_location(s, node)
             ast.fix_missing_locations(s)
@@ -594,9 +868,10 @@ def _build_factory(fn):
             sub.id if isinstance(sub, ast.Name) else None)
         if nm and nm.startswith("__") and not nm.endswith("__"):
             return None
+    ret_changed = _desugar_returns(fdef)
     t = _Transformer()
     t.visit(tree)
-    if not t.changed:  # nothing rewritten — keep the original function
+    if not (t.changed or ret_changed):  # nothing rewritten — keep original
         return None
     freevars = fn.__code__.co_freevars
     factory = ast.FunctionDef(

@@ -701,7 +701,9 @@ class _StaticNN:
                     env = sub.interpret(env, tvals)
                     res = []
                     for o in outs:
-                        if is_symbolic(o._value):
+                        if not hasattr(o, "_value"):  # raw python constant
+                            res.append(jnp.asarray(o))  # (e.g. a bool flag)
+                        elif is_symbolic(o._value):
                             if o._value.name in env:
                                 res.append(env[o._value.name])
                             else:  # identity-returned placeholder

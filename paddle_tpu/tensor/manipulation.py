@@ -19,7 +19,12 @@ def cast(x, dtype):
     dst_float = jnp.issubdtype(np.dtype(dt), np.floating) or dt == jnp.bfloat16
     if src_float and dst_float:
         return op(lambda v: v.astype(dt), x, _name="cast")
-    # non-differentiable cast
+    # non-differentiable cast: detached from the tape, but still an op when
+    # the value is a static-trace symbol (SymbolicValue has no .astype)
+    from ..framework.static_trace import is_symbolic
+
+    if is_symbolic(x._value):
+        return op(lambda v: v.astype(dt), x, _name="cast")
     return _wrap_value(x._value.astype(dt))
 
 

@@ -381,3 +381,147 @@ def test_pure_calls_named_like_mutators_still_rewritten():
     # traced predicate: still compiles through lax.cond
     step = paddle.jit.to_static(f)
     np.testing.assert_allclose(_np(step(x)), [3.0, 5.0])
+
+
+# -- break / continue / return-in-branch (reference:
+# break_continue_transformer.py, return_transformer.py) ---------------------
+
+
+def test_break_in_while_concrete_and_traced():
+    def f(x, n):
+        i = 0
+        s = x * 0
+        while i < n:
+            s = s + x
+            if s.sum() > 4:
+                break
+            i = i + 1
+        return s, i
+
+    g = transpile(f)
+    x = paddle.to_tensor(np.array([1.0, 1.0], np.float32))
+    # concrete bound: parity with native Python
+    fs, fi = f(x, 10)
+    gs, gi = g(x, 10)
+    np.testing.assert_allclose(_np(fs), _np(gs))
+    assert fi == gi == 2
+    # traced bound: compiles through lax.while_loop, same value
+    n_t = paddle.to_tensor(np.int32(10))
+    ts, ti = g(x, n_t)
+    np.testing.assert_allclose(_np(ts), _np(fs))
+    assert int(_np(ti)) == 2
+
+
+def test_continue_in_for_range_concrete_and_traced():
+    def f(x, n):
+        s = x * 0
+        for i in range(n):
+            if i % 2 == 0:
+                continue
+            s = s + x * i
+        return s
+
+    g = transpile(f)
+    x = paddle.to_tensor(np.array([1.0], np.float32))
+    np.testing.assert_allclose(_np(g(x, 6)), _np(f(x, 6)))  # 1+3+5 = 9
+    traced = g(x, paddle.to_tensor(np.int32(6)))
+    np.testing.assert_allclose(_np(traced), _np(f(x, 6)))
+
+
+def test_break_in_for_range_traced_bound():
+    """The canonical reference example: loop with a tensor-dependent break
+    under a traced range bound."""
+    def f(x, n):
+        s = x * 0
+        for i in range(n):
+            s = s + x
+            if s.sum() >= 3:
+                break
+        return s
+
+    g = transpile(f)
+    x = paddle.to_tensor(np.array([1.0], np.float32))
+    np.testing.assert_allclose(_np(g(x, 100)), _np(f(x, 100)))
+    traced = g(x, paddle.to_tensor(np.int32(100)))
+    np.testing.assert_allclose(_np(traced), [3.0])
+
+
+def test_return_in_branch_concrete_and_traced():
+    def f(x):
+        if x.sum() > 0:
+            return x * 2
+        return x - 1
+
+    g = transpile(f)
+    pos = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    neg = paddle.to_tensor(np.array([-1.0, -2.0], np.float32))
+    np.testing.assert_allclose(_np(g(pos)), [2.0, 4.0])
+    np.testing.assert_allclose(_np(g(neg)), [-2.0, -3.0])
+    # traced predicate: both paths merge through lax.cond under jit
+    import jax
+
+    jf = jax.jit(lambda v: g(paddle.to_tensor(v) * 1.0)._value)
+    np.testing.assert_allclose(np.asarray(jf(np.array([1.0, 2.0], np.float32))), [2.0, 4.0])
+    np.testing.assert_allclose(np.asarray(jf(np.array([-1.0, -2.0], np.float32))), [-2.0, -3.0])
+
+
+def test_return_in_elif_chain():
+    def f(x):
+        if x.sum() > 10:
+            return x * 3
+        elif x.sum() > 0:
+            return x * 2
+        else:
+            return x * 1
+
+    g = transpile(f)
+    for v, scale in (([20.0], 3), ([1.0], 2), ([-5.0], 1)):
+        x = paddle.to_tensor(np.array(v, np.float32))
+        np.testing.assert_allclose(_np(g(x)), np.array(v) * scale)
+
+
+def test_return_then_code_after_if():
+    def f(x):
+        if x.sum() > 0:
+            return x * 2
+        y = x - 5
+        return y * 10
+
+    g = transpile(f)
+    np.testing.assert_allclose(_np(g(paddle.to_tensor(np.array([2.0], np.float32)))), [4.0])
+    np.testing.assert_allclose(_np(g(paddle.to_tensor(np.array([-1.0], np.float32)))), [-60.0])
+
+
+def test_return_inside_loop_left_native():
+    """Returns inside loops are out of scope: the function must still run
+    with exact Python semantics for concrete values."""
+    def f(x, n):
+        for i in range(n):
+            if i == 2:
+                return x + i
+        return x
+
+    g = transpile(f)
+    np.testing.assert_allclose(_np(g(paddle.to_tensor(np.array([1.0], np.float32)), 5)), [3.0])
+
+
+def test_break_loop_is_differentiable_with_concrete_bounds():
+    """Concrete-bounds loop with a traced break unrolls to lax.cond-masked
+    iterations, so reverse-mode works (a dynamic lax.while_loop would not)."""
+    import jax
+
+    def f(x):
+        s = x * 0
+        for i in range(6):
+            s = s + x * (i + 1)
+            if s.sum() > 5:
+                break
+        return s.sum()
+
+    g = transpile(f)
+    x0 = np.array([1.0], np.float32)
+    # breaks after i=2 (1+2+3=6 > 5): ds/dx = 1+2+3 = 6
+    grad = jax.grad(lambda v: g(paddle.to_tensor(v))._value)(x0)
+    np.testing.assert_allclose(np.asarray(grad), [6.0])
+    val = float(_np(g(paddle.to_tensor(x0))))
+    assert val == 6.0
