@@ -53,7 +53,13 @@ def main():
     model.fit(ArrayDataset(), epochs=2, batch_size=32, verbose=1,
               callbacks=[paddle.callbacks.EarlyStopping(monitor="loss", patience=3)])
 
-    prefix = os.path.join(os.path.dirname(__file__), "_clf_int8")
+    # gitignored output dir (override with PADDLE_TPU_EXAMPLE_OUT) so
+    # test/bench runs leave `git status` clean
+    out_root = os.environ.get(
+        "PADDLE_TPU_EXAMPLE_OUT",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), "_out"))
+    os.makedirs(out_root, exist_ok=True)
+    prefix = os.path.join(out_root, "clf_int8")
     net.eval()
     qat.save_quantized_model(net, prefix,
                              input_spec=[paddle.static.InputSpec([None, 1, 12, 12], "float32")])

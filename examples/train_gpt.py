@@ -42,7 +42,12 @@ def main():
         metrics = step(paddle.to_tensor(ids), paddle.to_tensor(ids))
         print(f"iter {it} loss {float(metrics['loss']):.4f} lr {float(metrics['lr']):.2e}")
 
-    ckpt_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_gpt_ckpt")
+    # outputs land under the gitignored examples/_out (override with
+    # PADDLE_TPU_EXAMPLE_OUT) so test/bench runs leave `git status` clean
+    out_root = os.environ.get(
+        "PADDLE_TPU_EXAMPLE_OUT",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), "_out"))
+    ckpt_dir = os.path.join(out_root, "gpt_ckpt")
     paddle.distributed.checkpoint.save_train_step(step, ckpt_dir)
     print("checkpoint saved to", ckpt_dir)
 

@@ -73,6 +73,8 @@ class CostModel:
                                    tuple(t._value for t in others), state)
         compiled = lowered.compile()
         cost = compiled.cost_analysis() or {}
+        if isinstance(cost, (list, tuple)):  # older jax: one dict per device
+            cost = cost[0] if cost else {}
         mem = compiled.memory_analysis()
         out = {
             "flops": float(cost.get("flops", 0.0)),

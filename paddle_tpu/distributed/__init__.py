@@ -40,4 +40,13 @@ from .ring_attention import ring_attention, ulysses_attention  # noqa: F401
 from .sharding import build_state_specs, group_sharded_parallel, state_shardings  # noqa: F401
 from .strategy import DistributedStrategy  # noqa: F401
 from .topology import AXES, HybridCommunicateGroup, build_mesh  # noqa: F401
-from .store import TCPStore, rendezvous_store  # noqa: F401
+from .store import BarrierTimeoutError, TCPStore, rendezvous_store  # noqa: F401
+from .resilience import (  # noqa: F401
+    CheckpointCorruption,
+    CheckpointManager,
+    RetryingStore,
+    WorkerFault,
+    retry,
+    run_resilient,
+    watchdog,
+)

@@ -20,6 +20,30 @@ def _ocp():
     return ocp
 
 
+def checksum_pytree(state: Any) -> dict:
+    """Per-leaf content checksums: tree path -> {crc32, shape, dtype}.
+
+    The CheckpointManager (distributed/resilience.py) stores this in each
+    checkpoint's manifest and re-computes it on restore, so a truncated or
+    bit-flipped checkpoint is detected instead of silently resuming from
+    garbage. CRC32 over the host bytes: integrity against torn writes, not
+    an adversary."""
+    import zlib
+
+    from jax.tree_util import keystr, tree_flatten_with_path
+
+    leaves, _ = tree_flatten_with_path(state)
+    out = {}
+    for path, leaf in leaves:
+        arr = np.asarray(jax.device_get(leaf))
+        out[keystr(path)] = {
+            "crc32": zlib.crc32(np.ascontiguousarray(arr).tobytes()) & 0xFFFFFFFF,
+            "shape": list(arr.shape),
+            "dtype": str(arr.dtype),
+        }
+    return out
+
+
 def save_state(state: Any, path: str, async_save: bool = False):
     """Save a (possibly sharded) pytree state. Returns when durable unless
     async_save (then returns a handle with .wait())."""

@@ -19,6 +19,8 @@ import threading
 import time
 from typing import List, Optional, Tuple
 
+from ..testing import chaos
+
 _PREFIX = "elastic"
 
 
@@ -37,6 +39,8 @@ class ElasticNode:
         self._thread.start()
 
     def _beat(self):
+        if chaos.heartbeat_frozen(self.node_id):
+            return  # injected zombie: process lives, membership sees it die
         self.store.set(f"{_PREFIX}/hb/{self.node_id}", repr(time.time()))
 
     def _loop(self):

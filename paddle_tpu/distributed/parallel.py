@@ -97,7 +97,9 @@ class _BucketReducer:
                 f"{missing}. Pass find_unused_parameters=True if parts of "
                 "the model are intentionally unused (reference reducer "
                 "semantics).")
-        for dt, ps in self.buckets:
+        from .resilience import watchdog
+
+        for bi, (dt, ps) in enumerate(self.buckets):
             grads = [p.grad for p in ps]
             if not any(g is not None for g in grads):
                 continue  # whole bucket untouched this pass
@@ -108,7 +110,12 @@ class _BucketReducer:
             ])
             fn, sh = self._pmean_fn(int(flat.shape[0]), dt)
             stacked = jax.make_array_from_process_local_data(sh, np.asarray(flat)[None])
-            out = jnp.asarray(fn(stacked).addressable_shards[0].data)[0]
+            # a dead peer turns this collective into a silent infinite hang;
+            # the watchdog (armed via FLAGS_collective_timeout_s) names the
+            # bucket so the elastic layer's restart is attributable
+            with watchdog(f"ddp all-reduce bucket {bi} ({dt}, "
+                          f"{int(flat.shape[0])} elems)"):
+                out = jnp.asarray(fn(stacked).addressable_shards[0].data)[0]
             off = 0
             for p, g in zip(ps, grads):
                 n = int(np.prod(p._value.shape or (1,)))

@@ -11,9 +11,26 @@ NCCL comm-id exchange.
 from __future__ import annotations
 
 import os
+import time
 from typing import List, Optional
 
 from ..framework import native
+from ..testing import chaos
+
+
+class BarrierTimeoutError(TimeoutError):
+    """A diagnostic barrier expired; names exactly which ranks never
+    arrived (``missing_ranks``) instead of a bare TimeoutError."""
+
+    def __init__(self, name: str, missing_ranks: List[int], arrived: List[int],
+                 timeout: float):
+        self.name = name
+        self.missing_ranks = list(missing_ranks)
+        self.arrived = list(arrived)
+        super().__init__(
+            f"barrier {name!r} timed out after {timeout:g}s: "
+            f"rank(s) {self.missing_ranks} never arrived "
+            f"(arrived: {self.arrived})")
 
 
 class TCPStore:
@@ -42,6 +59,7 @@ class TCPStore:
 
     # ------------------------------------------------------------- basic ops
     def set(self, key: str, value) -> None:
+        chaos.store_op("set", key)
         data = value.encode() if isinstance(value, str) else bytes(value)
         if self._lib.pt_store_set(self._client, key.encode(), data, len(data)) != 0:
             raise OSError(f"TCPStore.set({key!r}) failed")
@@ -50,6 +68,7 @@ class TCPStore:
         """Blocks until the key exists (reference Store::get semantics)."""
         import ctypes
 
+        chaos.store_op("get", key)
         out = ctypes.c_void_p()
         tmo = self.timeout_ms if timeout is None else int(timeout * 1000)
         n = self._lib.pt_store_get(self._client, key.encode(), ctypes.byref(out), tmo)
@@ -60,6 +79,7 @@ class TCPStore:
         return data
 
     def add(self, key: str, amount: int = 1) -> int:
+        chaos.store_op("add", key)
         r = self._lib.pt_store_add(self._client, key.encode(), amount)
         if r == -(2**63):
             raise OSError(f"TCPStore.add({key!r}) failed")
@@ -90,6 +110,31 @@ class TCPStore:
         if arrived == target:
             self.set(f"__barrier__/{name}/release/{round_}", b"1")
         self.get(f"__barrier__/{name}/release/{round_}", timeout=timeout)
+
+    def diagnostic_barrier(self, rank: int, name: str = "default",
+                           timeout: Optional[float] = None,
+                           poll: float = 0.05) -> None:
+        """Barrier with per-rank arrival keys: a timeout raises
+        BarrierTimeoutError naming exactly the ranks that never showed up
+        (vs ``barrier``'s counter, which can only say "someone").
+
+        Arrival keys persist in the store, so reuse needs a fresh ``name``
+        per synchronization point (e.g. suffix the step number).
+        """
+        self.set(f"__dbarrier__/{name}/arrived/{rank}", b"1")
+        tmo = self.timeout_ms / 1000.0 if timeout is None else timeout
+        deadline = time.monotonic() + tmo
+        missing = set(range(self.world_size))
+        while missing:
+            for r in sorted(missing):
+                try:
+                    self.get(f"__dbarrier__/{name}/arrived/{r}", timeout=poll)
+                    missing.discard(r)
+                except TimeoutError:
+                    pass
+            if missing and time.monotonic() >= deadline:
+                arrived = sorted(set(range(self.world_size)) - missing)
+                raise BarrierTimeoutError(name, sorted(missing), arrived, tmo)
 
     def _shutdown_server(self):
         if self._server:

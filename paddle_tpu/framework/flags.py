@@ -54,3 +54,17 @@ define_flag("FLAGS_flash_flat", False, "use the flat-lane (zero-relayout) flash 
 define_flag("FLAGS_eager_delete_tensor_gb", 0.0, "compat no-op: XLA/PJRT manages buffers")
 define_flag("FLAGS_allocator_strategy", "auto_growth", "compat no-op: PJRT BFC allocator is used")
 define_flag("FLAGS_remat_policy", "none", "default rematerialization policy for jit steps")
+
+# Fault-tolerance runtime (distributed/resilience.py).
+define_flag("FLAGS_collective_timeout_s", 0.0, "watchdog: report a cross-process collective still pending after this many seconds (0 = off)")
+
+# Deterministic fault injection (testing/chaos.py). All hooks are no-ops
+# unless FLAGS_chaos is on; each knob below selects one failure mode.
+define_flag("FLAGS_chaos", False, "master switch for deterministic fault injection")
+define_flag("FLAGS_chaos_crash_point", "", "named crash point to fire (e.g. 'checkpoint_save', 'train_step')")
+define_flag("FLAGS_chaos_crash_at_step", -1, "step index at which the crash point fires (-1: first hit)")
+define_flag("FLAGS_chaos_corrupt_ckpt", False, "flip bytes in the next published checkpoint (on-disk corruption)")
+define_flag("FLAGS_chaos_store_drop_ops", "", "comma list of store ops to fail, each 'op' or 'op:key-prefix'")
+define_flag("FLAGS_chaos_store_drop_count", -1, "fail only the first N matching store ops, then heal (-1: always)")
+define_flag("FLAGS_chaos_store_delay_s", 0.0, "sleep this long before every store op")
+define_flag("FLAGS_chaos_freeze_heartbeat", "", "comma list of elastic node ids whose heartbeat stops refreshing")

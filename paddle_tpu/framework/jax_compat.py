@@ -1,0 +1,71 @@
+"""Compatibility shims for the range of jax versions this repo runs on.
+
+The codebase targets the current jax API surface (``jax.shard_map``,
+``jax.export`` as eager attributes); older-but-supported releases (e.g.
+0.4.3x) ship the same functionality under ``jax.experimental`` or as a
+lazily-imported submodule. Importing this module (done once from
+``paddle_tpu.framework``) binds the modern names so every call site can use
+them unconditionally.
+"""
+from __future__ import annotations
+
+import jax
+
+if not hasattr(jax, "shard_map"):
+    try:
+        import functools
+        import inspect
+
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+        if "check_vma" in inspect.signature(_shard_map).parameters:
+            jax.shard_map = _shard_map
+        else:
+            # pre-graduation shard_map spells today's kwargs differently:
+            # check_vma was check_rep, and axis_names (axes the body is
+            # manual over) was auto (the complement: axes left automatic)
+            @functools.wraps(_shard_map)
+            def shard_map(*args, **kwargs):
+                if "check_vma" in kwargs:
+                    kwargs["check_rep"] = kwargs.pop("check_vma")
+                if "axis_names" in kwargs:
+                    manual = set(kwargs.pop("axis_names"))
+                    mesh = kwargs.get("mesh", args[1] if len(args) > 1 else None)
+                    if mesh is not None:
+                        # a size-1 axis is semantically identical manual or
+                        # auto; keeping it manual dodges the partial-auto
+                        # paths old shard_map never implemented
+                        auto = frozenset(n for n in mesh.axis_names
+                                         if n not in manual
+                                         and mesh.shape[n] > 1)
+                        if auto:
+                            kwargs["auto"] = auto
+                        else:
+                            kwargs.setdefault("check_rep", False)
+                return _shard_map(*args, **kwargs)
+
+            jax.shard_map = shard_map
+    except ImportError:  # pragma: no cover - very old jax; call sites raise
+        pass
+
+if not hasattr(jax.lax, "pcast"):
+    # modern varying-manual-axes annotation; on old jax there is no vma
+    # tracking (our shard_map shim disables check_rep when axes would need
+    # it), so the annotation is an identity
+    def _pcast(x, axis_name, *, to=None):
+        return x
+
+    jax.lax.pcast = _pcast
+
+# `jax.export` is a real submodule but only resolvable as an attribute once
+# imported; on versions where even that is absent, fall back to
+# jax.experimental.export (same API, pre-graduation home).
+try:
+    import jax.export  # noqa: F401
+except ImportError:  # pragma: no cover
+    try:
+        from jax.experimental import export as _export
+
+        jax.export = _export
+    except ImportError:
+        pass

@@ -41,7 +41,12 @@ _worker_info: WorkerInfo | None = None
 
 
 def get_worker_info():
-    """Inside a worker process: its WorkerInfo; in the main process: None."""
+    """Inside a process-mode worker: its WorkerInfo (with ``seed`` =
+    pool base_seed + worker id, reference worker.py semantics); in the
+    main process — and in thread-mode workers, which share the main
+    process — None. Process mode is the only mode that runs worker code
+    in a separate process, so it is the only mode with a worker-side
+    view to report."""
     return _worker_info
 
 
@@ -110,9 +115,10 @@ def _discard(obj):
 
 
 def _worker_loop(dataset, collate_fn, index_q, data_q, worker_id, num_workers,
-                 worker_init_fn, use_shm):
+                 worker_init_fn, use_shm, base_seed=0):
     global _worker_info
-    _worker_info = WorkerInfo(worker_id, num_workers, dataset)
+    _worker_info = WorkerInfo(worker_id, num_workers, dataset,
+                              seed=base_seed + worker_id)
     try:
         try:
             if worker_init_fn is not None:
@@ -139,9 +145,15 @@ def _worker_loop(dataset, collate_fn, index_q, data_q, worker_id, num_workers,
                 data_q.put((key, None,
                             f"{type(exc).__name__}: {exc}\n{traceback.format_exc()}"))
     except (KeyboardInterrupt, SystemExit):
-        pass
-    finally:
+        # interrupted: drop whatever the feeder still buffers — flushing
+        # could block forever on a parent that is itself dying
         data_q.cancel_join_thread()
+    else:
+        # graceful (sentinel) exit: flush buffered shm batches so the
+        # parent can drain and unlink them instead of leaking segments
+        data_q.close()
+        data_q.join_thread()
+    finally:
         os._exit(0)  # skip jax/XLA atexit hooks inherited through fork
 
 
@@ -149,8 +161,14 @@ class WorkerPool:
     """Persistent fork-based worker pool + ordered batch iteration."""
 
     def __init__(self, dataset, collate_fn, num_workers, worker_init_fn=None,
-                 use_shm=True, timeout=0, prefetch_factor=2):
+                 use_shm=True, timeout=0, prefetch_factor=2, base_seed=None):
         import multiprocessing as mp
+
+        if base_seed is None:
+            # one base per pool; worker i sees base_seed + i (reference
+            # worker.py derives per-worker seeds the same way)
+            base_seed = int.from_bytes(os.urandom(4), "little")
+        self.base_seed = base_seed
 
         ctx = mp.get_context("fork" if "fork" in mp.get_all_start_methods() else "spawn")
         self._ctx = ctx
@@ -176,7 +194,8 @@ class WorkerPool:
             p = ctx.Process(
                 target=_worker_loop,
                 args=(dataset, collate_fn, self._index_q, self._data_q,
-                      wid, num_workers, worker_init_fn, use_shm),
+                      wid, num_workers, worker_init_fn, use_shm,
+                      self.base_seed),
                 daemon=True)
             p.start()
             self._workers.append(p)

@@ -223,6 +223,24 @@ def concrete_true(flag):
     return bool(b) if b is not None else False
 
 
+def step_unless(brk, i, step):
+    """The for-range while-form's synthesized step, gated on the de-sugared
+    break flag: ``i`` unchanged once the break fired (Python leaves the
+    loop target at the break iteration), ``i + step`` otherwise. The gate
+    is on the break flag only — ``continue`` must still advance. Traced
+    flags select via ``where`` so the loop stays compilable."""
+    b = _concrete_bool(brk)
+    if b is not None:
+        return i if b else i + step
+    from ..tensor._helpers import ensure_tensor
+
+    i = ensure_tensor(i)
+    # arithmetic select (not `where`): every operand routes through the
+    # op layer, so it traces in both lax and static.nn subblocks
+    keep = 1 - ensure_tensor(brk).astype(i.dtype)
+    return i + ensure_tensor(step).astype(i.dtype) * keep
+
+
 def is_py(r):
     return r[0] == "py"
 
@@ -792,12 +810,14 @@ class _Transformer(ast.NodeTransformer):
             visited_guard = self.visit(guard_if)
             visited_guard = visited_guard if isinstance(visited_guard, list) else [visited_guard]
             # native early exit once the break flag is CONCRETELY true —
-            # restores Python's post-loop target value and skips dead
-            # iterations; a traced flag keeps unrolling behind the guards
+            # checked at the END of the iteration body, BEFORE the for
+            # statement rebinds the target, so the post-loop target equals
+            # Python's (the break iteration, not one past it); a traced
+            # flag keeps unrolling behind the guards
             early = ast.If(test=_jst_call("concrete_true", [_name(brk)]),
                            body=[ast.Break()], orelse=[])
             early._jst_skip = True
-            py_body = [early, _flag_assign(cont, False)] + visited_guard
+            py_body = [_flag_assign(cont, False)] + visited_guard + [early]
         else:
             py_body = copy.deepcopy(node.body)
         # python path: loop over the concrete range
@@ -806,8 +826,7 @@ class _Transformer(ast.NodeTransformer):
                           body=py_body, orelse=[])
         py_loop._jst_skip = True
         # traced-bounds path: while-form, rewritten through the while
-        # machinery; with break/continue the step stays UNguarded so
-        # `continue` still advances the loop variable
+        # machinery
         init = ast.Assign(targets=[_name(tgt, ast.Store())],
                           value=_jst_call("range_start", [_name(rname)]))
         step = ast.Assign(
@@ -816,6 +835,14 @@ class _Transformer(ast.NodeTransformer):
                             right=_jst_call("range_step", [_name(rname)])))
         test = _jst_call("range_cond", [_name(tgt), _name(rname)])
         if has_bc:
+            # the synthesized step is gated on the break flag (step_unless)
+            # so the target is not advanced past the break; `continue`
+            # still advances — the gate ignores the continue flag
+            step = ast.Assign(
+                targets=[_name(tgt, ast.Store())],
+                value=_jst_call("step_unless", [
+                    _name(brk), _name(tgt),
+                    _jst_call("range_step", [_name(rname)])]))
             wl_body = [_flag_assign(cont, False)] + copy.deepcopy(guarded)
             test = ast.BoolOp(op=ast.And(), values=[
                 ast.UnaryOp(op=ast.Not(), operand=_name(brk)), test])

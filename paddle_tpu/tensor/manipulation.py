@@ -407,7 +407,8 @@ def multiplex(inputs, index, name=None):
         stacked = jnp.stack(tensors, axis=0)  # [n, batch, ...]
         sel = ix.reshape(-1).astype(jnp.int32)
         return jnp.take_along_axis(
-            stacked, sel[None, :, *(None,) * (stacked.ndim - 2)], axis=0)[0]
+            stacked, sel.reshape((1, -1) + (1,) * (stacked.ndim - 2)),
+            axis=0)[0]
 
     return op(fn, idx, *ins, _name="multiplex")
 

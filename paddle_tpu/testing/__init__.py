@@ -1,0 +1,3 @@
+"""paddle_tpu.testing — test-support utilities (deterministic fault
+injection lives in ``testing.chaos``)."""
+from . import chaos  # noqa: F401

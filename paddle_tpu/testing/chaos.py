@@ -1,0 +1,125 @@
+"""Deterministic fault injection for the fault-tolerance runtime.
+
+Production code calls the hooks below at its failure seams (checkpoint
+publish, TCPStore ops, elastic heartbeats, the supervised train loop).
+With ``FLAGS_chaos`` off — the default — every hook is a single dict
+lookup; tests turn individual failure modes on through flags (or the
+``inject`` context manager) and get the *same* failure on every run:
+no randomness, no timing races.
+
+Injection points:
+
+- **crash-at-step**: ``crash_if_due(point, step)`` raises ``ChaosCrash``
+  when ``FLAGS_chaos_crash_point`` matches and ``FLAGS_chaos_crash_at_step``
+  is the current step (-1 = first hit). Each (point, step) fires at most
+  once per process, so a supervisor that restarts the step can make
+  progress — exactly the preempted-worker shape.
+- **corrupt-checkpoint-on-disk**: ``corrupt_due()`` tells the
+  CheckpointManager to flip bytes in the checkpoint it just published.
+- **drop/delay store ops**: ``store_op(op, key)`` raises ``ChaosError``
+  for ops matching ``FLAGS_chaos_store_drop_ops`` ('op' or
+  'op:key-prefix' specs), healing after ``FLAGS_chaos_store_drop_count``
+  failures; ``FLAGS_chaos_store_delay_s`` adds latency to every op.
+- **freeze heartbeat**: ``heartbeat_frozen(node_id)`` silences an
+  ElasticNode's refresh thread — the node stays up but looks dead to
+  the membership view (a zombie/partitioned host).
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+
+from ..framework.flags import flag, get_flags, set_flags
+
+
+class ChaosError(OSError):
+    """Injected transient store failure (an OSError so production retry
+    paths treat it exactly like a real socket error)."""
+
+
+class ChaosCrash(RuntimeError):
+    """Injected process death. Raised (not os._exit) so single-process
+    tests can drive multi-process recovery protocols end-to-end."""
+
+
+_fired: set = set()  # (point, step) crash points that already fired
+_dropped: dict = {}  # drop spec -> count of failures injected so far
+
+
+def reset():
+    """Forget fired crash points and drop counters (fresh experiment)."""
+    _fired.clear()
+    _dropped.clear()
+
+
+def enabled() -> bool:
+    return bool(flag("FLAGS_chaos"))
+
+
+def crash_if_due(point: str, step=None):
+    """Raise ChaosCrash when ``point`` is armed (at most once per
+    (point, step) per process)."""
+    if not enabled() or flag("FLAGS_chaos_crash_point") != point:
+        return
+    at = flag("FLAGS_chaos_crash_at_step")
+    if at >= 0 and step is not None and step != at:
+        return
+    # keyed by the ARMED step (not the current one) so '-1: first hit'
+    # fires exactly once per point instead of once per visited step
+    key = (point, at if at >= 0 else None)
+    if key in _fired:
+        return
+    _fired.add(key)
+    raise ChaosCrash(f"chaos: injected crash at point {point!r} step {step}")
+
+
+def corrupt_due() -> bool:
+    return enabled() and bool(flag("FLAGS_chaos_corrupt_ckpt"))
+
+
+def store_op(op: str, key: str):
+    """Called by TCPStore before each wire op; may delay or fail it."""
+    if not enabled():
+        return
+    delay = flag("FLAGS_chaos_store_delay_s")
+    if delay > 0:
+        time.sleep(delay)
+    specs = [s for s in flag("FLAGS_chaos_store_drop_ops").split(",") if s]
+    limit = flag("FLAGS_chaos_store_drop_count")
+    for spec in specs:
+        sop, _, prefix = spec.partition(":")
+        if sop != op or (prefix and not key.startswith(prefix)):
+            continue
+        n = _dropped.get(spec, 0)
+        if limit >= 0 and n >= limit:
+            return  # healed: budget of injected failures spent
+        _dropped[spec] = n + 1
+        raise ChaosError(f"chaos: dropped store op {op}({key!r}) "
+                         f"[{n + 1}{'/' + str(limit) if limit >= 0 else ''}]")
+
+
+def heartbeat_frozen(node_id) -> bool:
+    if not enabled():
+        return False
+    frozen = flag("FLAGS_chaos_freeze_heartbeat")
+    return frozen != "" and str(node_id) in frozen.split(",")
+
+
+@contextlib.contextmanager
+def inject(**overrides):
+    """Temporarily set chaos flags (FLAGS_chaos is implied on), e.g.::
+
+        with chaos.inject(FLAGS_chaos_store_drop_ops="get"):
+            ...
+
+    Restores previous flag values and resets counters on exit.
+    """
+    overrides.setdefault("FLAGS_chaos", True)
+    prev = get_flags(list(overrides))
+    reset()
+    set_flags(overrides)
+    try:
+        yield
+    finally:
+        set_flags(prev)
+        reset()

@@ -60,10 +60,13 @@ def test_boolop_short_circuit_preserved():
 def test_unsupported_shapes_left_untouched():
     def f(x):
         if x > 0:
-            return 1  # return in branch: not rewritten
+            return 1  # return in branch: rewritten by _desugar_returns
         return 2
 
     g = transpile(f)
+    # the return transform applies (flag + continuation form) and must
+    # preserve values exactly
+    assert getattr(g, "_jst_transpiled", False)
     assert g(3) == 1 and g(-3) == 2
 
     def h(x):
@@ -503,6 +506,50 @@ def test_return_inside_loop_left_native():
 
     g = transpile(f)
     np.testing.assert_allclose(_np(g(paddle.to_tensor(np.array([1.0], np.float32)), 5)), [3.0])
+
+
+def test_break_leaves_for_range_target_at_python_value():
+    """Regression: the concrete-break check must fire BEFORE the for
+    statement rebinds the target (and the while-form's synthesized step
+    must be gated on the break flag), so the post-loop target equals
+    Python's — the break iteration, not one past it."""
+    def f(n):
+        for i in range(n):
+            if i == 3:
+                break
+        return i
+
+    g = transpile(f)
+    assert getattr(g, "_jst_transpiled", False)
+    assert g(10) == f(10) == 3
+
+    # data-dependent (tensor) break predicate, concrete bounds
+    def h(x, n):
+        s = x * 0
+        for i in range(n):
+            s = s + x
+            if s.sum() >= 3:
+                break
+        return s, i
+
+    gh = transpile(h)
+    x = paddle.to_tensor(np.array([1.0], np.float32))
+    fs, fi = h(x, 100)
+    gs, gi = gh(x, 100)
+    np.testing.assert_allclose(_np(gs), _np(fs))
+    assert gi == fi == 2
+
+    # genuinely traced bound: the while-form through lax.while_loop must
+    # leave the carried target at the break iteration too
+    import jax
+
+    def run(xv, nv):
+        s, i = gh(paddle.to_tensor(xv), paddle.to_tensor(nv))
+        return s._value, paddle.to_tensor(i)._value
+
+    s_val, i_val = jax.jit(run)(np.array([1.0], np.float32), np.int32(100))
+    np.testing.assert_allclose(np.asarray(s_val), [3.0])
+    assert int(np.asarray(i_val)) == 2
 
 
 def test_break_loop_is_differentiable_with_concrete_bounds():
