@@ -1,0 +1,64 @@
+"""paddle_tpu.analysis — static analysis over the two IRs the framework
+records.
+
+Paddle parity: the L5 IR pass layer (paddle/fluid/framework/ir, ~190 graph
+passes) and the inference analyzer (inference/analysis/analyzer.cc) inspect
+and validate the ProgramDesc before the Executor/AnalysisPredictor run it.
+The optimizing passes are XLA's job in this design; this package keeps the
+*diagnostic* half, over both IRs we already have:
+
+- the recorded :class:`~paddle_tpu.framework.static_trace.Program` — a
+  def-use graph (:mod:`analysis.graph`) feeding registered passes
+  (:mod:`analysis.passes`) that emit stable ``PTA0xx`` diagnostics, and
+- the Python AST dy2static transpiles — a pre-flight linter
+  (:mod:`analysis.ast_lint`, ``PTA1xx``) that points at unsupported
+  constructs with file:line before any tracer error can occur.
+
+Entry points:
+  ``Program.analyze(fetch_list)``          — run the IR passes
+  ``Executor.run`` under ``FLAGS_static_check`` — auto-check per new program
+  ``paddle.jit.to_static(fn, lint=True)``  — pre-flight AST lint
+  ``python -m paddle_tpu.analysis <target>`` — CLI over files/modules/dirs
+"""
+from __future__ import annotations
+
+from .ast_lint import (
+    lint_file,
+    lint_function,
+    lint_module,
+    lint_path,
+    lint_source,
+)
+from .diagnostics import (
+    SEVERITIES,
+    Diagnostic,
+    ProgramAnalysisError,
+    format_report,
+    max_severity,
+)
+from .graph import RESERVED_FEEDS, DefUseGraph
+from .passes import (
+    AnalysisContext,
+    analyze_program,
+    register_pass,
+    registered_passes,
+)
+
+__all__ = [
+    "AnalysisContext",
+    "DefUseGraph",
+    "Diagnostic",
+    "ProgramAnalysisError",
+    "RESERVED_FEEDS",
+    "SEVERITIES",
+    "analyze_program",
+    "format_report",
+    "lint_file",
+    "lint_function",
+    "lint_module",
+    "lint_path",
+    "lint_source",
+    "max_severity",
+    "register_pass",
+    "registered_passes",
+]

@@ -1,0 +1,65 @@
+"""CLI: ``python -m paddle_tpu.analysis <module-or-script-or-dir> ...``
+
+Runs the dy2static pre-flight linter over the targets' Python source
+(no target code is imported or executed — modules resolve via find_spec).
+Exit status: 0 clean / warnings only, 1 when error-severity diagnostics are
+found (or any finding under ``--strict``), 2 on usage errors.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List
+
+from .ast_lint import lint_path
+from .diagnostics import SEVERITIES, Diagnostic
+
+
+def main(argv: List[str] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.analysis",
+        description="dy2static pre-flight lint over scripts, packages or "
+                    "dotted module names (source-only; nothing is executed)")
+    parser.add_argument("targets", nargs="+",
+                        help=".py file, directory, or dotted module name "
+                             "(e.g. examples/train_gpt.py, paddle_tpu.models.gpt)")
+    parser.add_argument("--strict", action="store_true",
+                        help="exit 1 on any diagnostic, not just errors")
+    parser.add_argument("--min-severity", choices=SEVERITIES, default="info",
+                        help="hide diagnostics below this level")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="emit diagnostics as a JSON array")
+    args = parser.parse_args(argv)
+
+    diags: List[Diagnostic] = []
+    for target in args.targets:
+        try:
+            diags.extend(lint_path(target))
+        except (OSError, ValueError) as e:
+            print(f"error: {target}: {e}", file=sys.stderr)
+            return 2
+
+    floor = SEVERITIES.index(args.min_severity)
+    shown = [d for d in diags if SEVERITIES.index(d.severity) >= floor]
+    if args.as_json:
+        print(json.dumps([{
+            "code": d.code, "severity": d.severity, "message": d.message,
+            "hint": d.hint, "file": d.file, "line": d.line, "col": d.col,
+        } for d in shown], indent=2))
+    else:
+        for d in shown:
+            print(d)
+        counts = {s: sum(1 for d in diags if d.severity == s) for s in SEVERITIES}
+        summary = ", ".join(f"{n} {s}" for s, n in counts.items() if n) or "clean"
+        print(f"checked {len(args.targets)} target(s): {summary}")
+
+    if any(d.severity == "error" for d in diags):
+        return 1
+    if args.strict and diags:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

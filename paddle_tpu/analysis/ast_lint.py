@@ -1,0 +1,246 @@
+"""dy2static pre-flight linter: find the constructs the AST transpiler
+documents as unsupported *before* tracing, with source line numbers.
+
+Reference: the dygraph_to_static transformers
+(python/paddle/fluid/dygraph/dygraph_to_static/*) silently leave unsupported
+shapes untouched; when the offending condition is traced, the failure
+surfaces deep inside JAX as ``TracerBoolConversionError`` with no pointer to
+the user's line. This linter walks the Python AST (the same envelope checks
+jit/dy2static.py applies while rewriting — its mutating-call tables are
+imported, single source of truth) and reports each hazard as a ``PTA1xx``
+:class:`Diagnostic` carrying file:line.
+
+Codes:
+  PTA100 syntax error (source does not parse)          [error]
+  PTA101 return inside a loop                          [warning]
+  PTA102 tuple-target for loop                         [warning]
+  PTA103 break/continue inside try/with                [warning]
+  PTA104 in-place mutation inside a conditional block  [warning]
+  PTA105 side effect under trace (print/global store)  [info]
+
+All of these run fine natively; they break only when the governing condition
+or loop bound is a traced tensor — which is exactly when dy2static would have
+needed to rewrite them and could not.
+"""
+from __future__ import annotations
+
+import ast
+import importlib.util
+import inspect
+import os
+import textwrap
+from typing import List, Optional
+
+from .diagnostics import Diagnostic
+
+
+def _mutating_tables():
+    """dy2static's in-place-call envelope (lazy: keeps import order loose)."""
+    from ..jit.dy2static import MUTATING_METHODS, is_inplace_call
+
+    return MUTATING_METHODS, is_inplace_call
+
+
+class _FunctionLinter:
+    """Lints ONE function body. Nested defs/lambdas/classes are separate
+    scopes (dy2static treats them so) and are linted on their own."""
+
+    def __init__(self, diags: List[Diagnostic], filename: str, offset: int):
+        self.diags = diags
+        self.filename = filename
+        self.offset = offset
+        self.mutating, self.is_inplace_call = _mutating_tables()
+
+    def emit(self, code, severity, node, message, hint=""):
+        self.diags.append(Diagnostic(
+            code, severity, message, hint=hint, file=self.filename,
+            line=(node.lineno + self.offset) if hasattr(node, "lineno") else None,
+            col=getattr(node, "col_offset", None)))
+
+    def lint(self, fdef):
+        for stmt in fdef.body:
+            self._walk(stmt, loop=0, trywith=0, branch=0)
+
+    # ------------------------------------------------------------- walking
+    def _walk(self, node, loop: int, trywith: int, branch: int):
+        """loop: enclosing loop count; trywith: try/with blocks entered
+        *inside the innermost loop*; branch: enclosing If/While/For count."""
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            return  # separate scope
+        if isinstance(node, ast.Return):
+            if loop:
+                self.emit(
+                    "PTA101", "warning", node,
+                    "return inside a loop: dy2static cannot rewrite it; a "
+                    "traced loop bound/condition dies as "
+                    "TracerBoolConversionError here",
+                    hint="assign to a result variable, break, and return "
+                         "after the loop")
+        elif isinstance(node, (ast.Break, ast.Continue)):
+            if loop and trywith:
+                kw = "break" if isinstance(node, ast.Break) else "continue"
+                self.emit(
+                    "PTA103", "warning", node,
+                    f"{kw} inside try/with: dy2static refuses to relocate it "
+                    "out of the handler block, so the loop is left unrewritten",
+                    hint=f"move the {kw} out of the try/with (set a flag "
+                         "inside, test it after)")
+        elif isinstance(node, ast.For):
+            if isinstance(node.target, (ast.Tuple, ast.List)):
+                self.emit(
+                    "PTA102", "warning", node,
+                    "tuple-target for loop: dy2static only rewrites "
+                    "`for <name> in range(...)`; traced iterables here fail "
+                    "at trace time",
+                    hint="iterate an index over range(len(...)) and unpack "
+                         "inside the body")
+            self._stmt_exprs(node.iter, branch)
+            self._walk_block(node.body + node.orelse, loop + 1, 0, branch + 1,
+                             node)
+            return
+        elif isinstance(node, (ast.While, ast.AsyncFor)):
+            if isinstance(node, ast.While):
+                self._stmt_exprs(node.test, branch)
+            self._walk_block(node.body + node.orelse, loop + 1, 0, branch + 1,
+                             node)
+            return
+        elif isinstance(node, ast.If):
+            self._stmt_exprs(node.test, branch)
+            self._walk_block(node.body + node.orelse, loop, trywith, branch + 1,
+                             node)
+            return
+        elif isinstance(node, ast.Try):
+            blocks = node.body + node.orelse + node.finalbody
+            for h in node.handlers:
+                blocks += h.body
+            self._walk_block(blocks, loop, trywith + 1, branch, node)
+            return
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            self._walk_block(node.body, loop, trywith + 1, branch, node)
+            return
+        elif isinstance(node, ast.Global):
+            self.emit(
+                "PTA105", "info", node,
+                f"global store ({', '.join(node.names)}): runs once at trace "
+                "time, not per execution of the compiled program",
+                hint="return the value instead of writing a global")
+
+        # statement-level expression scanning (mutations, prints); compound
+        # statements not special-cased above (e.g. match) recurse instead so
+        # nothing is scanned twice
+        children = list(ast.iter_child_nodes(node))
+        if any(isinstance(c, ast.stmt) for c in children):
+            for child in children:
+                if isinstance(child, ast.stmt):
+                    self._walk(child, loop, trywith, branch)
+        else:
+            self._stmt_exprs(node, branch)
+
+    def _walk_block(self, stmts, loop, trywith, branch, parent):
+        for s in stmts:
+            self._walk(s, loop, trywith, branch)
+
+    # ------------------------------------------------- expression hazards
+    def _stmt_exprs(self, node, branch: int):
+        for sub in ast.walk(node):
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.ClassDef, ast.Lambda)):
+                continue
+            if branch and isinstance(sub, (ast.Subscript, ast.Attribute)) \
+                    and isinstance(sub.ctx, ast.Store):
+                kind = "subscript" if isinstance(sub, ast.Subscript) else "attribute"
+                self.emit(
+                    "PTA104", "warning", sub,
+                    f"in-place {kind} store inside a conditional block: under "
+                    "a traced predicate both branches execute at trace time, "
+                    "so the mutation applies even when the branch is not taken",
+                    hint="rebind a fresh value and merge it through the "
+                         "branch outputs instead of mutating")
+            elif branch and isinstance(sub, ast.Call) and self.is_inplace_call(sub):
+                self.emit(
+                    "PTA104", "warning", sub,
+                    f"in-place call .{sub.func.attr}() inside a conditional "
+                    "block: silently applied for the untaken branch when the "
+                    "predicate is traced",
+                    hint="use the out-of-place form and merge the result")
+            elif (branch and isinstance(sub, ast.Expr)
+                  and isinstance(sub.value, ast.Call)
+                  and isinstance(sub.value.func, ast.Attribute)
+                  and sub.value.func.attr in self.mutating):
+                self.emit(
+                    "PTA104", "warning", sub,
+                    f"mutating call .{sub.value.func.attr}() inside a "
+                    "conditional block: dy2static refuses to trace the "
+                    "branch, and the mutation is wrong if it does trace",
+                    hint="collect into a new container and merge it through "
+                         "the branch outputs")
+            elif (isinstance(sub, ast.Call) and isinstance(sub.func, ast.Name)
+                  and sub.func.id == "print"):
+                self.emit(
+                    "PTA105", "info", sub,
+                    "print() under trace runs once at trace time with "
+                    "abstract values, not per execution",
+                    hint="use paddle_tpu debugging hooks or fetch the value "
+                         "and print outside the traced function")
+
+
+# ------------------------------------------------------------------ frontends
+def lint_source(src: str, filename: str = "<source>", offset: int = 0) -> List[Diagnostic]:
+    """Lint every function defined in ``src``; module-level code is skipped
+    (it runs on the host exactly once and is never traced)."""
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [Diagnostic("PTA100", "error", f"source does not parse: {e.msg}",
+                           file=filename, line=(e.lineno or 0) + offset,
+                           col=e.offset)]
+    diags: List[Diagnostic] = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            _FunctionLinter(diags, filename, offset).lint(node)
+    diags.sort(key=lambda d: (d.line or 0, d.col or 0, d.code))
+    return diags
+
+
+def lint_function(fn) -> List[Diagnostic]:
+    """Lint one Python function (the ``to_static(lint=True)`` entry point).
+    Reported line numbers match the function's defining file."""
+    fn = inspect.unwrap(fn)
+    fn = getattr(fn, "__func__", fn)  # bound method -> function
+    code = getattr(fn, "__code__", None)
+    if code is None:
+        return []
+    lines, start = inspect.getsourcelines(fn)
+    src = textwrap.dedent("".join(lines))
+    return lint_source(src, filename=code.co_filename or "<dy2static>",
+                       offset=start - 1)
+
+
+def lint_file(path: str) -> List[Diagnostic]:
+    with open(path, "r", encoding="utf-8") as f:
+        return lint_source(f.read(), filename=path)
+
+
+def lint_module(name: str) -> List[Diagnostic]:
+    """Lint a module by dotted name WITHOUT importing (find_spec only)."""
+    try:
+        spec = importlib.util.find_spec(name)
+    except (ImportError, ValueError):  # missing parent package etc.
+        spec = None
+    if spec is None or not spec.origin or not spec.origin.endswith(".py"):
+        raise ValueError(f"cannot locate Python source for module {name!r}")
+    return lint_file(spec.origin)
+
+
+def lint_path(target: str) -> List[Diagnostic]:
+    """Lint a .py file, every .py under a directory, or a dotted module."""
+    if os.path.isdir(target):
+        diags: List[Diagnostic] = []
+        for root, _dirs, files in os.walk(target):
+            for f in sorted(files):
+                if f.endswith(".py"):
+                    diags.extend(lint_file(os.path.join(root, f)))
+        return diags
+    if os.path.isfile(target) or target.endswith(".py"):
+        return lint_file(target)
+    return lint_module(target)

@@ -1,0 +1,87 @@
+"""Diagnostic model shared by the Program IR passes and the dy2static linter.
+
+Paddle parity: the reference's IR pass framework reports through
+``paddle/fluid/framework/ir/pass.h`` + the inference analyzer's
+``argument/analysis_passes``; error text there is free-form C++ ``LOG``
+output. Here every finding is a structured :class:`Diagnostic` with a stable
+``PTA`` code so tests, CI gates and editors can match on it.
+
+Code space:
+  PTA0xx — Program IR passes (paddle_tpu.analysis.passes)
+  PTA1xx — dy2static pre-flight AST lint (paddle_tpu.analysis.ast_lint)
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence
+
+#: severity order, least to most severe
+SEVERITIES = ("info", "warning", "error")
+
+
+@dataclass
+class Diagnostic:
+    """One analysis finding: stable code, severity, location and a fix hint."""
+
+    code: str                       # stable id, e.g. "PTA001"
+    severity: str                   # "info" | "warning" | "error"
+    message: str
+    hint: str = ""
+    op: Optional[str] = None        # recorded Op name (IR passes)
+    var: Optional[str] = None       # SymbolicValue / feed name (IR passes)
+    file: Optional[str] = None      # source file (AST lint)
+    line: Optional[int] = None      # 1-based source line (AST lint)
+    col: Optional[int] = None
+
+    def __post_init__(self):
+        if self.severity not in SEVERITIES:
+            raise ValueError(f"unknown severity {self.severity!r}")
+
+    @property
+    def location(self) -> str:
+        if self.file is not None:
+            pos = f"{self.file}:{self.line}" if self.line is not None else self.file
+            return pos if self.col is None else f"{pos}:{self.col}"
+        parts = []
+        if self.op:
+            parts.append(f"op '{self.op}'")
+        if self.var:
+            parts.append(f"var '{self.var}'")
+        return ", ".join(parts)
+
+    def __str__(self):
+        loc = self.location
+        head = f"{self.code} [{self.severity}]"
+        body = f"{loc}: {self.message}" if loc else self.message
+        return f"{head} {body}" + (f" (hint: {self.hint})" if self.hint else "")
+
+
+def max_severity(diagnostics: Sequence[Diagnostic]) -> Optional[str]:
+    """Most severe level present, or None for an empty list."""
+    worst = -1
+    for d in diagnostics:
+        worst = max(worst, SEVERITIES.index(d.severity))
+    return SEVERITIES[worst] if worst >= 0 else None
+
+
+def format_report(diagnostics: Sequence[Diagnostic]) -> str:
+    """Human-readable multi-line report (one diagnostic per line + summary)."""
+    if not diagnostics:
+        return "no diagnostics"
+    lines = [str(d) for d in diagnostics]
+    counts = {s: sum(1 for d in diagnostics if d.severity == s) for s in SEVERITIES}
+    summary = ", ".join(f"{n} {s}{'s' if n != 1 else ''}"
+                        for s, n in counts.items() if n)
+    lines.append(f"-- {len(diagnostics)} diagnostic(s): {summary}")
+    return "\n".join(lines)
+
+
+class ProgramAnalysisError(RuntimeError):
+    """Raised (under ``FLAGS_static_check``) when error-severity diagnostics
+    are found before a program compiles."""
+
+    def __init__(self, diagnostics: Sequence[Diagnostic]):
+        self.diagnostics = list(diagnostics)
+        super().__init__(
+            "static analysis found error-severity diagnostics:\n"
+            + format_report(self.diagnostics))

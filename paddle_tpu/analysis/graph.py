@@ -1,0 +1,96 @@
+"""Def-use dataflow graph over a recorded :class:`Program`.
+
+Paddle parity: ``paddle/fluid/framework/ir/graph.h`` builds an SSA graph
+(var nodes + op nodes) from the ProgramDesc for the ~190 IR passes. Here the
+Program is already SSA — every ``SymbolicValue`` has exactly one producing
+``Op`` (or is a feed), so the graph is two dicts keyed by value name plus
+derived liveness/reachability queries. Passes (analysis/passes.py) consume
+this instead of re-walking ``program.ops``.
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Sequence, Set
+
+#: feeds the runtime injects itself (Executor.run); never user-fed, never
+#: reported as unused, excluded from dtype lint (uint32 plumbing).
+RESERVED_FEEDS = ("__rng_key__", "__train_flag__")
+
+
+class DefUseGraph:
+    """Producer/consumer maps + liveness over one Program.
+
+    - ``producers[name]`` -> index of the op producing value ``name``
+    - ``consumers[name]`` -> indices of ops reading value ``name``
+    - feeds appear only in ``consumers`` (no producing op)
+    """
+
+    def __init__(self, program):
+        self.program = program
+        self.ops = list(program.ops)
+        self.producers: Dict[str, int] = {}
+        self.consumers: Dict[str, List[int]] = {}
+        for i, op in enumerate(self.ops):
+            for sv in op.outputs:
+                self.producers[sv.name] = i
+            for kind, ref in op.inputs:
+                if kind == "sym":
+                    self.consumers.setdefault(ref.name, []).append(i)
+
+    # ------------------------------------------------------------- queries
+    def sink_ops(self) -> List[int]:
+        """Ops none of whose outputs are read by another op."""
+        return [i for i, op in enumerate(self.ops)
+                if not any(sv.name in self.consumers for sv in op.outputs)]
+
+    def root_names(self, fetch: Optional[Iterable[str]] = None) -> Set[str]:
+        """Value names that must stay live: explicit fetch targets (or, when
+        none are given, every sink output), plus the loss, named grads and
+        deferred buffer writes the Executor commits after each run."""
+        p = self.program
+        roots: Set[str] = set()
+        if fetch is not None:
+            roots.update(fetch)
+        else:
+            for i in self.sink_ops():
+                roots.update(sv.name for sv in self.ops[i].outputs)
+        if getattr(p, "loss_var", None) is not None:
+            roots.add(p.loss_var.name)
+        roots.update(sv.name for sv in getattr(p, "grad_vars", {}).values())
+        roots.update(sym.name for _, sym in getattr(p, "buffer_writes", []))
+        return roots
+
+    def live_ops(self, fetch: Optional[Iterable[str]] = None) -> Set[int]:
+        """Indices of ops reachable (via def-use edges, walking backward)
+        from the root set — the ops the Executor actually needs to run."""
+        live: Set[int] = set()
+        stack = [self.producers[n] for n in self.root_names(fetch)
+                 if n in self.producers]
+        while stack:
+            i = stack.pop()
+            if i in live:
+                continue
+            live.add(i)
+            for kind, ref in self.ops[i].inputs:
+                if kind == "sym" and ref.name in self.producers:
+                    stack.append(self.producers[ref.name])
+        return live
+
+    def live_values(self, fetch: Optional[Iterable[str]] = None) -> Set[str]:
+        """Names of feeds and op outputs read by any live op, plus the roots."""
+        names = set(self.root_names(fetch))
+        for i in self.live_ops(fetch):
+            for kind, ref in self.ops[i].inputs:
+                if kind == "sym":
+                    names.add(ref.name)
+        return names
+
+    def unused_feeds(self) -> List[str]:
+        """User feeds no op ever reads (reserved runtime feeds excluded)."""
+        return [n for n in self.program.feeds
+                if n not in RESERVED_FEEDS and n not in self.consumers]
+
+    def consumers_of(self, name: str) -> List[int]:
+        return list(self.consumers.get(name, ()))
+
+    def producer_of(self, name: str) -> Optional[int]:
+        return self.producers.get(name)

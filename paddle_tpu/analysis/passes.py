@@ -1,0 +1,385 @@
+"""Analysis passes over the Program IR, each emitting stable ``PTA0xx``
+diagnostics.
+
+Paddle parity: the reference feeds every ProgramDesc through an IR pass
+framework (~190 graph passes, paddle/fluid/framework/ir/*) before the
+Executor / AnalysisPredictor touch it. The optimizing passes are XLA's job
+here; what this module keeps is the *diagnostic* half — the checks that catch
+a wrong or wasteful graph before it compiles, with op/var names attached
+instead of a runtime JAX traceback.
+
+Registered passes (see README "Static analysis" for the full table):
+  PTA001 dead op                 PTA005 baked dynamic dim (error)
+  PTA002 unused feed             PTA006 duplicate computation (CSE)
+  PTA003 implicit dtype promotion PTA007 oversized closed-over constant
+  PTA004 f16/bf16 reduction (AMP hazard)
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+
+from .diagnostics import Diagnostic
+from .graph import RESERVED_FEEDS, DefUseGraph
+
+
+class AnalysisContext:
+    """Per-run knobs the passes read."""
+
+    def __init__(self, fetch: Optional[List[str]] = None,
+                 const_capture_threshold: int = 1 << 16):
+        self.fetch = fetch
+        # elements above which a closed-over constant is reported (PTA007);
+        # 65536 f32 elements = 256 KiB baked into every compiled executable
+        self.const_capture_threshold = const_capture_threshold
+
+
+PassFn = Callable[[Any, DefUseGraph, AnalysisContext], Iterable[Diagnostic]]
+_REGISTRY: Dict[str, Tuple[str, PassFn]] = {}
+
+
+def register_pass(code: str, name: str):
+    """Register an analysis pass under a stable diagnostic code."""
+
+    def deco(fn: PassFn) -> PassFn:
+        if code in _REGISTRY:
+            raise ValueError(f"duplicate analysis pass code {code}")
+        _REGISTRY[code] = (name, fn)
+        return fn
+
+    return deco
+
+
+def registered_passes() -> Dict[str, str]:
+    """code -> pass name, in registration order."""
+    return {code: name for code, (name, _) in _REGISTRY.items()}
+
+
+def _fetch_names(fetch) -> Optional[List[str]]:
+    """Normalize a fetch list of Tensors / SymbolicValues / names."""
+    if fetch is None:
+        return None
+    if not isinstance(fetch, (list, tuple, set)):
+        fetch = [fetch]
+    names = []
+    for f in fetch:
+        if isinstance(f, str):
+            names.append(f)
+            continue
+        v = getattr(f, "_value", f)        # Tensor -> SymbolicValue
+        name = getattr(v, "name", None)
+        if name:
+            names.append(name)
+    return names
+
+
+def analyze_program(program, fetch=None, passes: Optional[Iterable[str]] = None,
+                    const_capture_threshold: int = 1 << 16) -> List[Diagnostic]:
+    """Run the registered passes over ``program``; returns all diagnostics.
+
+    ``fetch`` (names, Tensors or SymbolicValues) anchors liveness — without
+    it every sink op counts as a result and the dead-op pass stays silent.
+    ``passes`` restricts to a subset of codes.
+    """
+    ctx = AnalysisContext(fetch=_fetch_names(fetch),
+                          const_capture_threshold=const_capture_threshold)
+    graph = DefUseGraph(program)
+    out: List[Diagnostic] = []
+    for code, (name, fn) in _REGISTRY.items():
+        if passes is not None and code not in passes:
+            continue
+        out.extend(fn(program, graph, ctx))
+    return out
+
+
+# --------------------------------------------------------------- dtype utils
+def _np_dtype(dt):
+    try:
+        return np.dtype(dt)
+    except TypeError:
+        return None  # jax extended dtypes (PRNG keys) — not lintable
+
+
+def _is_float(dt) -> bool:
+    dt = _np_dtype(dt)
+    return dt is not None and np.issubdtype(dt, np.floating)
+
+
+def _is_int(dt) -> bool:
+    dt = _np_dtype(dt)
+    return (dt is not None and np.issubdtype(dt, np.integer)
+            and dt != np.dtype(bool))
+
+
+def _is_half(dt) -> bool:
+    dt = _np_dtype(dt)
+    if dt is None:
+        return False
+    return dt == np.float16 or dt.name == "bfloat16"
+
+
+def _input_dtypes(op):
+    """(dtype, description) per array-ish input; reserved runtime feeds and
+    python scalars (weak-typed under JAX) are skipped."""
+    out = []
+    for kind, ref in op.inputs:
+        if kind == "sym":
+            if ref.name in RESERVED_FEEDS:
+                continue
+            out.append((ref.dtype, ref.name))
+        elif kind == "tensor":
+            v = getattr(ref, "_value", None)
+            if v is not None and hasattr(v, "dtype"):
+                out.append((v.dtype, getattr(ref, "name", None) or "tensor"))
+        else:  # const: only concrete arrays carry a committed dtype
+            if hasattr(ref, "dtype") and hasattr(ref, "shape"):
+                out.append((ref.dtype, "const"))
+    return out
+
+
+# -------------------------------------------------------------------- passes
+@register_pass("PTA001", "dead-op")
+def _dead_op_pass(program, graph: DefUseGraph, ctx: AnalysisContext):
+    """Ops not reachable from the fetch targets (needs an explicit fetch
+    list to be meaningful — every sink is a root otherwise)."""
+    live = graph.live_ops(ctx.fetch)
+    for i, op in enumerate(graph.ops):
+        if i in live:
+            continue
+        outs = ", ".join(sv.name for sv in op.outputs)
+        yield Diagnostic(
+            "PTA001", "warning",
+            f"op #{i} is not reachable from the fetch targets "
+            f"(outputs: {outs}); the Executor still traces and compiles it",
+            hint="drop the dead call at build time, or add its output to "
+                 "fetch_list if it was meant as a result",
+            op=op.name, var=op.outputs[0].name if op.outputs else None)
+
+
+@register_pass("PTA002", "unused-feed")
+def _unused_feed_pass(program, graph: DefUseGraph, ctx: AnalysisContext):
+    for name in graph.unused_feeds():
+        yield Diagnostic(
+            "PTA002", "warning",
+            f"feed {name!r} is declared by static.data but never read by any op",
+            hint="remove the static.data call (or stop passing the array — "
+                 "unused feeds still ship host->device every run)",
+            var=name)
+
+
+# ops that legitimately mix integer and floating inputs (lookups/indexing/
+# explicit conversions) — excluded from the int/float promotion lint
+_INT_FLOAT_ALLOW = (
+    "embedding", "gather", "take", "index", "one_hot", "lookup", "cast",
+    "astype", "scatter", "where", "bincount", "unique", "topk", "sort",
+    "searchsorted", "roll", "repeat", "tile", "pad", "interpolate", "slice",
+    "put_along_axis", "dropout", "rng", "eye", "full", "arange", "linspace",
+)
+
+
+@register_pass("PTA003", "dtype-lint")
+def _dtype_pass(program, graph: DefUseGraph, ctx: AnalysisContext):
+    """Implicit precision changes at op boundaries: f32/f64 mixes, silently
+    widened float64 outputs, and int/float promotion in arithmetic."""
+    for op in graph.ops:
+        ins = _input_dtypes(op)
+        if not ins:
+            continue
+        name_l = op.name.lower()
+        floats = [(d, n) for d, n in ins if _is_float(d)]
+        ints = [(d, n) for d, n in ins if _is_int(d)]
+        f32 = [n for d, n in floats if _np_dtype(d) == np.float32]
+        f64 = [n for d, n in floats if _np_dtype(d) == np.float64]
+        if f32 and f64:
+            yield Diagnostic(
+                "PTA003", "warning",
+                f"mixes float32 ({', '.join(f32)}) and float64 "
+                f"({', '.join(f64)}) inputs; XLA promotes to float64 "
+                "(or silently downcasts when x64 is off)",
+                hint="cast the float64 side explicitly (astype('float32')) "
+                     "so the intent is recorded",
+                op=op.name, var=f64[0])
+            continue
+        out_f64 = [sv for sv in op.outputs if _np_dtype(sv.dtype) == np.float64]
+        if out_f64 and floats and not f64:
+            yield Diagnostic(
+                "PTA003", "warning",
+                f"produces float64 {out_f64[0].name!r} from non-float64 "
+                "inputs — an implicit widening (usually a stray numpy "
+                "float64 constant)",
+                hint="pin the constant/op dtype to float32",
+                op=op.name, var=out_f64[0].name)
+            continue
+        if (ints and floats
+                and any(_is_float(sv.dtype) for sv in op.outputs)
+                and not any(tok in name_l for tok in _INT_FLOAT_ALLOW)):
+            yield Diagnostic(
+                "PTA003", "warning",
+                f"mixes integer ({', '.join(n for _, n in ints)}) and "
+                f"floating ({', '.join(n for _, n in floats)}) inputs; the "
+                "integer side is promoted to float implicitly",
+                hint="cast the integer input explicitly if the promotion is "
+                     "intended",
+                op=op.name, var=ints[0][1])
+
+
+# op-name tokens that imply a many-to-few reduction whose accumulator
+# precision matters
+_REDUCTION_TOKENS = ("sum", "mean", "softmax", "logsumexp", "var", "std",
+                     "norm", "prod", "cross_entropy", "cumsum", "logcumsumexp")
+
+
+@register_pass("PTA004", "amp-reduction")
+def _amp_reduction_pass(program, graph: DefUseGraph, ctx: AnalysisContext):
+    """Reductions recorded at f16/bf16 end to end: the accumulator inherits
+    the half dtype, so long sums lose precision (the AMP black-list exists
+    for exactly these ops)."""
+    for op in graph.ops:
+        name_l = op.name.lower()
+        if not any(tok in name_l for tok in _REDUCTION_TOKENS):
+            continue
+        ins = _input_dtypes(op)
+        half_in = [n for d, n in ins if _is_half(d)]
+        half_out = [sv for sv in op.outputs if _is_half(sv.dtype)]
+        if half_in and half_out:
+            dt = _np_dtype(half_out[0].dtype)
+            yield Diagnostic(
+                "PTA004", "warning",
+                f"reduction runs in {dt.name if dt else 'half'} end to end "
+                f"(inputs {', '.join(half_in)}); the accumulator loses "
+                "precision on long reductions",
+                hint="upcast to float32 before reducing and cast back "
+                     "(the amp O1 black-list does this automatically)",
+                op=op.name, var=half_out[0].name)
+
+
+@register_pass("PTA005", "dynamic-dim-bake")
+def _dynamic_dim_pass(program, graph: DefUseGraph, ctx: AnalysisContext):
+    """The shape probe for a dynamic (-1) input dim failed on the second
+    extent, so record_op kept the first probe's guess — the op's output
+    shape may silently bake the placeholder extent in and go wrong the
+    moment a real batch size differs from it."""
+    from ..framework.static_trace import _DYN_PLACEHOLDER
+
+    for i, op in enumerate(graph.ops):
+        fb = getattr(op, "dyn_fallback", None)
+        if not fb:
+            continue
+        shapes = ", ".join(str(tuple(sv.shape)) for sv in op.outputs)
+        yield Diagnostic(
+            "PTA005", "error",
+            f"op #{i} consumes a dynamic (-1) dim but its shape fn rejected "
+            f"the second probe extent ({fb}); output shape(s) {shapes} are "
+            f"the first probe's guess and may bake the placeholder extent "
+            f"{_DYN_PLACEHOLDER} in",
+            hint="make the op shape-polymorphic over the dynamic dim (derive "
+                 "sizes from x.shape instead of literals), or declare the "
+                 "dim static in static.data",
+            op=op.name, var=op.outputs[0].name if op.outputs else None)
+
+
+# ------------------------------------------------- structural value numbering
+def _const_key(ref):
+    if isinstance(ref, (bool, int, float, complex, str, bytes, type(None))):
+        return ("scalar", ref)
+    if hasattr(ref, "shape") and hasattr(ref, "dtype"):
+        try:
+            arr = np.asarray(ref)
+            if arr.size <= 4096:  # hash small constants by value
+                return ("arr", arr.shape, str(arr.dtype), arr.tobytes())
+        except Exception:
+            pass
+        return ("bigarr", tuple(getattr(ref, "shape", ())), id(ref))
+    if isinstance(ref, (tuple, list)):
+        return ("seq", type(ref).__name__, tuple(_const_key(x) for x in ref))
+    return ("obj", id(ref))
+
+
+def _cell_key(v):
+    if isinstance(v, (bool, int, float, complex, str, bytes, type(None))):
+        return v
+    if isinstance(v, (tuple, list)):
+        return tuple(_cell_key(x) for x in v)
+    return ("obj", id(v))
+
+
+def _fn_key(fn):
+    """Structural identity of an op fn: shared code object + captured cell
+    values. Two closures over the same def with equal captures compute the
+    same function."""
+    code = getattr(fn, "__code__", None)
+    cells = ()
+    closure = getattr(fn, "__closure__", None)
+    if closure:
+        cells = tuple(_cell_key(c.cell_contents) for c in closure)
+    return (id(code) if code is not None else id(fn), cells)
+
+
+def _kwargs_key(kwargs):
+    return tuple(sorted((k, repr(v)[:256]) for k, v in kwargs.items()))
+
+
+@register_pass("PTA006", "duplicate-computation")
+def _duplicate_pass(program, graph: DefUseGraph, ctx: AnalysisContext):
+    """Value numbering over (fn, attrs, input value numbers): two ops with
+    identical structure recompute the same values — a CSE opportunity XLA
+    only recovers when the duplicates land in one jit scope."""
+    vn: Dict[str, Any] = {}
+    table: Dict[Any, int] = {}
+    for i, op in enumerate(graph.ops):
+        in_keys = []
+        for kind, ref in op.inputs:
+            if kind == "sym":
+                in_keys.append(vn.get(ref.name, ("feed", ref.name)))
+            elif kind == "tensor":
+                in_keys.append(("tensor", id(ref)))
+            else:
+                in_keys.append(_const_key(ref))
+        key = (op.name, _fn_key(op.fn), _kwargs_key(op.kwargs), tuple(in_keys))
+        try:
+            hash(key)
+        except TypeError:
+            key = ("unhashable", i)
+        if key in table:
+            j = table[key]
+            prev = graph.ops[j]
+            # duplicates share value numbers so chains dedupe transitively
+            for sv, psv in zip(op.outputs, prev.outputs):
+                vn[sv.name] = vn[psv.name]
+            yield Diagnostic(
+                "PTA006", "warning",
+                f"op #{i} recomputes op #{j} ('{prev.name}' -> "
+                f"{prev.outputs[0].name if prev.outputs else '?'}): same fn, "
+                "attrs and inputs",
+                hint=f"reuse {prev.outputs[0].name if prev.outputs else 'its output'} "
+                     "instead of re-recording the call",
+                op=op.name, var=op.outputs[0].name if op.outputs else None)
+        else:
+            table[key] = i
+            for k, sv in enumerate(op.outputs):
+                vn[sv.name] = ("out", i, k)
+
+
+@register_pass("PTA007", "oversized-capture")
+def _capture_pass(program, graph: DefUseGraph, ctx: AnalysisContext):
+    """Large arrays captured as ``const`` inputs are baked into every
+    compiled executable as literals (one copy per feed-shape
+    specialization) instead of being passed as runtime buffers."""
+    thresh = ctx.const_capture_threshold
+    for i, op in enumerate(graph.ops):
+        for kind, ref in op.inputs:
+            if kind != "const" or not (hasattr(ref, "shape") and hasattr(ref, "dtype")):
+                continue
+            size = int(np.prod(ref.shape)) if len(getattr(ref, "shape", ())) else 1
+            if size <= thresh:
+                continue
+            nbytes = getattr(ref, "nbytes", size)
+            yield Diagnostic(
+                "PTA007", "warning",
+                f"op #{i} closes over a constant array of {size} elements "
+                f"(~{int(nbytes)} bytes, shape {tuple(ref.shape)}); it is "
+                "baked into every compiled executable for this program",
+                hint="pass it as a Tensor (runtime buffer, shared across "
+                     "specializations) or feed it via static.data",
+                op=op.name)

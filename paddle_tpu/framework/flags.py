@@ -54,6 +54,7 @@ define_flag("FLAGS_flash_flat", False, "use the flat-lane (zero-relayout) flash 
 define_flag("FLAGS_eager_delete_tensor_gb", 0.0, "compat no-op: XLA/PJRT manages buffers")
 define_flag("FLAGS_allocator_strategy", "auto_growth", "compat no-op: PJRT BFC allocator is used")
 define_flag("FLAGS_remat_policy", "none", "default rematerialization policy for jit steps")
+define_flag("FLAGS_static_check", False, "run the paddle_tpu.analysis passes over each Program before its first compile in Executor.run; warnings are reported via the warnings module, error-severity diagnostics raise ProgramAnalysisError")
 
 # Fault-tolerance runtime (distributed/resilience.py).
 define_flag("FLAGS_collective_timeout_s", 0.0, "watchdog: report a cross-process collective still pending after this many seconds (0 = off)")

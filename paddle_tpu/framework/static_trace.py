@@ -70,7 +70,7 @@ _DYN_PLACEHOLDER_B = 8
 class Op:
     """One recorded primitive call (OpDesc analog: fn + attrs + var refs)."""
 
-    __slots__ = ("fn", "kwargs", "inputs", "outputs", "name")
+    __slots__ = ("fn", "kwargs", "inputs", "outputs", "name", "dyn_fallback")
 
     def __init__(self, fn, kwargs, inputs, outputs, name):
         self.fn = fn            # pure jax function of positional arrays
@@ -78,6 +78,10 @@ class Op:
         self.inputs = inputs    # list of ('sym', SymbolicValue)|('tensor', Tensor)|('const', value)
         self.outputs = outputs  # list of SymbolicValue
         self.name = name
+        # set by record_op when the second dynamic-dim shape probe failed:
+        # "TypeName: message" of the rejection — the analysis pass PTA005
+        # surfaces it (the output shape may bake the probe extent in)
+        self.dyn_fallback = None
 
 
 class Program:
@@ -136,7 +140,19 @@ class Program:
             c.optimizer = None
             c.loss_var = None
             c.grad_vars = {}
+            # eval-mode runs must not commit BatchNorm running-stat updates
+            c.buffer_writes = []
         return c
+
+    def analyze(self, fetch_list=None, **kwargs):
+        """Run the registered static-analysis passes over this program
+        (paddle_tpu.analysis). ``fetch_list`` (Tensors, SymbolicValues or
+        names) anchors liveness for the dead-op pass; without it every sink
+        op counts as a result. Returns a list of ``Diagnostic``s — the
+        Executor runs this automatically under ``FLAGS_static_check``."""
+        from ..analysis import analyze_program
+
+        return analyze_program(self, fetch=fetch_list, **kwargs)
 
     def all_parameters(self):
         """Trainable concrete Tensors referenced by recorded ops."""
@@ -179,6 +195,12 @@ class Program:
                     vals.append(ref)
             out = op.fn(*vals, **op.kwargs)
             outs = out if isinstance(out, (tuple, list)) else (out,)
+            if len(outs) != len(op.outputs):
+                raise RuntimeError(
+                    f"op {op.name!r} returned {len(outs)} output(s) at run "
+                    f"time but {len(op.outputs)} were recorded at trace time; "
+                    "an op fn's output structure must not depend on run-time "
+                    "state")
             for sv, v in zip(op.outputs, outs):
                 env[sv.name] = v
         return env
@@ -263,6 +285,7 @@ def record_op(fn: Callable, args: Sequence[Any], kwargs: Dict[str, Any], name: s
     multi = isinstance(out_spec, (tuple, list))
     out_specs = tuple(out_spec) if multi else (out_spec,)
     out_shapes = [tuple(s.shape) for s in out_specs]
+    dyn_fallback = None
     if has_dyn:
         # second probe: output dims that track an input's dynamic dim change
         # with it — record those as -1 instead of baking the placeholder in
@@ -273,11 +296,16 @@ def record_op(fn: Callable, args: Sequence[Any], kwargs: Dict[str, Any], name: s
                 tuple(-1 if da != db else da for da, db in zip(sa.shape, sb.shape))
                 for sa, sb in zip(out_specs, specs_b)
             ]
-        except Exception:
-            pass  # shape fn rejects the probe extent; keep the static guess
+        except (TypeError, ValueError, IndexError, ArithmeticError) as e:
+            # shape fn rejects the probe extent: keep the static guess, but
+            # mark the op — the first probe's extent may be baked into its
+            # output shape, which the PTA005 analysis pass surfaces
+            dyn_fallback = f"{type(e).__name__}: {e}"
     outputs = [SymbolicValue(shp, s.dtype, prog.fresh_name(name or "op"))
                for shp, s in zip(out_shapes, out_specs)]
-    prog.ops.append(Op(fn, dict(kwargs), inputs, outputs, name or getattr(fn, "__name__", "op")))
+    rec = Op(fn, dict(kwargs), inputs, outputs, name or getattr(fn, "__name__", "op"))
+    rec.dyn_fallback = dyn_fallback
+    prog.ops.append(rec)
 
     wrapped = tuple(_wrap_value(sv, stop_gradient=not any_diff) for sv in outputs)
     return wrapped if multi else wrapped[0]

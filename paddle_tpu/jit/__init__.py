@@ -280,7 +280,29 @@ class EvalStep:
         return _wrap_tree(out)
 
 
-def to_static(function=None, input_spec=None, full_graph=True, **kwargs):
+def _preflight_lint(fn):
+    """Run the dy2static pre-flight linter (paddle_tpu.analysis.ast_lint)
+    over ``fn`` and surface findings as one UserWarning — BEFORE transpile or
+    tracing, so unsupported constructs are reported with file:line instead of
+    dying later as an opaque TracerBoolConversionError."""
+    from ..analysis.ast_lint import lint_function
+
+    try:
+        diags = lint_function(fn)
+    except (OSError, TypeError):  # source unavailable (C ext, REPL, …)
+        return []
+    if diags:
+        import warnings
+
+        from ..analysis.diagnostics import format_report
+
+        warnings.warn("to_static(lint=True) pre-flight report for "
+                      f"{getattr(fn, '__qualname__', fn)!r}:\n"
+                      + format_report(diags), stacklevel=4)
+    return diags
+
+
+def to_static(function=None, input_spec=None, full_graph=True, lint=False, **kwargs):
     """Decorator compiling a Tensor-level function/Layer method with jax.jit.
 
     Parity: @paddle.jit.to_static including a minimal AST transpile
@@ -295,6 +317,11 @@ def to_static(function=None, input_spec=None, full_graph=True, **kwargs):
     ``paddle.static.nn.while_loop`` and ``paddle.static.nn.switch_case``
     work in eager, to_static and static programs alike; ``@jit.not_to_static``
     opts a function out of rewriting.
+
+    ``lint=True`` runs the dy2static pre-flight linter first
+    (paddle_tpu.analysis.ast_lint): unsupported constructs are reported with
+    source line numbers via ``warnings`` and attached to the returned wrapper
+    as ``__lint_report__`` — before any trace can fail.
     """
 
     def decorate(fn):
@@ -307,6 +334,7 @@ def to_static(function=None, input_spec=None, full_graph=True, **kwargs):
             model = fn
             fwd = model.forward
             inner = getattr(fwd, "__func__", fwd)
+            lint_report = _preflight_lint(inner) if lint else []
             rewritten = transpile(inner)
             if rewritten is not inner:
                 model.forward = types.MethodType(rewritten, model)
@@ -327,8 +355,10 @@ def to_static(function=None, input_spec=None, full_graph=True, **kwargs):
                 return _wrap_tree(out)
 
             wrapper.__wrapped_layer__ = model
+            wrapper.__lint_report__ = lint_report
             return wrapper
 
+        lint_report = _preflight_lint(fn) if lint else []
         fn = transpile(fn)
 
         @functools.partial(jax.jit)
@@ -342,6 +372,7 @@ def to_static(function=None, input_spec=None, full_graph=True, **kwargs):
             arrays = tuple(unwrap(a) if isinstance(a, Tensor) else jnp.asarray(a) for a in args)
             return _wrap_tree(_pure(arrays))
 
+        wrapper.__lint_report__ = lint_report
         return wrapper
 
     if function is not None:

@@ -433,6 +433,14 @@ def _escapes(nodes):
     return False
 
 
+# public envelope tables: the pre-flight linter (paddle_tpu.analysis.ast_lint)
+# flags exactly what this transpiler refuses to rewrite — same definitions,
+# single source of truth
+MUTATING_METHODS = _EscapeScan._MUTATING
+is_inplace_call = _EscapeScan._is_inplace_call
+is_mutating_stmt = _EscapeScan._is_mutating_stmt
+
+
 def _name(id_, ctx=None):
     return ast.Name(id=id_, ctx=ctx or ast.Load())
 

@@ -235,6 +235,13 @@ class Executor:
         feed_sig = tuple(sorted((k, v.shape, str(v.dtype)) for k, v in feed_arrays.items()))
         key = (prog.id, prog.version, feed_sig, tuple(fetch_names), train)
         if key not in self._cache:
+            from ..framework.flags import flag as _flag
+
+            if _flag("FLAGS_static_check"):
+                # pre-flight the program once per compiled specialization:
+                # warnings surface through the warnings module, error-severity
+                # diagnostics (e.g. a baked dynamic dim) abort before compile
+                self._static_check(prog, [n for n in fetch_names if n])
             self._cache[key] = self._build(prog, tuple(sorted(feed_arrays)), fetch_names,
                                            params, others, train)
             while len(self._cache) > self._CACHE_CAPACITY:
@@ -285,6 +292,20 @@ class Executor:
                     gs.var(fetch_names[i])._value = v
             out.append(np.asarray(v) if return_numpy else _wrap_value(v))
         return out
+
+    def _static_check(self, prog: Program, fetch_names):
+        """FLAGS_static_check body: analyze, warn, raise on errors."""
+        import warnings as _warnings
+
+        from ..analysis import ProgramAnalysisError
+
+        diags = prog.analyze(fetch_names or None)
+        errors = [d for d in diags if d.severity == "error"]
+        for d in diags:
+            if d.severity != "error":
+                _warnings.warn(f"FLAGS_static_check: {d}", stacklevel=3)
+        if errors:
+            raise ProgramAnalysisError(errors)
 
     def _build(self, prog: Program, feed_names, fetch_names, params, others, train):
         opt = prog.optimizer

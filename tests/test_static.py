@@ -207,6 +207,52 @@ def test_static_batchnorm_training_updates_buffers():
     assert np.linalg.norm(bn._mean.numpy() - xv.mean(0)) < np.linalg.norm(rm_after - xv.mean(0))
 
 
+def test_clone_for_test_clears_buffer_writes():
+    """Regression: eval-mode clones must NOT commit BatchNorm running-stat
+    updates (clone(for_test=True) used to share buffer_writes)."""
+    paddle.seed(0)
+    bn = paddle.nn.BatchNorm1D(3)
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [None, 3])
+        y = bn(x)
+    assert prog.buffer_writes, "fixture must record running-stat updates"
+    test_prog = prog.clone(for_test=True)
+    assert test_prog.buffer_writes == []
+    assert prog.buffer_writes  # the train program keeps its commits
+    rm_before = bn._mean.numpy().copy()
+    rv_before = bn._variance.numpy().copy()
+    exe = static.Executor()
+    xv = np.random.default_rng(0).normal(loc=5.0, size=(16, 3)).astype("float32")
+    exe.run(test_prog, feed={"x": xv}, fetch_list=[y])
+    np.testing.assert_array_equal(bn._mean.numpy(), rm_before)
+    np.testing.assert_array_equal(bn._variance.numpy(), rv_before)
+    # the train program still updates
+    exe.run(prog, feed={"x": xv}, fetch_list=[y])
+    assert not np.allclose(bn._mean.numpy(), rm_before)
+
+
+def test_interpret_output_arity_mismatch_raises():
+    """Regression: Program.interpret raised nothing when an op returned a
+    different number of outputs than recorded — values were silently
+    dropped by the unchecked zip."""
+    from paddle_tpu.tensor._helpers import op as _op
+
+    calls = {"n": 0}
+
+    def tricky(v):
+        calls["n"] += 1
+        return (v, v) if calls["n"] == 1 else v  # shape probe sees a pair
+
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [2])
+        _op(tricky, x, _name="tricky")
+    assert len(prog.ops[-1].outputs) == 2
+    with pytest.raises(RuntimeError, match="tricky.*1 output.*2 were recorded"):
+        prog.interpret({"x": np.ones(2, np.float32)}, {})
+
+
 def test_static_inplace_raises():
     prog = static.Program()
     with static.program_guard(prog):
