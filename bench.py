@@ -74,8 +74,32 @@ def _measure(flash_flat: bool):
     dt = time.perf_counter() - t0
 
     tokens_per_sec = batch * seq * iters / dt
+    steps_per_sec = iters / dt
+
+    # dispatch-amortized multi-step path: K fused steps per Python dispatch
+    # (lax.scan over the step body, state donated) — same model/state
+    from paddle_tpu import profiler
+
+    K = 8
+    stacked = (np.stack([ids] * K), np.stack([ids] * K))
+    out = step.run_steps(stacked, k=K)  # warmup compile
+    float(np.asarray(out["loss"]._value)[-1])
+    profiler.reset_counters("train_step.")
+    groups = max(1, iters // K)
+    t0 = time.perf_counter()
+    for _ in range(groups):
+        out = step.run_steps(stacked, k=K)
+    float(np.asarray(out["loss"]._value)[-1])
+    dt_fused = time.perf_counter() - t0
+    counts = profiler.counters("train_step.")
+    extras = {
+        "steps_per_sec": round(steps_per_sec, 3),
+        "steps_per_sec_fused": round(groups * K / dt_fused, 3),
+        "dispatches_per_step": round(
+            counts["train_step.dispatches"] / counts["train_step.steps"], 4),
+    }
     config_key = f"{d0.device_kind or d0.platform}/h{cfg.hidden_size}L{cfg.num_layers}b{batch}s{seq}/amp={amp_level}"
-    return tokens_per_sec, config_key, on_tpu
+    return tokens_per_sec, config_key, on_tpu, extras
 
 
 def _measure_in_subprocess(which: str, timeout: float):
@@ -88,13 +112,14 @@ def _measure_in_subprocess(which: str, timeout: float):
                        capture_output=True, text=True, timeout=timeout)
     line = [l for l in r.stdout.splitlines() if l.startswith("{")][-1]
     d = json.loads(line)
-    return d["value"], d["config"], d["on_tpu"]
+    return d["value"], d["config"], d["on_tpu"], d.get("extras", {})
 
 
 def main():
     if os.environ.get("BENCH_ONE"):
-        tps, config_key, on_tpu = _measure(os.environ["BENCH_ONE"] == "flat")
-        print(json.dumps({"value": tps, "config": config_key, "on_tpu": on_tpu}))
+        tps, config_key, on_tpu, extras = _measure(os.environ["BENCH_ONE"] == "flat")
+        print(json.dumps({"value": tps, "config": config_key, "on_tpu": on_tpu,
+                          "extras": extras}))
         return
 
     from __graft_entry__ import _probe_default_backend
@@ -103,7 +128,8 @@ def main():
         # fail FAST and parseably — never hang into the driver's timeout
         print(json.dumps({"metric": "gpt_pretrain_throughput", "value": None,
                           "unit": "tokens/sec/chip", "vs_baseline": None,
-                          "error": reason}))
+                          "steps_per_sec": None, "steps_per_sec_fused": None,
+                          "dispatches_per_step": None, "error": reason}))
 
     verdict = _probe_default_backend(timeout=75.0)
     if verdict is False:
@@ -115,11 +141,11 @@ def main():
         # could not spawn a probe child — subprocess machinery unavailable,
         # so measure once in-process (a hang here is unavoidable but this
         # path only exists where fork/exec fails, e.g. sandboxed CPU runs)
-        tokens_per_sec, config_key, on_tpu = _measure(flash_flat=False)
+        tokens_per_sec, config_key, on_tpu, extras = _measure(flash_flat=False)
         on_tpu = False  # device now locked by this process: skip the flat run
     else:
         try:
-            tokens_per_sec, config_key, on_tpu = _measure_in_subprocess("classic", timeout=520)
+            tokens_per_sec, config_key, on_tpu, extras = _measure_in_subprocess("classic", timeout=520)
         except subprocess.TimeoutExpired:
             # the probe only bounds backend init, not model compile; a hung
             # compile must surface as a sentinel, never as an in-process retry
@@ -132,9 +158,9 @@ def main():
             return
     if on_tpu:
         try:
-            flat_tps, flat_cfg, _ = _measure_in_subprocess("flat", timeout=240)
+            flat_tps, flat_cfg, _, flat_extras = _measure_in_subprocess("flat", timeout=240)
             if flat_cfg == config_key and flat_tps > tokens_per_sec:
-                tokens_per_sec, chosen = flat_tps, "flash_flat"
+                tokens_per_sec, chosen, extras = flat_tps, "flash_flat", flat_extras
         except Exception:
             pass  # classic measurement stands
 
@@ -157,6 +183,13 @@ def main():
         "unit": "tokens/sec/chip",
         "vs_baseline": round(vs, 4),
         "attention_path": chosen,
+        # dispatch-amortization telemetry (run_steps, lax.scan over K=8):
+        # steps/sec for the per-step loop vs the fused multi-step path, and
+        # dispatches-per-step measured by the train_step.* counters (1/8
+        # when every step rides a fused dispatch)
+        "steps_per_sec": extras.get("steps_per_sec"),
+        "steps_per_sec_fused": extras.get("steps_per_sec_fused"),
+        "dispatches_per_step": extras.get("dispatches_per_step"),
     }))
 
 

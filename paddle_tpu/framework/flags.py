@@ -11,6 +11,16 @@ import os
 from typing import Any, Dict
 
 _REGISTRY: Dict[str, Any] = {}
+# side-effecting flags: callback fired when the value is defined (import) or
+# changed via set_flags — e.g. FLAGS_compile_cache_dir pushing jax.config
+_ON_SET: Dict[str, Any] = {}
+
+
+def on_flag_set(name: str, callback):
+    """Register ``callback(value)`` to run now (with the current value) and
+    on every subsequent ``set_flags`` of ``name``."""
+    _ON_SET[name] = callback
+    callback(_REGISTRY[name])
 
 
 def define_flag(name: str, default, help_str: str = ""):
@@ -34,6 +44,8 @@ def set_flags(flags: Dict[str, Any]):
         if k not in _REGISTRY:
             raise KeyError(f"unknown flag {k!r}")
         _REGISTRY[k] = v
+        if k in _ON_SET:
+            _ON_SET[k](v)
 
 
 def get_flags(flags):
@@ -55,6 +67,22 @@ define_flag("FLAGS_eager_delete_tensor_gb", 0.0, "compat no-op: XLA/PJRT manages
 define_flag("FLAGS_allocator_strategy", "auto_growth", "compat no-op: PJRT BFC allocator is used")
 define_flag("FLAGS_remat_policy", "none", "default rematerialization policy for jit steps")
 define_flag("FLAGS_static_check", False, "run the paddle_tpu.analysis passes over each Program before its first compile in Executor.run; warnings are reported via the warnings module, error-severity diagnostics raise ProgramAnalysisError")
+define_flag("FLAGS_executor_donate", False, "Executor.run donates parameter and optimizer-state buffers to the compiled program on training runs (flat param memory; stale outside handles raise StaleHandleError)")
+define_flag("FLAGS_compile_cache_dir", "", "persistent XLA compilation cache directory (jax_compilation_cache_dir): repeated runs of the same program skip recompiles. Env spelling: FLAGS_compile_cache_dir=/path (JAX's own JAX_COMPILATION_CACHE_DIR works too, but only this flag is visible to get_flags/set_flags)")
+
+
+def _apply_compile_cache_dir(path):
+    if not path:
+        return
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", str(path))
+    # cache every hit: the default 1s floor would skip exactly the small
+    # specializations an Executor compiles dozens of
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+
+
+on_flag_set("FLAGS_compile_cache_dir", _apply_compile_cache_dir)
 
 # Fault-tolerance runtime (distributed/resilience.py).
 define_flag("FLAGS_collective_timeout_s", 0.0, "watchdog: report a cross-process collective still pending after this many seconds (0 = off)")

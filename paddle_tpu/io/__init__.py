@@ -1,5 +1,5 @@
 """paddle_tpu.io (parity: python/paddle/io)."""
-from .dataloader import DataLoader, default_collate_fn  # noqa: F401
+from .dataloader import DataLoader, default_collate_fn, stack_batches  # noqa: F401
 from .mp_worker import WorkerInfo, get_worker_info  # noqa: F401
 from .dataset import (  # noqa: F401
     BatchSampler,

@@ -18,6 +18,55 @@ import numpy as np
 from .dataset import BatchSampler, Dataset, IterableDataset
 
 
+def stack_batches(it, k, to_device=True):
+    """Group every ``k`` consecutive batches from ``it`` into one
+    ``[k, ...]``-stacked pytree — the feed unit of the single-dispatch
+    multi-step path (``TrainStep.run_steps`` / ``MultiStepRunner``).
+
+    Stacking happens on host (numpy); with ``to_device`` each stack's
+    host→HBM transfer is issued asynchronously one stack ahead (device_put
+    is async under PJRT), preserving the loader's one-ahead overlap at stack
+    granularity. A trailing group shorter than ``k`` is still yielded (its
+    different leading dim costs one extra compile downstream).
+    """
+    import jax
+
+    k = int(k)
+    if k < 1:
+        raise ValueError(f"stack_batches needs k >= 1, got {k}")
+
+    def sig(batch):
+        return tuple(np.shape(l) for l in jax.tree_util.tree_leaves(batch))
+
+    def stacks():
+        group = []
+        for batch in it:
+            # a ragged batch (e.g. a drop_last=False remainder) cannot join
+            # the current stack: flush what we have, start a new group
+            if group and sig(batch) != sig(group[0]):
+                yield jax.tree_util.tree_map(lambda *xs: np.stack(xs), *group)
+                group = []
+            group.append(batch)
+            if len(group) == k:
+                yield jax.tree_util.tree_map(lambda *xs: np.stack(xs), *group)
+                group = []
+        if group:
+            yield jax.tree_util.tree_map(lambda *xs: np.stack(xs), *group)
+
+    if not to_device:
+        yield from stacks()
+        return
+    put = lambda b: jax.tree_util.tree_map(jax.device_put, b)
+    prev = None
+    for stack in stacks():
+        nxt = put(stack)
+        if prev is not None:
+            yield prev
+        prev = nxt
+    if prev is not None:
+        yield prev
+
+
 def default_collate_fn(batch):
     sample = batch[0]
     if isinstance(sample, (list, tuple)):
@@ -45,8 +94,13 @@ class DataLoader:
     pipelines. ``persistent_workers``/``timeout``/``worker_init_fn`` apply
     to process mode."""
 
-    def __init__(self, dataset, feed_list=None, places=None, return_list=True, batch_sampler=None, batch_size=1, shuffle=False, drop_last=False, collate_fn=None, num_workers=0, use_buffer_reader=True, prefetch_factor=2, use_shared_memory=True, timeout=0, worker_init_fn=None, persistent_workers=False, worker_mode="thread"):
+    def __init__(self, dataset, feed_list=None, places=None, return_list=True, batch_sampler=None, batch_size=1, shuffle=False, drop_last=False, collate_fn=None, num_workers=0, use_buffer_reader=True, prefetch_factor=2, use_shared_memory=True, timeout=0, worker_init_fn=None, persistent_workers=False, worker_mode="thread", fuse_steps=None):
         self.dataset = dataset
+        # fuse_steps=K: yield [K, ...]-stacked device-resident batch stacks
+        # (one per K steps) for TrainStep.run_steps instead of single batches
+        self.fuse_steps = int(fuse_steps) if fuse_steps else None
+        if self.fuse_steps is not None and self.fuse_steps < 1:
+            raise ValueError(f"fuse_steps must be >= 1, got {fuse_steps}")
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
         self.prefetch_factor = max(prefetch_factor, 1)
@@ -90,7 +144,11 @@ class DataLoader:
             it = self._iter_multiprocess()
         else:
             it = self._iter_threaded()
-        if self._prefetch_to_device():
+        if self.fuse_steps is not None:
+            # stack granularity subsumes per-batch prefetch: one async
+            # device_put per K batches, still one stack ahead
+            it = stack_batches(it, self.fuse_steps, to_device=self._prefetch_to_device())
+        elif self._prefetch_to_device():
             it = self._iter_device_prefetch(it)
         yield from it
 

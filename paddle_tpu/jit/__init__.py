@@ -93,8 +93,10 @@ class TrainStep:
         if mesh is not None and state_shardings is not None:
             self.state = jax.device_put(self.state, state_shardings)
             self._jit = jax.jit(self._step, donate_argnums=0, in_shardings=(state_shardings, batch_shardings), out_shardings=(state_shardings, None))
+            self._jit_multi = jax.jit(self._multi_step, donate_argnums=0, in_shardings=(state_shardings, None), out_shardings=(state_shardings, None))
         else:
             self._jit = jax.jit(self._step, donate_argnums=0)
+            self._jit_multi = jax.jit(self._multi_step, donate_argnums=0)
 
     def _build(self, remat):
         model, optimizer, loss_fn = self.model, self.optimizer, self.loss_fn
@@ -200,11 +202,72 @@ class TrainStep:
 
         self._step = _step
 
+        def _multi_step(state, stacked):
+            # K steps in one XLA dispatch: the per-step fn is the scan body,
+            # so the compiled program chains K forward+backward+update
+            # iterations on-device — the InterpreterCore's per-op scheduling
+            # amortized to one host round-trip per K steps
+            return jax.lax.scan(_step, state, stacked)
+
+        self._multi_step = _multi_step
+
+    @staticmethod
+    def _as_arrays(x):
+        return tuple(unwrap(v) if isinstance(v, Tensor) else jnp.asarray(v)
+                     for v in (x if isinstance(x, (list, tuple)) else [x]))
+
     def __call__(self, inputs, labels):
-        inputs = tuple(unwrap(x) if isinstance(x, Tensor) else jnp.asarray(x) for x in (inputs if isinstance(inputs, (list, tuple)) else [inputs]))
-        labels = tuple(unwrap(y) if isinstance(y, Tensor) else jnp.asarray(y) for y in (labels if isinstance(labels, (list, tuple)) else [labels]))
+        inputs = self._as_arrays(inputs)
+        labels = self._as_arrays(labels)
         self.state, metrics = self._jit(self.state, (inputs, labels))
+        from ..profiler import counter_inc
+
+        counter_inc("train_step.dispatches")
+        counter_inc("train_step.steps")
         return {k: _wrap_tree(v) for k, v in metrics.items()}
+
+    def run_steps(self, batches, k=None):
+        """Run K training steps in ONE jitted dispatch (lax.scan over the
+        step body, state donated).
+
+        ``batches`` is either
+
+        * a sequence of K per-step ``(inputs, labels)`` batches (``k`` may be
+          omitted) — stacked here along a new leading axis, or
+        * a pre-stacked ``(inputs, labels)`` pair whose leaves already carry
+          the leading ``[k, ...]`` axis (what ``io.DataLoader(fuse_steps=k)``
+          yields) — then ``k`` must be passed.
+
+        Returns the metrics dict with every leaf stacked ``[k, ...]`` as
+        device-resident arrays: nothing syncs the host until the caller
+        reads a value (log boundaries), so the loop costs one Python
+        dispatch per K steps instead of per step. Bitwise-identical to K
+        individual ``__call__`` steps (same step fn, same per-step rng
+        fold-in on the carried counter).
+        """
+        if k is None:
+            batches = list(batches)
+            k = len(batches)
+            if k == 0:
+                raise ValueError("run_steps needs at least one batch")
+            norm = [(self._as_arrays(i), self._as_arrays(l)) for i, l in batches]
+            stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *norm)
+        else:
+            k = int(k)
+            inputs, labels = batches
+            stacked = (self._as_arrays(inputs), self._as_arrays(labels))
+            for leaf in jax.tree_util.tree_leaves(stacked):
+                if leaf.shape[:1] != (k,):
+                    raise ValueError(
+                        f"pre-stacked batch leaf has leading dim {leaf.shape[:1]}, "
+                        f"expected ({k},); pass per-step batches without k= to "
+                        "have run_steps stack them")
+        self.state, metrics = self._jit_multi(self.state, stacked)
+        from ..profiler import counter_inc
+
+        counter_inc("train_step.dispatches")
+        counter_inc("train_step.steps", k)
+        return {name: _wrap_tree(v) for name, v in metrics.items()}
 
     # -- interop -----------------------------------------------------------
     def sync_to_model(self):
@@ -228,6 +291,46 @@ class TrainStep:
         lowered = self._jit.lower(self.state, (inputs, labels))
         compiled = lowered.compile()
         return compiled
+
+
+class MultiStepRunner:
+    """Amortized training driver over a batch stream: groups every K batches
+    into one device-resident stack and runs them through
+    :meth:`TrainStep.run_steps` — one Python/XLA dispatch per K steps, the
+    JAX/XLA production-trainer idiom (device data + lax.scan, host sync only
+    at log boundaries).
+
+    ``batch_iter`` yields per-step ``(inputs, labels)`` batches (a plain
+    ``io.DataLoader`` works); with ``prestacked=True`` it yields
+    ``[k, ...]``-stacked pairs (``io.DataLoader(fuse_steps=k)``), skipping
+    the host-side stacking here. Iterating the runner yields one stacked
+    metrics dict per dispatch; a trailing group smaller than K still runs
+    (one extra specialization compile for that size).
+    """
+
+    def __init__(self, step: TrainStep, k: int, prestacked: bool = False):
+        if int(k) < 1:
+            raise ValueError(f"k must be >= 1, got {k}")
+        self.step = step
+        self.k = int(k)
+        self.prestacked = prestacked
+
+    def run(self, batch_iter):
+        if self.prestacked:
+            for stacked in batch_iter:
+                lead = jax.tree_util.tree_leaves(stacked)[0].shape[0]
+                yield self.step.run_steps(tuple(stacked), k=lead)
+            return
+        group = []
+        for batch in batch_iter:
+            group.append(batch)
+            if len(group) == self.k:
+                yield self.step.run_steps(group)
+                group = []
+        if group:
+            yield self.step.run_steps(group)
+
+    __call__ = run
 
 
 class EvalStep:

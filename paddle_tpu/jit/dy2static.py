@@ -135,6 +135,21 @@ def convert_ifelse(pred, true_fn, false_fn, names):
 
 
 def convert_while(cond_fn, body_fn, init, names):
+    """Dispatch a rewritten ``while``: native Python while the condition is
+    concrete, lax.while_loop once it is traced.
+
+    When a de-sugared break/continue flag turns traced MID-loop (e.g.
+    ``if i >= 2 and (x > 0): break`` — concrete short-circuit for i < 2,
+    traced after), the traced loop resumes from the already-advanced loop
+    vars: iterations completed concretely are kept, not re-executed.
+
+    Limitation: Python-level side effects in the body (print / logging /
+    list mutation / host RNG) still run once per *concrete* iteration plus
+    exactly once more when JAX traces the remaining loop — lax.while_loop
+    executes the Python body a single time at trace time regardless of trip
+    count, so per-iteration side effects cannot be replayed on device.
+    Side-effect-free bodies are unaffected.
+    """
     b = _concrete_bool(cond_fn(*init))
     if b is not None:
         vals = tuple(init)
@@ -145,9 +160,10 @@ def convert_while(cond_fn, body_fn, init, names):
                 if any(n.startswith(("_jst_brk", "_jst_cont")) for n in names):
                     # a de-sugared break/continue flag became traced: the
                     # flag-form body is pure over its loop vars (escape-
-                    # scanned), so discard the partial run and re-execute
-                    # the whole loop in traced form from init
-                    return _traced_while(cond_fn, body_fn, init, names)
+                    # scanned), so hand the ALREADY-ADVANCED vals to the
+                    # traced loop — the concrete prefix is kept, only the
+                    # remaining iterations compile
+                    return _traced_while(cond_fn, body_fn, vals, names)
                 raise TypeError(
                     "while condition became a traced tensor mid-loop; a "
                     "tensor-dependent while must start from tensor loop vars "
@@ -364,7 +380,12 @@ class _EscapeScan(ast.NodeVisitor):
     def _is_inplace_call(cls, node):
         f = node.func
         return (isinstance(f, ast.Attribute) and f.attr.endswith("_")
-                and not f.attr.startswith("_"))
+                and not f.attr.startswith("_")
+                # the rewriter's own pure helpers (__paddle_jst__.and_/or_/
+                # not_) share the trailing-underscore spelling — without
+                # this exclusion any loop body containing a rewritten
+                # bool-op could never convert to convert_while
+                and not (isinstance(f.value, ast.Name) and f.value.id == _JST))
 
     @classmethod
     def _is_mutating_stmt(cls, node):

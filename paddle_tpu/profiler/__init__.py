@@ -93,6 +93,33 @@ class RecordEvent:
 
 _HOST_EVENTS = defaultdict(list)
 
+# ---------------------------------------------------------------- counters
+# Cheap monotonic counters for dispatch accounting (reference: the op/run
+# counts platform/profiler keeps per tracer). The hot paths bump these with
+# one dict add — no locks, no device sync — so they are safe to leave on:
+#   executor.runs / executor.cache_hits / executor.cache_misses /
+#   executor.compiles / executor.donated_runs — Executor.run bookkeeping
+#   train_step.dispatches / train_step.steps — TrainStep __call__/run_steps
+# ``run_steps(k)`` adds 1 dispatch and k steps: dispatches-per-step is the
+# amortization ratio bench.py reports.
+_COUNTERS = defaultdict(int)
+
+
+def counter_inc(name: str, n: int = 1) -> None:
+    """Bump a named dispatch counter by ``n``."""
+    _COUNTERS[name] += n
+
+
+def counters(prefix: str = "") -> dict:
+    """Snapshot of the counters, optionally filtered by name prefix."""
+    return {k: v for k, v in _COUNTERS.items() if k.startswith(prefix)}
+
+
+def reset_counters(prefix: str = "") -> None:
+    """Zero the counters (those matching ``prefix`` when given)."""
+    for k in [k for k in _COUNTERS if k.startswith(prefix)]:
+        del _COUNTERS[k]
+
 
 class Profiler:
     def __init__(self, targets=None, scheduler=None, on_trace_ready=None, timer_only=False, record_shapes=False, profile_memory=False, with_flops=False):

@@ -40,7 +40,7 @@ __all__ = [
     "data", "Executor", "append_backward", "CompiledProgram", "InputSpec",
     "save_inference_model", "load_inference_model", "enable_static",
     "disable_static", "in_dynamic_mode", "gradients", "name_scope", "py_func",
-    "global_scope", "scope_guard", "Scope",
+    "global_scope", "scope_guard", "Scope", "StaleHandleError",
 ]
 
 _default_main = Program()
@@ -159,11 +159,75 @@ class InputSpec:
         self.name = name
 
 
+class StaleHandleError(RuntimeError):
+    """A handle fetched from ``Executor.run`` whose device buffer was since
+    donated back to the compiled program (``FLAGS_executor_donate``)."""
+
+
+class _StaleArray:
+    """Poison value installed into Tensors whose buffer a donated run
+    consumed: any use (shape/dtype/np.asarray/ops) raises StaleHandleError
+    with the donation story instead of XLA's opaque deleted-buffer crash."""
+
+    __slots__ = ("_msg",)
+
+    def __init__(self, msg):
+        object.__setattr__(self, "_msg", msg)
+
+    def __getattr__(self, name):
+        raise StaleHandleError(object.__getattribute__(self, "_msg"))
+
+    def __array__(self, dtype=None, copy=None):
+        raise StaleHandleError(object.__getattribute__(self, "_msg"))
+
+    def __repr__(self):
+        return "<stale donated handle>"
+
+
+class _RunPlan:
+    """Per-specialization run plan: everything ``Executor.run`` previously
+    recomputed every call — param/other Tensor lists, the compiled fn, and
+    the scope-publish targets — resolved once at build time so the per-run
+    hot path is: read feed arrays, call, write back."""
+
+    __slots__ = ("fn", "params", "others", "train", "donate",
+                 "scope", "param_vars", "fetch_vars")
+
+    def __init__(self, fn, params, others, train, donate):
+        self.fn = fn
+        self.params = params
+        self.others = others
+        self.train = train
+        self.donate = donate
+        self.scope = None          # scope the publish targets below belong to
+        self.param_vars = ()       # [(param Tensor, scope Variable)]
+        self.fetch_vars = {}       # fetch name -> scope Variable
+
+    def bind_scope(self, gs, fetch_names):
+        if self.scope is not gs:
+            self.scope = gs
+            self.param_vars = tuple((p, gs.var(p.name)) for p in self.params
+                                    if getattr(p, "name", None))
+            self.fetch_vars = {n: gs.var(n) for n in fetch_names if n}
+
+
 class Executor:
     """Compiles and runs Programs (reference executor.py:1108 Executor.run →
     here: one jax.jit per (program version, feed/fetch signature) cached like
     _ExecutorCache; parameter/optimizer state round-trips through the concrete
-    Tensors so eager code observes static updates and vice versa)."""
+    Tensors so eager code observes static updates and vice versa).
+
+    Hot-path overhead is amortized per specialization: a cached
+    :class:`_RunPlan` keeps the param/other id lists and scope-publish
+    targets, so a cache-hit ``run`` does no program walking and no
+    ``gs.var`` lookups. With ``FLAGS_executor_donate`` training runs donate
+    ``param_vals`` and the optimizer state into the compiled program
+    (``donate_argnums``) — parameter memory stays flat — and any previously
+    fetched handle aliasing a donated buffer is invalidated to raise
+    :class:`StaleHandleError` on use. ``return_numpy=False`` fetches return
+    device-resident Tensors without forcing a host sync. Dispatch accounting
+    (runs / cache_hits / cache_misses / compiles / donated_runs) is exported
+    via ``paddle_tpu.profiler.counters('executor.')``."""
 
     # compiled programs kept per executor; beyond this LRU bound the oldest
     # recompiles on next use (varying feed shapes would otherwise accumulate
@@ -175,13 +239,22 @@ class Executor:
         import collections
 
         self.place = place
-        self._cache: "collections.OrderedDict[tuple, Any]" = collections.OrderedDict()
+        self._cache: "collections.OrderedDict[tuple, _RunPlan]" = collections.OrderedDict()
         # keyed (prog.id, param-identity tuple); at most one live entry per
         # program — growing a program evicts its stale state
         self._opt_states: Dict[tuple, Any] = {}
+        # (prog.id, version) -> feed names actually consumed by the ops
+        self._feed_use: Dict[tuple, set] = {}
+        # weakrefs to device-handle Tensors returned while donation is on;
+        # a donated run sweeps these and poisons the ones it consumed
+        self._fetch_watch: list = []
 
     def run(self, program: Optional[Program] = None, feed: Optional[Dict[str, Any]] = None,
             fetch_list: Optional[List] = None, return_numpy: bool = True):
+        from ..framework.flags import flag as _flag
+        from ..profiler import counter_inc
+
+        counter_inc("executor.runs")
         prog = program if program is not None else _default_main
         if isinstance(prog, CompiledProgram):
             prog = prog._program
@@ -215,42 +288,52 @@ class Executor:
         if "__train_flag__" in prog.feeds:  # clone(for_test=True) flips to 0
             feed_arrays["__train_flag__"] = jnp.uint32(0 if getattr(prog, "for_test", False) else 1)
         missing = set(prog.feeds) - set(feed_arrays)
-        used_feeds = {n for op in prog.ops for kind, ref in op.inputs
-                      if kind == "sym" for n in [ref.name] if n in prog.feeds}
-        if missing & used_feeds:
-            raise ValueError(f"missing feeds: {sorted(missing & used_feeds)}")
+        if missing:
+            use_key = (prog.id, prog.version)
+            used_feeds = self._feed_use.get(use_key)
+            if used_feeds is None:  # computed once per program version
+                used_feeds = {n for op in prog.ops for kind, ref in op.inputs
+                              if kind == "sym" for n in [ref.name] if n in prog.feeds}
+                self._feed_use[use_key] = used_feeds
+            if missing & used_feeds:
+                raise ValueError(f"missing feeds: {sorted(missing & used_feeds)}")
 
         train = prog.optimizer is not None or bool(prog.grad_vars)
-        refs = prog.tensor_refs()
-        if train and prog.grad_vars:
-            # append_backward already applied parameter_list/no_grad_set
-            params = [t for t in refs if id(t) in prog.grad_vars]
-        elif train:
-            params = [t for t in refs if not t.stop_gradient]
-        else:
-            params = []
-        param_ids = {id(t) for t in params}
-        others = [t for t in refs if id(t) not in param_ids]
+        opt = prog.optimizer
+        donate = (bool(_flag("FLAGS_executor_donate")) and train
+                  and opt is not None and prog.loss_var is not None)
 
         feed_sig = tuple(sorted((k, v.shape, str(v.dtype)) for k, v in feed_arrays.items()))
-        key = (prog.id, prog.version, feed_sig, tuple(fetch_names), train)
-        if key not in self._cache:
-            from ..framework.flags import flag as _flag
-
+        key = (prog.id, prog.version, feed_sig, tuple(fetch_names), train, donate)
+        plan = self._cache.get(key)
+        if plan is None:
+            counter_inc("executor.cache_misses")
+            counter_inc("executor.compiles")
             if _flag("FLAGS_static_check"):
                 # pre-flight the program once per compiled specialization:
                 # warnings surface through the warnings module, error-severity
                 # diagnostics (e.g. a baked dynamic dim) abort before compile
                 self._static_check(prog, [n for n in fetch_names if n])
-            self._cache[key] = self._build(prog, tuple(sorted(feed_arrays)), fetch_names,
-                                           params, others, train)
+            refs = prog.tensor_refs()
+            if train and prog.grad_vars:
+                # append_backward already applied parameter_list/no_grad_set
+                params = [t for t in refs if id(t) in prog.grad_vars]
+            elif train:
+                params = [t for t in refs if not t.stop_gradient]
+            else:
+                params = []
+            param_ids = {id(t) for t in params}
+            others = [t for t in refs if id(t) not in param_ids]
+            fn = self._build(prog, tuple(sorted(feed_arrays)), fetch_names,
+                             params, others, train, donate)
+            plan = self._cache[key] = _RunPlan(fn, tuple(params), tuple(others), train, donate)
             while len(self._cache) > self._CACHE_CAPACITY:
                 self._cache.popitem(last=False)  # LRU eviction
         else:
+            counter_inc("executor.cache_hits")
             self._cache.move_to_end(key)
-        fn = self._cache[key]
+        params = plan.params
 
-        opt = prog.optimizer
         # keyed by param identity too: appending ops/params to the program
         # after a trained run must rebuild the state, not pair the stale
         # pytree with a different params list
@@ -264,8 +347,12 @@ class Executor:
         state = self._opt_states.get(opt_key) if train and opt is not None else None
 
         param_vals = tuple(p._value for p in params)
-        other_vals = tuple(t._value for t in others)
-        fetched, buf_updates, new_params, new_state = fn(feed_arrays, param_vals, other_vals, state)
+        other_vals = tuple(t._value for t in plan.others)
+        donated_ids = None
+        if donate:
+            donated_ids = {id(v) for v in param_vals}
+            donated_ids.update(id(l) for l in jax.tree_util.tree_leaves(state))
+        fetched, buf_updates, new_params, new_state = plan.fn(feed_arrays, param_vals, other_vals, state)
         if train and opt is not None:
             for p, v in zip(params, new_params):
                 p._value = v
@@ -273,25 +360,56 @@ class Executor:
         for buf, sym in prog.buffer_writes:  # commit running-stat updates
             if sym.name in buf_updates:
                 buf._value = buf_updates[sym.name]
+        if donate:
+            counter_inc("executor.donated_runs")
+            self._sweep_stale(donated_ids)
 
         # publish results into the active Scope (reference: the executor's
         # variables live in global_scope; find_var(...).get_tensor() works)
+        # — through the plan's cached Variable slots, not per-run gs.var()
         from ..framework.scope import global_scope as _gs
 
-        gs = _gs()
-        for p in params:
-            if getattr(p, "name", None):
-                gs.var(p.name)._value = p._value
+        plan.bind_scope(_gs(), fetch_names)
+        for p, var in plan.param_vars:
+            var._value = p._value
         out = []
+        track = bool(_flag("FLAGS_executor_donate")) and not return_numpy
         for i in range(len(fetch_list)):
             if i in passthrough:
                 v = passthrough[i]._value
             else:
                 v = fetched[fetch_names[i]]
                 if fetch_names[i]:
-                    gs.var(fetch_names[i])._value = v
-            out.append(np.asarray(v) if return_numpy else _wrap_value(v))
+                    plan.fetch_vars[fetch_names[i]]._value = v
+            if return_numpy:
+                out.append(np.asarray(v))  # host transfer = device sync
+            else:
+                t = _wrap_value(v)  # device handle, no sync
+                if track:
+                    import weakref
+
+                    self._fetch_watch.append(weakref.ref(t))
+                out.append(t)
         return out
+
+    def _sweep_stale(self, donated_ids):
+        """Poison previously returned device handles whose buffer the donated
+        run just consumed, so reuse raises StaleHandleError (clear story)
+        instead of XLA's deleted-buffer error."""
+        msg = ("this handle's device buffer was donated back to the compiled "
+               "program by a later Executor.run (FLAGS_executor_donate); "
+               "fetch it again, or copy it out (np.asarray / .numpy()) "
+               "before the next run")
+        alive = []
+        for ref in self._fetch_watch:
+            t = ref()
+            if t is None:
+                continue
+            if id(t._value) in donated_ids:
+                t._value = _StaleArray(msg)
+            else:
+                alive.append(ref)
+        self._fetch_watch = alive
 
     def _static_check(self, prog: Program, fetch_names):
         """FLAGS_static_check body: analyze, warn, raise on errors."""
@@ -307,7 +425,8 @@ class Executor:
         if errors:
             raise ProgramAnalysisError(errors)
 
-    def _build(self, prog: Program, feed_names, fetch_names, params, others, train):
+    def _build(self, prog: Program, feed_names, fetch_names, params, others, train,
+               donate=False):
         opt = prog.optimizer
         param_ids = [id(p) for p in params]
         other_ids = [id(t) for t in others]
@@ -347,6 +466,13 @@ class Executor:
                            if sym.name in env}
             return fetched, buf_updates, new_params, new_state
 
+        if donate:
+            # donate param_vals + opt state (the two pytrees the update
+            # rewrites): XLA reuses their buffers for the new values, so
+            # param memory stays flat across training runs. The consumed
+            # jax.Arrays are dead after the call — run() rebinds p._value
+            # and sweeps previously fetched handles (StaleHandleError).
+            return jax.jit(run_fn, donate_argnums=(1, 3))
         return jax.jit(run_fn)
 
 

@@ -572,3 +572,82 @@ def test_break_loop_is_differentiable_with_concrete_bounds():
     np.testing.assert_allclose(np.asarray(grad), [6.0])
     val = float(_np(g(paddle.to_tensor(x0))))
     assert val == 6.0
+
+
+def test_while_break_with_and_converts():
+    """Regression: the escape scan must not mistake the rewriter's own
+    __paddle_jst__.and_/or_/not_ helpers for paddle-style trailing-underscore
+    inplace calls — a while+break whose predicate uses `and` must still
+    convert to convert_while (previously it stayed a native loop and died
+    with TracerBoolConversionError under jit tracing)."""
+    import jax
+
+    def f(x):
+        s = x * 0
+        i = 0
+        while i < 6:
+            if i >= 2 and (x.sum() > 0):
+                break
+            s = s + x * i
+            i = i + 1
+        return s, i
+
+    g = transpile(f)
+    assert getattr(g, "_jst_transpiled", False)
+    x = paddle.to_tensor(np.array([1.0], np.float32))
+    # eager concrete: exact Python parity
+    fs, fi = f(x, )
+    gs, gi = g(x)
+    np.testing.assert_allclose(_np(gs), _np(fs))
+    assert int(gi) == int(fi) == 2
+
+    # traced (jit): the break flag turns traced MID-loop (concrete `i >= 2`
+    # short-circuit for i < 2, traced `x > 0` after) — the traced while
+    # resumes from the already-advanced loop vars
+    def run(xv):
+        s, i = g(paddle.to_tensor(xv))
+        return s._value, paddle.to_tensor(i)._value
+
+    s_val, i_val = jax.jit(run)(np.array([1.0], np.float32))
+    np.testing.assert_allclose(np.asarray(s_val), [1.0])  # 0*x + 1*x
+    assert int(np.asarray(i_val)) == 2
+    s_neg, i_neg = jax.jit(run)(np.array([-1.0], np.float32))
+    np.testing.assert_allclose(np.asarray(s_neg), [-15.0])  # -(0+..+5)
+    assert int(np.asarray(i_neg)) == 6
+
+
+def test_while_midloop_traced_flag_resumes_from_advanced_vals():
+    """When the de-sugared break flag turns traced mid-loop, convert_while
+    hands the ALREADY-ADVANCED vals to the traced loop: iterations completed
+    concretely run exactly once (Python) and the body is traced exactly once
+    more for the compiled remainder — not re-run per completed iteration."""
+    import jax
+
+    calls = {"n": 0}
+
+    def tick(v):
+        calls["n"] += 1
+        return v
+
+    def f(x):
+        s = x * 0
+        i = 0
+        while i < 6:
+            s = tick(s)
+            if i >= 2 and (x.sum() > 0):
+                break
+            s = s + x * i
+            i = i + 1
+        return s, i
+
+    g = transpile(f)
+
+    def run(xv):
+        s, i = g(paddle.to_tensor(xv))
+        return s._value, paddle.to_tensor(i)._value
+
+    s_val, i_val = jax.jit(run)(np.array([1.0], np.float32))
+    np.testing.assert_allclose(np.asarray(s_val), [1.0])
+    assert int(np.asarray(i_val)) == 2
+    # 3 concrete iterations (i=0,1,2) + exactly 1 trace of the remainder
+    assert calls["n"] == 4

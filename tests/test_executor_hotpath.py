@@ -1,0 +1,140 @@
+"""Executor hot path: run-plan caching, dispatch accounting, and the
+FLAGS_executor_donate zero-sync donated training path.
+
+Equivalence contract: a donated training loop produces bitwise the same
+losses/params as the non-donated path. Safety contract: a device handle
+fetched before a donated run raises StaleHandleError (not an opaque
+deleted-buffer crash) once its buffer has been donated back. Caching
+contract: a second identical run is a cache hit with 0 new compiles.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import profiler, static
+from paddle_tpu.framework.flags import set_flags
+
+
+@pytest.fixture
+def donate_flag():
+    set_flags({"FLAGS_executor_donate": True})
+    yield
+    set_flags({"FLAGS_executor_donate": False})
+
+
+def _build_train_program(seed=0):
+    paddle.seed(seed)
+    model = paddle.nn.Linear(4, 1)
+    main = static.Program()
+    with static.program_guard(main, static.Program()):
+        x = static.data("x", [None, 4])
+        yt = static.data("y", [None, 1])
+        loss = paddle.mean((model(x) - yt) ** 2)
+        paddle.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return main, loss, model
+
+
+def _train(runs=6):
+    rng = np.random.default_rng(0)
+    main, loss, model = _build_train_program()
+    exe = static.Executor()
+    losses = []
+    for _ in range(runs):
+        xv = rng.normal(size=(8, 4)).astype("float32")
+        yv = xv.sum(1, keepdims=True).astype("float32")
+        (lv,) = exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss])
+        losses.append(float(lv))
+    return losses, model.weight.numpy().copy(), (exe, main, loss, model)
+
+
+def test_donated_run_matches_non_donated(donate_flag):
+    set_flags({"FLAGS_executor_donate": False})
+    base_losses, base_w, _ = _train()
+    set_flags({"FLAGS_executor_donate": True})
+    don_losses, don_w, _ = _train()
+    assert base_losses == don_losses  # bitwise
+    np.testing.assert_array_equal(base_w, don_w)
+
+
+def test_donated_run_counter(donate_flag):
+    profiler.reset_counters("executor.")
+    _train(runs=4)
+    counts = profiler.counters("executor.")
+    assert counts["executor.runs"] == 4
+    assert counts["executor.donated_runs"] == 4
+    assert counts["executor.compiles"] == 1
+    assert counts["executor.cache_hits"] == 3
+
+
+def test_stale_fetch_handle_raises_clear_error(donate_flag):
+    _, _, (exe, main, loss, model) = _train(runs=2)
+    rng = np.random.default_rng(1)
+    xv = rng.normal(size=(8, 4)).astype("float32")
+    yv = xv.sum(1, keepdims=True).astype("float32")
+    feed = {"x": xv, "y": yv}
+    # fetch the weight as a device handle (no sync), then train once more:
+    # the donated run consumes the handle's buffer
+    (w_handle,) = exe.run(main, feed=feed, fetch_list=[model.weight],
+                          return_numpy=False)
+    assert w_handle.shape == [4, 1]  # live before the next run
+    exe.run(main, feed=feed, fetch_list=[loss])
+    with pytest.raises(static.StaleHandleError, match="donated"):
+        w_handle.numpy()
+    with pytest.raises(static.StaleHandleError, match="donated"):
+        _ = w_handle.shape
+    # the parameter Tensor itself was rebound to the new buffer: still live
+    assert model.weight.numpy().shape == (4, 1)
+
+
+def test_cache_hit_zero_new_compiles():
+    """CI invariant: a second identical Executor.run is a pure cache hit —
+    no new specialization compiles."""
+    main, loss, _ = _build_train_program()
+    exe = static.Executor()
+    xv = np.ones((8, 4), "float32")
+    feed = {"x": xv, "y": xv.sum(1, keepdims=True)}
+    profiler.reset_counters("executor.")
+    exe.run(main, feed=feed, fetch_list=[loss])
+    first = profiler.counters("executor.")
+    assert first["executor.compiles"] == 1
+    exe.run(main, feed=feed, fetch_list=[loss])
+    second = profiler.counters("executor.")
+    assert second["executor.compiles"] == 1  # 0 new compiles
+    assert second["executor.cache_hits"] == 1
+    # a new feed shape is a new specialization
+    xv2 = np.ones((16, 4), "float32")
+    exe.run(main, feed={"x": xv2, "y": xv2.sum(1, keepdims=True)}, fetch_list=[loss])
+    assert profiler.counters("executor.")["executor.compiles"] == 2
+
+
+def test_return_numpy_false_returns_device_tensor():
+    main, loss, _ = _build_train_program()
+    exe = static.Executor()
+    xv = np.ones((8, 4), "float32")
+    (lv,) = exe.run(main, feed={"x": xv, "y": xv.sum(1, keepdims=True)},
+                    fetch_list=[loss], return_numpy=False)
+    from paddle_tpu.framework.core import Tensor
+
+    assert isinstance(lv, Tensor)  # device handle, no forced host sync
+    assert float(lv.numpy()) >= 0.0
+
+
+def test_run_plan_scope_rebind():
+    """The cached scope-publish targets follow scope_guard switches."""
+    main, loss, model = _build_train_program()
+    model.weight.name = "w_rebind_test"  # named params publish to the scope
+    exe = static.Executor()
+    xv = np.ones((8, 4), "float32")
+    feed = {"x": xv, "y": xv.sum(1, keepdims=True)}
+    exe.run(main, feed=feed, fetch_list=[loss])
+    assert static.global_scope().find_var(loss._value.name) is not None
+    s = static.Scope()
+    with static.scope_guard(s):
+        exe.run(main, feed=feed, fetch_list=[loss])
+        assert s.find_var(loss._value.name) is not None
+        assert s.find_var("w_rebind_test") is not None
+    # back on the global scope, publishing resumes there with fresh values
+    exe.run(main, feed=feed, fetch_list=[loss])
+    np.testing.assert_array_equal(
+        np.asarray(static.global_scope().find_var("w_rebind_test")._value),
+        model.weight.numpy())

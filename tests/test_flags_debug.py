@@ -40,3 +40,29 @@ def test_benchmark_flag_collects_per_op_stats():
     _ = a + b
     stats = benchmark_stats()
     assert any(s["count"] >= 2 and s["total_s"] > 0 for s in stats.values()), stats
+
+
+def test_compile_cache_dir_flag_applies_to_jax_config(tmp_path):
+    """FLAGS_compile_cache_dir pushes jax_compilation_cache_dir (persistent
+    XLA compile cache) — set_flags applies it immediately via the on-set
+    hook, and the min-compile-time floor is dropped so small programs cache
+    too. Env spelling: FLAGS_compile_cache_dir=/path at process start."""
+    import jax
+
+    prev = jax.config.jax_compilation_cache_dir
+    prev_floor = jax.config.jax_persistent_cache_min_compile_time_secs
+    d = str(tmp_path / "xla_cache")
+    try:
+        flags.set_flags({"FLAGS_compile_cache_dir": d})
+        assert jax.config.jax_compilation_cache_dir == d
+        assert jax.config.jax_persistent_cache_min_compile_time_secs == 0
+        assert flags.get_flags("FLAGS_compile_cache_dir")["FLAGS_compile_cache_dir"] == d
+    finally:
+        flags._REGISTRY["FLAGS_compile_cache_dir"] = ""
+        jax.config.update("jax_compilation_cache_dir", prev)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", prev_floor)
+
+
+def test_executor_donate_flag_registered():
+    got = flags.get_flags(["FLAGS_executor_donate", "FLAGS_compile_cache_dir"])
+    assert got["FLAGS_executor_donate"] is False
