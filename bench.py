@@ -98,6 +98,23 @@ def _measure(flash_flat: bool):
         "dispatches_per_step": round(
             counts["train_step.dispatches"] / counts["train_step.steps"], 4),
     }
+    # observability snapshot: dispatch counters + span-histogram summaries
+    # (p50/p90/p99 step/compile timings), plus the per-specialization XLA
+    # cost rows behind TrainStep.explain()
+    from paddle_tpu import observability
+
+    snap = observability.metrics.snapshot()
+    extras["metrics"] = {
+        "counters": {k: v for k, v in snap["counters"].items() if v},
+        "histograms": snap["histograms"],
+    }
+    cost_rows = step.explain()
+    if cost_rows:
+        extras["cost"] = {k: cost_rows[0].get(k) for k in
+                          ("flops", "bytes_accessed", "peak_bytes",
+                           "compile_seconds")}
+        # stdout carries only the JSON result line; the table is operator aid
+        print(observability.format_cost_table(cost_rows), file=sys.stderr)
     config_key = f"{d0.device_kind or d0.platform}/h{cfg.hidden_size}L{cfg.num_layers}b{batch}s{seq}/amp={amp_level}"
     return tokens_per_sec, config_key, on_tpu, extras
 
@@ -190,6 +207,10 @@ def main():
         "steps_per_sec": extras.get("steps_per_sec"),
         "steps_per_sec_fused": extras.get("steps_per_sec_fused"),
         "dispatches_per_step": extras.get("dispatches_per_step"),
+        # observability snapshot (counters + span-histogram summaries) and
+        # the compiled-specialization cost captured at TrainStep compile
+        "metrics": extras.get("metrics"),
+        "cost": extras.get("cost"),
     }))
 
 

@@ -72,10 +72,13 @@ class CostModel:
             lowered = jit_fn.lower(feed_arrays, tuple(p._value for p in params),
                                    tuple(t._value for t in others), state)
         compiled = lowered.compile()
-        cost = compiled.cost_analysis() or {}
-        if isinstance(cost, (list, tuple)):  # older jax: one dict per device
-            cost = cost[0] if cost else {}
-        mem = compiled.memory_analysis()
+        from ..framework.jax_compat import (
+            compiled_cost_analysis,
+            compiled_memory_analysis,
+        )
+
+        cost = compiled_cost_analysis(compiled)
+        mem = compiled_memory_analysis(compiled)
         out = {
             "flops": float(cost.get("flops", 0.0)),
             "bytes_accessed": float(cost.get("bytes accessed", 0.0)),

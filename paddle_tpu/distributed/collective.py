@@ -13,6 +13,31 @@ import jax
 import jax.numpy as jnp
 
 from ..framework.core import Tensor, _wrap_value, unwrap
+from ..observability import span as _span
+from ..observability.metrics import counter_inc as _counter_inc
+
+
+def _collective(name):
+    """Telemetry wrapper: every collective entry bumps
+    ``collective.<name>.calls`` and runs under a ``collective.<name>`` span.
+    Inside a shard_map/jit trace the span measures trace time (the dispatch
+    XLA sees); for eager concrete arrays it covers the actual execution."""
+
+    def deco(fn):
+        import functools
+
+        counter = f"collective.{name}.calls"
+        span_name = f"collective.{name}"
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            _counter_inc(counter)
+            with _span(span_name):
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+    return deco
 
 
 class ReduceOp:
@@ -31,6 +56,7 @@ def _axis(group):
     return getattr(group, "axis_name", "dp")
 
 
+@_collective("all_reduce")
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     ax = _axis(group)
     v = unwrap(tensor)
@@ -50,6 +76,7 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     return out
 
 
+@_collective("all_gather")
 def all_gather(tensor_list, tensor=None, group=None, sync_op=True, axis=0):
     ax = _axis(group)
     v = unwrap(tensor if tensor is not None else tensor_list)
@@ -62,11 +89,13 @@ def all_gather(tensor_list, tensor=None, group=None, sync_op=True, axis=0):
     return out
 
 
+@_collective("all_gather_concat")
 def all_gather_concat(x, group=None, concat_axis=0):
     ax = _axis(group)
     return jax.lax.all_gather(unwrap(x), ax, axis=concat_axis, tiled=True)
 
 
+@_collective("reduce_scatter")
 def reduce_scatter(output, input, op=ReduceOp.SUM, group=None, sync_op=True, scatter_axis=0):
     ax = _axis(group)
     v = unwrap(input)
@@ -77,6 +106,7 @@ def reduce_scatter(output, input, op=ReduceOp.SUM, group=None, sync_op=True, sca
     return out
 
 
+@_collective("alltoall")
 def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True, split_axis=0, concat_axis=0):
     ax = _axis(group)
     if isinstance(in_tensor_list, (list, tuple)):
@@ -93,6 +123,7 @@ def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True, spl
 all_to_all = alltoall
 
 
+@_collective("broadcast")
 def broadcast(tensor, src=0, group=None, sync_op=True):
     """Inside shard_map all ranks trace identically; broadcast = take src's
     value. Implemented as psum of masked value (the XLA idiom)."""
@@ -112,6 +143,7 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
     return all_reduce(tensor, op=op, group=group)
 
 
+@_collective("scatter")
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     ax = _axis(group)
     if tensor_list is not None:
@@ -127,6 +159,7 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     return out
 
 
+@_collective("ppermute")
 def ppermute(x, perm, group=None):
     """collective_permute (reference send_v2/recv_v2 pairs,
     operators/collective/send_v2_op.cu.cc:162)."""
@@ -145,6 +178,7 @@ def send(tensor, dst=0, group=None, sync_op=True):
 recv = send
 
 
+@_collective("barrier")
 def barrier(group=None):
     """No-op under a single controller: program order is the barrier."""
     return None

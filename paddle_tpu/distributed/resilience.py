@@ -39,6 +39,9 @@ import time
 from typing import Any, Callable, List, Optional, Tuple
 
 from ..framework.flags import flag
+from ..observability import runlog as _runlog
+from ..observability import span as _span
+from ..observability.metrics import counter_inc as _counter_inc
 from ..testing import chaos
 from . import checkpoint as ckpt_mod
 from .store import BarrierTimeoutError  # noqa: F401  (re-export: one seam)
@@ -102,6 +105,14 @@ class CheckpointManager:
     # --------------------------------------------------------------- save
     def save(self, state: Any, step: int) -> str:
         """Atomically publish ``state`` as the checkpoint for ``step``."""
+        with _span("checkpoint.save") as sp:
+            final = self._save(state, step)
+        _counter_inc("checkpoint.saves")
+        _runlog.emit("checkpoint_save", step=step, path=final,
+                     seconds=sp.seconds)
+        return final
+
+    def _save(self, state: Any, step: int) -> str:
         final = self._step_dir(step)
         tmp = os.path.join(self.directory, f".tmp-step_{step:08d}-{os.getpid()}")
         if os.path.exists(tmp):
@@ -122,6 +133,8 @@ class CheckpointManager:
         os.rename(tmp, final)
         if chaos.corrupt_due():
             _corrupt_array_data(final)
+            _runlog.emit("chaos_inject", step=step, kind="corrupt_ckpt",
+                         path=final)
         self.gc()
         return final
 
@@ -132,14 +145,21 @@ class CheckpointManager:
         """(state, step) from the newest checkpoint that passes
         verification, walking backwards past corrupt/truncated ones;
         None when no valid checkpoint exists."""
-        for step in reversed(self.steps()):
-            try:
-                return self._load_verified(step, target, shardings), step
-            except Exception as exc:
-                print(f"[resilience] checkpoint step {step} invalid "
-                      f"({type(exc).__name__}: {exc}); falling back",
-                      file=sys.stderr)
-        return None
+        with _span("checkpoint.restore") as sp:
+            result = None
+            for step in reversed(self.steps()):
+                try:
+                    result = self._load_verified(step, target, shardings), step
+                    break
+                except Exception as exc:
+                    print(f"[resilience] checkpoint step {step} invalid "
+                          f"({type(exc).__name__}: {exc}); falling back",
+                          file=sys.stderr)
+        if result is not None:
+            _counter_inc("checkpoint.restores")
+            _runlog.emit("checkpoint_restore", step=result[1],
+                         seconds=sp.seconds)
+        return result
 
     def _load_verified(self, step: int, target, shardings) -> Any:
         d = self._step_dir(step)
@@ -267,6 +287,8 @@ def watchdog(name: str, timeout: Optional[float] = None,
 
         def fire():
             elapsed = time.monotonic() - t0
+            _runlog.emit("collective_timeout", name=name, seconds=elapsed,
+                         deadline=tmo)
             if on_timeout is not None:
                 on_timeout(name, elapsed)
             else:
@@ -333,6 +355,15 @@ def run_resilient(train_step_fn: Callable[[Any, int, List[int]], Any], *,
         if on_event is not None:
             on_event(kind, info)
 
+    def _membership_events(old, new, step_):
+        for node_id in sorted(set(new) - set(old)):
+            _runlog.emit("worker_join", step=step_, node=node_id,
+                         members=list(new))
+        for node_id in sorted(set(old) - set(new)):
+            _runlog.emit("worker_leave", step=step_, node=node_id,
+                         members=list(new))
+
+    _membership_events([], members, step)
     _emit("start", step=step, members=members)
     while step < num_steps:
         try:
@@ -350,8 +381,10 @@ def run_resilient(train_step_fn: Callable[[Any, int, List[int]], Any], *,
             _emit("hold", step=step, fault=repr(fault), restart=restarts)
             manager.save(state, step)  # HOLD: make current progress durable
             time.sleep(backoff * (2 ** (restarts - 1)))
+            prev_members = members
             members = node.wait_for(min_nodes, max_nodes, settle=settle,
                                     deadline=deadline)
+            _membership_events(prev_members, members, step)
             restored = manager.restore_latest(target=state)
             if restored is not None:
                 state, step = restored

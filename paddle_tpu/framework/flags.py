@@ -84,6 +84,10 @@ def _apply_compile_cache_dir(path):
 
 on_flag_set("FLAGS_compile_cache_dir", _apply_compile_cache_dir)
 
+# Observability spine (paddle_tpu/observability/).
+define_flag("FLAGS_monitor", True, "always-on runtime telemetry: step/compile/checkpoint run-log events, timeline spans and span histograms (spans become no-ops when off)")
+define_flag("FLAGS_run_log_dir", "", "directory for the structured run log (JSONL, one run-<pid>.jsonl per process); empty keeps events only in the in-memory ring")
+
 # Fault-tolerance runtime (distributed/resilience.py).
 define_flag("FLAGS_collective_timeout_s", 0.0, "watchdog: report a cross-process collective still pending after this many seconds (0 = off)")
 

@@ -69,3 +69,26 @@ except ImportError:  # pragma: no cover
         jax.export = _export
     except ImportError:
         pass
+
+
+def compiled_cost_analysis(compiled) -> dict:
+    """``Compiled.cost_analysis()`` normalized across jax versions/backends:
+    older jax returns one dict per device (take the first), some backends
+    return None or raise — both become ``{}`` so CPU-only CI sees the same
+    call succeed."""
+    try:
+        cost = compiled.cost_analysis()
+    except Exception:
+        return {}
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else {}
+    return dict(cost) if cost else {}
+
+
+def compiled_memory_analysis(compiled):
+    """``Compiled.memory_analysis()`` or None when the backend does not
+    implement it (fields are read with getattr by callers)."""
+    try:
+        return compiled.memory_analysis()
+    except Exception:
+        return None

@@ -115,6 +115,56 @@ class ProgBarLogger(Callback):
             print(f"Eval - {items}")
 
 
+class MetricsLogger(Callback):
+    """Bridge ``Model.fit``/``evaluate`` into the observability spine
+    (paddle_tpu.observability): per-batch scalar logs become gauges
+    (``hapi.loss``, ``hapi.lr``, …), batch latency feeds the ``hapi.step``
+    histogram, and epoch/eval summaries are emitted as structured run-log
+    events. Appended automatically by ``config_callbacks`` when
+    ``FLAGS_monitor`` is on."""
+
+    @staticmethod
+    def _scalars(logs):
+        return {k: float(np.asarray(v)) for k, v in (logs or {}).items()
+                if np.ndim(v) == 0}
+
+    def on_train_begin(self, logs=None):
+        from ..observability import runlog
+
+        self._t = None
+        runlog.emit("fit_begin", epochs=self.params.get("epochs"),
+                    steps=self.params.get("steps"))
+
+    def on_train_batch_begin(self, step, logs=None):
+        self._t = time.perf_counter()
+
+    def on_train_batch_end(self, step, logs=None):
+        from ..framework.flags import flag
+        from ..observability import metrics
+
+        if not flag("FLAGS_monitor"):
+            return
+        if getattr(self, "_t", None) is not None:
+            metrics.observe("hapi.step", time.perf_counter() - self._t)
+        for k, v in self._scalars(logs).items():
+            metrics.gauge_set(f"hapi.{k}", v)
+
+    def on_epoch_end(self, epoch, logs=None):
+        from ..observability import runlog
+
+        runlog.emit("epoch", epoch=int(epoch), **self._scalars(logs))
+
+    def on_eval_end(self, logs=None):
+        from ..observability import runlog
+
+        runlog.emit("eval", **self._scalars(logs))
+
+    def on_train_end(self, logs=None):
+        from ..observability import runlog
+
+        runlog.emit("fit_end", **self._scalars(logs))
+
+
 class ModelCheckpoint(Callback):
     """Save `<save_dir>/{epoch}` every save_freq epochs + `<save_dir>/final`
     (reference ModelCheckpoint semantics)."""
@@ -204,8 +254,12 @@ class EarlyStopping(Callback):
 def config_callbacks(callbacks=None, model=None, epochs=None, steps=None, log_freq=10, verbose=2, metrics=None, mode="train"):
     """Parity: hapi/callbacks.py config_callbacks — ensure a ProgBarLogger
     is present and bind model/params."""
+    from ..framework.flags import flag
+
     cbks = list(callbacks or [])
     if verbose and not any(isinstance(c, ProgBarLogger) for c in cbks):
         cbks.append(ProgBarLogger(log_freq, verbose=verbose))
+    if flag("FLAGS_monitor") and not any(isinstance(c, MetricsLogger) for c in cbks):
+        cbks.append(MetricsLogger())
     params = {"epochs": epochs, "steps": steps, "verbose": verbose, "metrics": metrics or []}
     return CallbackList(cbks, model=model, params=params)

@@ -15,6 +15,8 @@ from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 
+from ..observability import span as _span
+from ..observability.metrics import counter_inc as _counter_inc
 from .dataset import BatchSampler, Dataset, IterableDataset
 
 
@@ -56,7 +58,14 @@ def stack_batches(it, k, to_device=True):
     if not to_device:
         yield from stacks()
         return
-    put = lambda b: jax.tree_util.tree_map(jax.device_put, b)
+
+    def put(b):
+        # async under PJRT: the span times the host-side issue, the transfer
+        # itself overlaps the in-flight step
+        with _span("dataloader.device_put"):
+            _counter_inc("dataloader.device_puts")
+            return jax.tree_util.tree_map(jax.device_put, b)
+
     prev = None
     for stack in stacks():
         nxt = put(stack)
@@ -133,7 +142,10 @@ class DataLoader:
         return len(self.batch_sampler)
 
     def _fetch(self, indices):
-        return self.collate_fn([self.dataset[i] for i in indices])
+        with _span("dataloader.fetch"):
+            batch = self.collate_fn([self.dataset[i] for i in indices])
+        _counter_inc("dataloader.batches")
+        return batch
 
     def __iter__(self):
         if self._iterable_mode:
@@ -201,7 +213,11 @@ class DataLoader:
         (device_put is async under PJRT)."""
         import jax
 
-        put = lambda b: jax.tree_util.tree_map(jax.device_put, b)
+        def put(b):
+            with _span("dataloader.device_put"):
+                _counter_inc("dataloader.device_puts")
+                return jax.tree_util.tree_map(jax.device_put, b)
+
         prev = None
         for batch in it:
             nxt = put(batch)
@@ -212,14 +228,20 @@ class DataLoader:
             yield prev
 
     def _iter_iterable(self):
+        def collate(b):
+            with _span("dataloader.fetch"):
+                out = self.collate_fn(b)
+            _counter_inc("dataloader.batches")
+            return out
+
         batch = []
         for sample in self.dataset:
             batch.append(sample)
             if len(batch) == self.batch_size:
-                yield self.collate_fn(batch)
+                yield collate(batch)
                 batch = []
         if batch and not self.drop_last:
-            yield self.collate_fn(batch)
+            yield collate(batch)
 
     def _iter_threaded(self):
         with ThreadPoolExecutor(max_workers=self.num_workers) as pool:
@@ -245,4 +267,8 @@ class DataLoader:
                 fut = pending.get()
                 in_flight -= 1
                 submit_next()
-                yield fut.result()
+                # the prefetch span is the stall: time the consumer spent
+                # blocked on a worker batch (0 when workers keep up)
+                with _span("dataloader.prefetch"):
+                    batch = fut.result()
+                yield batch

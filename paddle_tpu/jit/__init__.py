@@ -97,6 +97,12 @@ class TrainStep:
         else:
             self._jit = jax.jit(self._step, donate_argnums=0)
             self._jit_multi = jax.jit(self._multi_step, donate_argnums=0)
+        # observability: per-batch-signature AOT executables (the retained
+        # XLA Compiled handles behind explain()), their cost rows, and the
+        # host-side step counter the run log indexes by
+        self._compiled: Dict[tuple, Any] = {}
+        self._specializations: list = []
+        self._host_step = 0
 
     def _build(self, remat):
         model, optimizer, loss_fn = self.model, self.optimizer, self.loss_fn
@@ -216,14 +222,60 @@ class TrainStep:
         return tuple(unwrap(v) if isinstance(v, Tensor) else jnp.asarray(v)
                      for v in (x if isinstance(x, (list, tuple)) else [x]))
 
+    def _dispatch(self, which, jitfn, batch):
+        """Run one compiled dispatch, compiling through the AOT path on a
+        new (kind, batch-shape) signature so the XLA Compiled handle — the
+        only source of cost_analysis/memory_analysis — is retained for the
+        run log and :meth:`explain`. Falls back to the plain jitted call
+        whenever AOT is unavailable; dispatch never breaks for telemetry."""
+        sig = (which,) + tuple((tuple(l.shape), str(l.dtype))
+                               for l in jax.tree_util.tree_leaves(batch))
+        entry = self._compiled.get(sig)
+        if entry is None:
+            from ..observability import introspect as _introspect
+            from ..observability import runlog as _runlog
+            from ..observability import span as _span
+            from ..profiler import counter_inc
+
+            label = which + "/" + ",".join(
+                f"{d}{list(s)}" for s, d in sig[1:5])  # first few batch leaves
+            with _span("train_step.compile"):
+                compiled, info = _introspect.aot_compile(jitfn, (self.state, batch))
+            entry = compiled if compiled is not None else jitfn
+            self._compiled[sig] = entry
+            counter_inc("train_step.compiles")
+            info["label"] = label
+            info["kind"] = which
+            self._specializations.append(info)
+            _runlog.emit("compile", component="train_step", label=label,
+                         seconds=info.get("compile_seconds"),
+                         flops=info.get("flops"),
+                         bytes_accessed=info.get("bytes_accessed"),
+                         peak_bytes=info.get("peak_bytes"))
+        try:
+            return entry(self.state, batch)
+        except (TypeError, ValueError):
+            if entry is jitfn:
+                raise
+            # AOT executables validate avals strictly; on drift fall back to
+            # the jitted path permanently for this signature
+            self._compiled[sig] = jitfn
+            return jitfn(self.state, batch)
+
     def __call__(self, inputs, labels):
-        inputs = self._as_arrays(inputs)
-        labels = self._as_arrays(labels)
-        self.state, metrics = self._jit(self.state, (inputs, labels))
+        from ..observability import runlog as _runlog
+        from ..observability import span as _span
         from ..profiler import counter_inc
 
+        inputs = self._as_arrays(inputs)
+        labels = self._as_arrays(labels)
+        with _span("train_step.step") as sp:
+            self.state, metrics = self._dispatch("step", self._jit, (inputs, labels))
         counter_inc("train_step.dispatches")
         counter_inc("train_step.steps")
+        self._host_step += 1
+        _runlog.emit("step", step=self._host_step, component="train_step",
+                     k=1, seconds=sp.seconds)
         return {k: _wrap_tree(v) for k, v in metrics.items()}
 
     def run_steps(self, batches, k=None):
@@ -262,12 +314,26 @@ class TrainStep:
                         f"pre-stacked batch leaf has leading dim {leaf.shape[:1]}, "
                         f"expected ({k},); pass per-step batches without k= to "
                         "have run_steps stack them")
-        self.state, metrics = self._jit_multi(self.state, stacked)
+        from ..observability import runlog as _runlog
+        from ..observability import span as _span
         from ..profiler import counter_inc
 
+        with _span("train_step.run_steps") as sp:
+            self.state, metrics = self._dispatch("run_steps", self._jit_multi, stacked)
         counter_inc("train_step.dispatches")
         counter_inc("train_step.steps", k)
+        self._host_step += k
+        _runlog.emit("step", step=self._host_step, component="train_step",
+                     k=k, seconds=sp.seconds)
         return {name: _wrap_tree(v) for name, v in metrics.items()}
+
+    def explain(self) -> list:
+        """Per-specialization cost table: one row per compiled (kind,
+        batch-shape) signature with the XLA ``cost_analysis``/
+        ``memory_analysis`` captured at compile time (flops, bytes accessed,
+        peak device memory, compile seconds). Render with
+        ``paddle_tpu.observability.format_cost_table``; bench.py prints it."""
+        return list(self._specializations)
 
     # -- interop -----------------------------------------------------------
     def sync_to_model(self):

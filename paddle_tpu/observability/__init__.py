@@ -1,0 +1,53 @@
+"""paddle_tpu.observability — the unified runtime telemetry spine.
+
+Reference parity: the platform/profiler layer's always-on accounting
+(per-tracer op/run counts, host tracer, chrome-trace export) grown into a
+production observability stack for the TPU runtime. Four pieces:
+
+- :mod:`.metrics` — counters + gauges + bounded histograms with a
+  Prometheus text exporter and a JSON snapshot (``snapshot()``).
+- :mod:`.runlog` — the :class:`Monitor`: structured JSONL run-log events
+  (``step``, ``compile``, ``checkpoint_save``/``restore``,
+  ``collective_timeout``, ``worker_join``/``leave``, ``chaos_inject``)
+  written to ``FLAGS_run_log_dir``.
+- :mod:`.spans` — nestable ``span(name)`` timing sections flowing into both
+  the chrome-trace export (via profiler.RecordEvent) and per-span
+  histograms.
+- :mod:`.introspect` — compiled-program cost capture
+  (``cost_analysis``/``memory_analysis`` at every Executor/TrainStep
+  compile) behind ``Executor.explain()`` / ``TrainStep.explain()``.
+
+Everything is gated by ``FLAGS_monitor`` (default on; spans and events
+become no-ops when off) and reading the run log back is
+``python -m paddle_tpu.observability report <run.jsonl>``.
+"""
+from __future__ import annotations
+
+from . import introspect, metrics, runlog, spans  # noqa: F401
+from .introspect import cost_summary, format_cost_table  # noqa: F401
+from .metrics import observe, prometheus_text, snapshot  # noqa: F401
+from .runlog import Monitor, emit, monitor  # noqa: F401
+from .spans import Span, span  # noqa: F401
+
+__all__ = [
+    "metrics", "runlog", "spans", "introspect", "Monitor", "monitor",
+    "emit", "span", "Span", "observe", "snapshot", "prometheus_text",
+    "cost_summary", "format_cost_table",
+]
+
+# Pre-declare the runtime's counter series so a Prometheus scrape (or the
+# bench snapshot) sees the full set from process start, zeros included —
+# absent-vs-zero is a real distinction for dashboards.
+for _name in (
+    "executor.runs", "executor.cache_hits", "executor.cache_misses",
+    "executor.compiles", "executor.donated_runs",
+    "train_step.dispatches", "train_step.steps", "train_step.compiles",
+    "dataloader.batches", "dataloader.device_puts",
+    "collective.all_reduce.calls", "collective.all_gather.calls",
+    "collective.reduce_scatter.calls", "collective.alltoall.calls",
+    "collective.broadcast.calls", "collective.barrier.calls",
+    "checkpoint.saves", "checkpoint.restores",
+    "profiler.steps",
+):
+    metrics.declare_counter(_name)
+del _name

@@ -1,0 +1,131 @@
+"""Run-log reader: ``python -m paddle_tpu.observability report <run.jsonl>``.
+
+Prints, from one structured run log (see :mod:`.runlog`):
+
+- event counts per kind and the run's wall span,
+- a per-phase time breakdown (every event carrying ``seconds``, grouped by
+  event kind / component — compile vs step vs checkpoint vs dataloader),
+- step-time percentiles (p50/p90/p99) and fused-dispatch stats.
+
+``--json`` emits the same analysis as one JSON object for tooling.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import defaultdict
+from typing import List
+
+
+def load_events(path: str) -> List[dict]:
+    events = []
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(json.loads(line))
+            except json.JSONDecodeError:
+                print(f"[report] {path}:{lineno}: unparseable line skipped",
+                      file=sys.stderr)
+    return events
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = (q / 100.0) * (len(sorted_vals) - 1)
+    lo = int(idx)
+    hi = min(lo + 1, len(sorted_vals) - 1)
+    return sorted_vals[lo] + (sorted_vals[hi] - sorted_vals[lo]) * (idx - lo)
+
+
+def analyze(events: List[dict]) -> dict:
+    counts: dict = defaultdict(int)
+    phase_seconds: dict = defaultdict(float)
+    step_secs: List[float] = []
+    step_count = 0
+    for ev in events:
+        kind = ev.get("event", "?")
+        counts[kind] += 1
+        secs = ev.get("seconds")
+        if isinstance(secs, (int, float)):
+            comp = ev.get("component")
+            phase_seconds[f"{kind}[{comp}]" if comp else kind] += secs
+        if kind == "step":
+            step_count += int(ev.get("k", 1))
+            if isinstance(secs, (int, float)):
+                k = max(int(ev.get("k", 1)), 1)
+                step_secs.extend([secs / k] * k)
+    step_secs.sort()
+    ts = [ev["ts"] for ev in events if isinstance(ev.get("ts"), (int, float))]
+    wall = (max(ts) - min(ts)) if len(ts) > 1 else 0.0
+    out = {
+        "events": sum(counts.values()),
+        "wall_seconds": wall,
+        "counts": dict(sorted(counts.items())),
+        "phase_seconds": dict(sorted(phase_seconds.items(),
+                                     key=lambda kv: -kv[1])),
+        "steps": step_count,
+    }
+    if step_secs:
+        total = sum(step_secs)
+        out["step_time"] = {
+            "count": len(step_secs),
+            "total_seconds": total,
+            "mean_seconds": total / len(step_secs),
+            "p50_seconds": _percentile(step_secs, 50),
+            "p90_seconds": _percentile(step_secs, 90),
+            "p99_seconds": _percentile(step_secs, 99),
+            "steps_per_sec": (len(step_secs) / total) if total > 0 else None,
+        }
+    return out
+
+
+def print_report(path: str, a: dict) -> None:
+    print(f"run log: {path}")
+    print(f"  events: {a['events']}  wall: {a['wall_seconds']:.3f}s  "
+          f"steps: {a['steps']}")
+    print("  event counts:")
+    for kind, n in a["counts"].items():
+        print(f"    {kind:<22} {n}")
+    if a["phase_seconds"]:
+        total = sum(a["phase_seconds"].values())
+        print("  per-phase time (instrumented host spans):")
+        for phase, secs in a["phase_seconds"].items():
+            pct = 100.0 * secs / total if total else 0.0
+            print(f"    {phase:<28} {secs:9.4f}s  {pct:5.1f}%")
+    st = a.get("step_time")
+    if st:
+        print("  step time (per training step, host dispatch span):")
+        print(f"    mean {st['mean_seconds'] * 1e3:.3f} ms   "
+              f"p50 {st['p50_seconds'] * 1e3:.3f} ms   "
+              f"p90 {st['p90_seconds'] * 1e3:.3f} ms   "
+              f"p99 {st['p99_seconds'] * 1e3:.3f} ms")
+        if st.get("steps_per_sec"):
+            print(f"    {st['steps_per_sec']:.2f} steps/sec (dispatch-span based)")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="python -m paddle_tpu.observability")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    rep = sub.add_parser("report", help="summarize a run-log JSONL file")
+    rep.add_argument("path", help="run-log .jsonl written under FLAGS_run_log_dir")
+    rep.add_argument("--json", action="store_true", help="emit the analysis as JSON")
+    args = p.parse_args(argv)
+    events = load_events(args.path)
+    if not events:
+        print(f"[report] no events in {args.path}", file=sys.stderr)
+        return 1
+    a = analyze(events)
+    if args.json:
+        print(json.dumps(a, indent=2))
+    else:
+        print_report(args.path, a)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,97 @@
+"""Compiled-program introspection: what does each specialization cost?
+
+At every Executor/TrainStep compile the runtime lowers through jax.jit's
+AOT path (``.lower(...).compile()``) so the XLA ``Compiled`` handle — the
+only object that answers ``cost_analysis()``/``memory_analysis()`` — is
+retained instead of being buried in jit's internal cache. The analysis is
+normalized by ``framework.jax_compat`` (older jax returns a list of
+per-device dicts; CPU builds omit fields) into a flat dict::
+
+    {"flops", "bytes_accessed", "argument_bytes", "output_bytes",
+     "temp_bytes", "peak_bytes", "generated_code_bytes"}
+
+``Executor.explain()`` / ``TrainStep.explain()`` return one such row per
+cached specialization; :func:`format_cost_table` renders them for humans
+(bench.py prints it).
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..framework import jax_compat
+
+__all__ = ["cost_summary", "aot_compile", "format_cost_table"]
+
+
+def cost_summary(compiled) -> Dict[str, Any]:
+    """Normalized cost/memory analysis of one XLA ``Compiled`` executable.
+    Every field degrades to None when the backend does not report it, so
+    CPU-only CI sees the same schema as TPU."""
+    cost = jax_compat.compiled_cost_analysis(compiled)
+    mem = jax_compat.compiled_memory_analysis(compiled)
+    arg = getattr(mem, "argument_size_in_bytes", None)
+    out_b = getattr(mem, "output_size_in_bytes", None)
+    tmp = getattr(mem, "temp_size_in_bytes", None)
+    gen = getattr(mem, "generated_code_size_in_bytes", None)
+    peak = None
+    known = [b for b in (arg, out_b, tmp) if b is not None]
+    if known:
+        # XLA's own peak stat when present; else the live-set upper bound
+        peak = getattr(mem, "peak_memory_in_bytes", None) or sum(known)
+    return {
+        "flops": float(cost["flops"]) if "flops" in cost else None,
+        "bytes_accessed": float(cost["bytes accessed"]) if "bytes accessed" in cost else None,
+        "argument_bytes": arg,
+        "output_bytes": out_b,
+        "temp_bytes": tmp,
+        "peak_bytes": peak,
+        "generated_code_bytes": gen,
+    }
+
+
+def aot_compile(jitfn, args: Tuple) -> Tuple[Optional[Any], Dict[str, Any]]:
+    """Lower + compile ``jitfn`` on ``args`` through the AOT path.
+
+    Returns ``(compiled, info)`` where ``compiled`` is the callable XLA
+    executable (donation/sharding from the jit wrapper preserved) and
+    ``info`` is :func:`cost_summary` plus ``compile_seconds``. On any
+    failure returns ``(None, {...})`` so callers fall back to the plain
+    jitted call — introspection must never break dispatch.
+    """
+    t0 = time.perf_counter()
+    try:
+        compiled = jitfn.lower(*args).compile()
+    except Exception as exc:  # AOT unsupported for this fn/args shape
+        return None, {"compile_seconds": time.perf_counter() - t0,
+                      "aot_error": f"{type(exc).__name__}: {exc}"}
+    info = cost_summary(compiled)
+    info["compile_seconds"] = time.perf_counter() - t0
+    return compiled, info
+
+
+_COLUMNS = (
+    ("flops", "GFLOP", 1e9),
+    ("bytes_accessed", "MB moved", 1e6),
+    ("peak_bytes", "MB peak", 1e6),
+    ("compile_seconds", "compile s", 1.0),
+)
+
+
+def format_cost_table(rows: List[dict], title: str = "specialization") -> str:
+    """Human-readable per-specialization cost table from ``explain()`` rows."""
+    if not rows:
+        return "(no compiled specializations)"
+    header = [title] + [label for _, label, _ in _COLUMNS]
+    body = []
+    for row in rows:
+        cells = [str(row.get("label", row.get("key", "?")))]
+        for field, _, scale in _COLUMNS:
+            v = row.get(field)
+            cells.append("-" if v is None else f"{v / scale:.3f}")
+        body.append(cells)
+    widths = [max(len(r[i]) for r in [header] + body) for i in range(len(header))]
+    fmt = "  ".join(f"{{:<{w}}}" for w in widths)
+    lines = [fmt.format(*header), fmt.format(*["-" * w for w in widths])]
+    lines += [fmt.format(*r) for r in body]
+    return "\n".join(lines)

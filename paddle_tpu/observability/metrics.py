@@ -1,0 +1,239 @@
+"""Metrics registry: counters + gauges + bounded histograms.
+
+Generalizes the bare dispatch counters PR 3 put in ``paddle_tpu.profiler``
+(reference: the per-tracer op/run accounting in platform/profiler) into the
+single always-on metrics store for the runtime. Design constraints:
+
+- **Hot path is one dict operation.** ``counter_inc``/``observe`` do a
+  single dict lookup + in-place mutation under the GIL — no locks, no
+  allocation on the steady state — so the Executor/TrainStep dispatch
+  paths can bump them unconditionally.
+- **Histograms are bounded.** A histogram is a fixed vector of bucket
+  counts plus (count, sum, min, max); observing never allocates, so a
+  billion-step run holds the same few hundred bytes per series.
+- **Two exports.** ``snapshot()`` returns plain JSON-able dicts (bench.py,
+  tests); ``prometheus_text()`` renders the Prometheus text exposition
+  format (counters, gauges, and histograms with ``_bucket``/``_sum``/
+  ``_count`` series) for scraping.
+
+This module is intentionally dependency-free (stdlib only) so the profiler
+and every runtime layer can import it without cycles.
+"""
+from __future__ import annotations
+
+import math
+import threading
+from typing import Dict, Iterable, List, Optional, Tuple
+
+__all__ = [
+    "Histogram", "counter_inc", "counters", "reset_counters", "gauge_set",
+    "gauges", "observe", "histogram", "histograms", "declare_counter",
+    "declare_histogram", "snapshot", "prometheus_text", "reset_all",
+]
+
+# Default span-duration buckets (seconds): half-decade geometric ladder from
+# 1us to 100s. 17 buckets + overflow covers a TPU dispatch (~10us) and a
+# multi-minute compile in the same series.
+DEFAULT_BUCKETS: Tuple[float, ...] = tuple(
+    10.0 ** (e / 2.0) for e in range(-12, 5)
+)
+
+_COUNTERS: Dict[str, float] = {}
+_GAUGES: Dict[str, float] = {}
+_HISTOGRAMS: Dict[str, "Histogram"] = {}
+# creation (not observation) of histograms is the only racy structural
+# mutation; guard it so two threads first-observing one name don't drop data
+_CREATE_LOCK = threading.Lock()
+
+
+class Histogram:
+    """Bounded histogram: fixed bucket upper bounds + running aggregates.
+
+    ``observe`` is the hot path: a linear scan over <=20 floats (cheaper
+    than bisect's function-call overhead at this size) and four scalar
+    updates. No allocation, no lock — single-writer-per-GIL-slice safe.
+    """
+
+    __slots__ = ("bounds", "bucket_counts", "count", "sum", "min", "max")
+
+    def __init__(self, bounds: Optional[Iterable[float]] = None):
+        self.bounds: Tuple[float, ...] = tuple(bounds) if bounds is not None else DEFAULT_BUCKETS
+        if any(nxt <= prev for prev, nxt in zip(self.bounds, self.bounds[1:])):
+            raise ValueError("histogram bucket bounds must be strictly increasing")
+        self.bucket_counts: List[int] = [0] * (len(self.bounds) + 1)  # +overflow
+        self.count = 0
+        self.sum = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+
+    def observe(self, value: float) -> None:
+        i = 0
+        for b in self.bounds:
+            if value <= b:
+                break
+            i += 1
+        self.bucket_counts[i] += 1
+        self.count += 1
+        self.sum += value
+        if value < self.min:
+            self.min = value
+        if value > self.max:
+            self.max = value
+
+    def percentile(self, q: float) -> Optional[float]:
+        """Approximate percentile (0..100) by linear interpolation inside
+        the bucket holding the q-th observation; None when empty."""
+        if self.count == 0:
+            return None
+        target = max(1.0, (q / 100.0) * self.count)
+        seen = 0
+        for i, n in enumerate(self.bucket_counts):
+            if n == 0:
+                continue
+            if seen + n >= target:
+                lo = self.bounds[i - 1] if i > 0 else min(self.min, self.bounds[0] if self.bounds else self.min)
+                hi = self.bounds[i] if i < len(self.bounds) else self.max
+                lo = max(lo, self.min)
+                hi = min(hi, self.max) if self.max >= lo else hi
+                frac = (target - seen) / n
+                return lo + (hi - lo) * frac
+            seen += n
+        return self.max
+
+    def summary(self) -> dict:
+        if self.count == 0:
+            return {"count": 0, "sum": 0.0}
+        return {
+            "count": self.count,
+            "sum": self.sum,
+            "mean": self.sum / self.count,
+            "min": self.min,
+            "max": self.max,
+            "p50": self.percentile(50),
+            "p90": self.percentile(90),
+            "p99": self.percentile(99),
+        }
+
+
+# ------------------------------------------------------------------ counters
+def counter_inc(name: str, n: float = 1) -> None:
+    """Bump a named monotonic counter (lock-free single-dict hot path)."""
+    _COUNTERS[name] = _COUNTERS.get(name, 0) + n
+
+
+def counters(prefix: str = "") -> Dict[str, float]:
+    return {k: v for k, v in _COUNTERS.items() if k.startswith(prefix)}
+
+
+def reset_counters(prefix: str = "") -> None:
+    """Zero counters matching ``prefix`` (all when empty). Declared names
+    stay present (at 0) so exports keep a stable series set."""
+    for k in [k for k in _COUNTERS if k.startswith(prefix)]:
+        if k in _DECLARED_COUNTERS:
+            _COUNTERS[k] = 0
+        else:
+            del _COUNTERS[k]
+
+
+def declare_counter(name: str) -> None:
+    """Pre-register ``name`` so it exports as 0 before the first increment
+    (scrapes see the full series set from process start)."""
+    _DECLARED_COUNTERS.add(name)
+    _COUNTERS.setdefault(name, 0)
+
+
+_DECLARED_COUNTERS: set = set()
+
+
+# -------------------------------------------------------------------- gauges
+def gauge_set(name: str, value: float) -> None:
+    _GAUGES[name] = value
+
+
+def gauges(prefix: str = "") -> Dict[str, float]:
+    return {k: v for k, v in _GAUGES.items() if k.startswith(prefix)}
+
+
+# ---------------------------------------------------------------- histograms
+def histogram(name: str, bounds: Optional[Iterable[float]] = None) -> Histogram:
+    """The histogram registered under ``name`` (created on first use)."""
+    h = _HISTOGRAMS.get(name)
+    if h is None:
+        with _CREATE_LOCK:
+            h = _HISTOGRAMS.get(name)
+            if h is None:
+                h = _HISTOGRAMS[name] = Histogram(bounds)
+    return h
+
+
+declare_histogram = histogram
+
+
+def observe(name: str, value: float) -> None:
+    """Record ``value`` into the bounded histogram ``name`` (hot path: one
+    dict hit + one bucket update once the series exists)."""
+    h = _HISTOGRAMS.get(name)
+    if h is None:
+        h = histogram(name)
+    h.observe(value)
+
+
+def histograms(prefix: str = "") -> Dict[str, Histogram]:
+    return {k: v for k, v in _HISTOGRAMS.items() if k.startswith(prefix)}
+
+
+# ------------------------------------------------------------------- exports
+def snapshot() -> dict:
+    """JSON-able snapshot of every series: counters, gauges, and histogram
+    summaries (count/sum/mean/min/max/p50/p90/p99)."""
+    return {
+        "counters": dict(_COUNTERS),
+        "gauges": dict(_GAUGES),
+        "histograms": {k: h.summary() for k, h in sorted(_HISTOGRAMS.items())},
+    }
+
+
+def _prom_name(name: str, suffix: str = "") -> str:
+    out = []
+    for ch in name:
+        out.append(ch if ch.isalnum() or ch == "_" else "_")
+    base = "".join(out)
+    if not base or not (base[0].isalpha() or base[0] == "_"):
+        base = "_" + base
+    return f"paddle_tpu_{base}{suffix}"
+
+
+def prometheus_text() -> str:
+    """Render every series in the Prometheus text exposition format.
+    Histogram series follow the convention: ``<name>_bucket{le=...}``
+    (cumulative), ``<name>_sum``, ``<name>_count``; durations are seconds."""
+    lines: List[str] = []
+    for name in sorted(_COUNTERS):
+        pn = _prom_name(name, "_total")
+        lines.append(f"# TYPE {pn} counter")
+        lines.append(f"{pn} {_COUNTERS[name]:g}")
+    for name in sorted(_GAUGES):
+        pn = _prom_name(name)
+        lines.append(f"# TYPE {pn} gauge")
+        lines.append(f"{pn} {_GAUGES[name]:g}")
+    for name in sorted(_HISTOGRAMS):
+        h = _HISTOGRAMS[name]
+        pn = _prom_name(name, "_seconds")
+        lines.append(f"# TYPE {pn} histogram")
+        cum = 0
+        for bound, n in zip(h.bounds, h.bucket_counts):
+            cum += n
+            lines.append(f'{pn}_bucket{{le="{bound:g}"}} {cum}')
+        lines.append(f'{pn}_bucket{{le="+Inf"}} {h.count}')
+        lines.append(f"{pn}_sum {h.sum:g}")
+        lines.append(f"{pn}_count {h.count}")
+    return "\n".join(lines) + "\n"
+
+
+def reset_all() -> None:
+    """Test helper: clear every series (declared counters re-zero)."""
+    _COUNTERS.clear()
+    _GAUGES.clear()
+    _HISTOGRAMS.clear()
+    for name in _DECLARED_COUNTERS:
+        _COUNTERS[name] = 0

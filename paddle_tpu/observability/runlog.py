@@ -1,0 +1,147 @@
+"""Structured run log: JSONL event stream for one training run.
+
+The :class:`Monitor` is the single sink every runtime layer reports to
+(reference analog: the host tracer + the logging the fleet runtime scatters
+over stdout, unified). Each event is one JSON object per line::
+
+    {"ts": <unix wall time>, "event": "<kind>", "step": <idx>, ...payload}
+
+Event kinds emitted by the wired layers:
+
+- ``run_start``          — first event of a sink file (pid, argv)
+- ``step``               — one TrainStep dispatch (``k`` fused steps,
+                           ``seconds`` = host dispatch span)
+- ``compile``            — a new compiled specialization (component,
+                           seconds, flops, bytes_accessed, peak memory)
+- ``checkpoint_save`` / ``checkpoint_restore``
+- ``collective_timeout`` — a resilience watchdog fired
+- ``worker_join`` / ``worker_leave`` — elastic membership changes
+- ``chaos_inject``       — a deterministic fault fired (testing/chaos.py)
+
+Gating: ``FLAGS_monitor`` (default on) switches every ``emit`` into a
+single flag check; events are kept in a bounded in-memory ring always, and
+mirrored to ``FLAGS_run_log_dir/run-<pid>.jsonl`` when that flag names a
+directory. The file is line-buffered so a crashed run's log is complete up
+to the crash — that is the point.
+"""
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import sys
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from ..framework.flags import flag
+
+__all__ = ["Monitor", "monitor", "emit"]
+
+
+class Monitor:
+    """Append-only event sink: bounded in-memory ring + optional JSONL file."""
+
+    def __init__(self, capacity: int = 4096):
+        self._ring: deque = deque(maxlen=capacity)
+        self._file = None
+        self._dir: Optional[str] = None  # dir the open file belongs to
+        self.path: Optional[str] = None
+
+    # ------------------------------------------------------------- plumbing
+    def enabled(self) -> bool:
+        return bool(flag("FLAGS_monitor"))
+
+    def _sink(self):
+        """The open line-buffered JSONL file for the current
+        FLAGS_run_log_dir, or None. Re-opens when the flag changes."""
+        d = flag("FLAGS_run_log_dir")
+        if not d:
+            if self._file is not None:
+                self.close()
+            return None
+        if self._file is None or self._dir != d:
+            self.close()
+            os.makedirs(d, exist_ok=True)
+            self.path = os.path.join(d, f"run-{os.getpid()}.jsonl")
+            self._file = open(self.path, "a", buffering=1)
+            self._dir = d
+            self._write({"ts": time.time(), "event": "run_start",
+                         "pid": os.getpid(), "argv": list(sys.argv)})
+        return self._file
+
+    def _write(self, ev: dict):
+        self._file.write(json.dumps(ev, default=_json_default) + "\n")
+
+    # ----------------------------------------------------------------- API
+    def emit(self, event: str, step: Optional[int] = None, **payload) -> None:
+        """Record one event (no-op when FLAGS_monitor is off)."""
+        if not self.enabled():
+            return
+        ev: Dict[str, Any] = {"ts": time.time(), "event": event}
+        if step is not None:
+            ev["step"] = int(step)
+        if payload:
+            ev.update(payload)
+        self._ring.append(ev)
+        try:
+            if self._sink() is not None:
+                self._write(ev)
+        except OSError:  # a full/readonly disk must never kill the run
+            pass
+
+    def events(self, kind: Optional[str] = None) -> List[dict]:
+        """In-memory ring contents (newest last), optionally one kind."""
+        if kind is None:
+            return list(self._ring)
+        return [e for e in self._ring if e["event"] == kind]
+
+    def flush(self) -> None:
+        if self._file is not None:
+            self._file.flush()
+
+    def close(self) -> None:
+        if self._file is not None:
+            try:
+                self._file.close()
+            except OSError:
+                pass
+            self._file = None
+            self._dir = None
+
+    def clear(self) -> None:
+        """Test helper: drop ring events (the file, if any, keeps its lines)."""
+        self._ring.clear()
+
+
+def _json_default(o):
+    """Arrays / numpy scalars in payloads degrade to plain Python."""
+    try:
+        import numpy as np
+
+        if isinstance(o, np.generic):
+            return o.item()
+        if isinstance(o, np.ndarray):
+            return o.tolist()
+    except ImportError:  # pragma: no cover
+        pass
+    if hasattr(o, "item"):
+        try:
+            return o.item()
+        except Exception:
+            pass
+    return repr(o)
+
+
+_MONITOR = Monitor()
+atexit.register(_MONITOR.close)
+
+
+def monitor() -> Monitor:
+    """The process-global Monitor every runtime layer reports to."""
+    return _MONITOR
+
+
+def emit(event: str, step: Optional[int] = None, **payload) -> None:
+    """Module-level shorthand for ``monitor().emit(...)``."""
+    _MONITOR.emit(event, step, **payload)

@@ -1,0 +1,83 @@
+"""Step timeline spans: nestable timed sections that feed three sinks.
+
+A ``span("executor.dispatch")`` block:
+
+1. opens a :class:`paddle_tpu.profiler.RecordEvent` — so the section shows
+   up in the device trace (``jax.profiler.TraceAnnotation``), the native
+   host tracer, and ``Profiler.export``'s chrome trace when a profiling
+   session is active;
+2. records its wall duration into the bounded histogram metric of the same
+   name (``metrics.observe``) — so steady-state percentiles are available
+   without any profiler session;
+3. optionally carries attributes for the caller to stuff into a run-log
+   event (the span object exposes ``seconds`` after exit).
+
+Gated by ``FLAGS_monitor``: when the flag is off, ``span(...)`` returns a
+shared no-op context whose enter/exit are two attribute lookups — the hot
+paths keep their instrumentation unconditionally.
+"""
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from ..framework.flags import flag
+from . import metrics
+
+__all__ = ["span", "Span"]
+
+
+class Span:
+    """One timed section. Use via ``with span(name): ...``; after exit,
+    ``seconds`` holds the wall duration (also recorded into the histogram
+    metric ``name``)."""
+
+    __slots__ = ("name", "seconds", "_t0", "_re")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.seconds: Optional[float] = None
+        self._t0 = 0
+        self._re = None
+
+    def __enter__(self):
+        from ..profiler import RecordEvent
+
+        self._re = RecordEvent(self.name)
+        self._re.begin()
+        self._t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, *exc):
+        dt = (time.perf_counter_ns() - self._t0) / 1e9
+        if self._re is not None:
+            self._re.end()
+            self._re = None
+        self.seconds = dt
+        metrics.observe(self.name, dt)
+        return False
+
+
+class _NullSpan:
+    """Shared no-op span for FLAGS_monitor=0 (enter/exit do nothing)."""
+
+    __slots__ = ()
+    name = ""
+    seconds = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL = _NullSpan()
+
+
+def span(name: str):
+    """A timed section context: real :class:`Span` when FLAGS_monitor is
+    on, the shared no-op otherwise."""
+    if not flag("FLAGS_monitor"):
+        return _NULL
+    return Span(name)

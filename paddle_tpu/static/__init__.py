@@ -191,9 +191,10 @@ class _RunPlan:
     hot path is: read feed arrays, call, write back."""
 
     __slots__ = ("fn", "params", "others", "train", "donate",
-                 "scope", "param_vars", "fetch_vars")
+                 "scope", "param_vars", "fetch_vars", "compiled", "cost",
+                 "label")
 
-    def __init__(self, fn, params, others, train, donate):
+    def __init__(self, fn, params, others, train, donate, label=""):
         self.fn = fn
         self.params = params
         self.others = others
@@ -202,6 +203,9 @@ class _RunPlan:
         self.scope = None          # scope the publish targets below belong to
         self.param_vars = ()       # [(param Tensor, scope Variable)]
         self.fetch_vars = {}       # fetch name -> scope Variable
+        self.compiled = None       # AOT XLA executable (set at first run)
+        self.cost = None           # observability.cost_summary of `compiled`
+        self.label = label         # human-readable specialization id
 
     def bind_scope(self, gs, fetch_names):
         if self.scope is not gs:
@@ -252,6 +256,7 @@ class Executor:
     def run(self, program: Optional[Program] = None, feed: Optional[Dict[str, Any]] = None,
             fetch_list: Optional[List] = None, return_numpy: bool = True):
         from ..framework.flags import flag as _flag
+        from ..observability import span as _span
         from ..profiler import counter_inc
 
         counter_inc("executor.runs")
@@ -303,35 +308,41 @@ class Executor:
         donate = (bool(_flag("FLAGS_executor_donate")) and train
                   and opt is not None and prog.loss_var is not None)
 
-        feed_sig = tuple(sorted((k, v.shape, str(v.dtype)) for k, v in feed_arrays.items()))
-        key = (prog.id, prog.version, feed_sig, tuple(fetch_names), train, donate)
-        plan = self._cache.get(key)
-        if plan is None:
-            counter_inc("executor.cache_misses")
-            counter_inc("executor.compiles")
-            if _flag("FLAGS_static_check"):
-                # pre-flight the program once per compiled specialization:
-                # warnings surface through the warnings module, error-severity
-                # diagnostics (e.g. a baked dynamic dim) abort before compile
-                self._static_check(prog, [n for n in fetch_names if n])
-            refs = prog.tensor_refs()
-            if train and prog.grad_vars:
-                # append_backward already applied parameter_list/no_grad_set
-                params = [t for t in refs if id(t) in prog.grad_vars]
-            elif train:
-                params = [t for t in refs if not t.stop_gradient]
+        with _span("executor.plan_lookup"):
+            feed_sig = tuple(sorted((k, v.shape, str(v.dtype)) for k, v in feed_arrays.items()))
+            key = (prog.id, prog.version, feed_sig, tuple(fetch_names), train, donate)
+            plan = self._cache.get(key)
+            if plan is None:
+                counter_inc("executor.cache_misses")
+                counter_inc("executor.compiles")
+                if _flag("FLAGS_static_check"):
+                    # pre-flight the program once per compiled specialization:
+                    # warnings surface through the warnings module, error-severity
+                    # diagnostics (e.g. a baked dynamic dim) abort before compile
+                    self._static_check(prog, [n for n in fetch_names if n])
+                refs = prog.tensor_refs()
+                if train and prog.grad_vars:
+                    # append_backward already applied parameter_list/no_grad_set
+                    params = [t for t in refs if id(t) in prog.grad_vars]
+                elif train:
+                    params = [t for t in refs if not t.stop_gradient]
+                else:
+                    params = []
+                param_ids = {id(t) for t in params}
+                others = [t for t in refs if id(t) not in param_ids]
+                fn = self._build(prog, tuple(sorted(feed_arrays)), fetch_names,
+                                 params, others, train, donate)
+                label = (f"prog{prog.id}.v{prog.version}"
+                         + ("/train" if train else "/infer")
+                         + ("/donated" if donate else "")
+                         + "/" + ",".join(f"{k}{list(s)}" for k, s, _ in feed_sig))
+                plan = self._cache[key] = _RunPlan(fn, tuple(params), tuple(others),
+                                                  train, donate, label=label)
+                while len(self._cache) > self._CACHE_CAPACITY:
+                    self._cache.popitem(last=False)  # LRU eviction
             else:
-                params = []
-            param_ids = {id(t) for t in params}
-            others = [t for t in refs if id(t) not in param_ids]
-            fn = self._build(prog, tuple(sorted(feed_arrays)), fetch_names,
-                             params, others, train, donate)
-            plan = self._cache[key] = _RunPlan(fn, tuple(params), tuple(others), train, donate)
-            while len(self._cache) > self._CACHE_CAPACITY:
-                self._cache.popitem(last=False)  # LRU eviction
-        else:
-            counter_inc("executor.cache_hits")
-            self._cache.move_to_end(key)
+                counter_inc("executor.cache_hits")
+                self._cache.move_to_end(key)
         params = plan.params
 
         # keyed by param identity too: appending ops/params to the program
@@ -352,7 +363,34 @@ class Executor:
         if donate:
             donated_ids = {id(v) for v in param_vals}
             donated_ids.update(id(l) for l in jax.tree_util.tree_leaves(state))
-        fetched, buf_updates, new_params, new_state = plan.fn(feed_arrays, param_vals, other_vals, state)
+        run_args = (feed_arrays, param_vals, other_vals, state)
+        if plan.cost is None:
+            # first run of this specialization: compile through the AOT path
+            # so the XLA Compiled handle (the only source of cost_analysis/
+            # memory_analysis) is retained for run-log + explain(); one XLA
+            # compile either way — the jit cache is simply never populated
+            from ..observability import introspect as _introspect
+            from ..observability import runlog as _runlog
+
+            with _span("executor.compile"):
+                plan.compiled, plan.cost = _introspect.aot_compile(plan.fn, run_args)
+            _runlog.emit("compile", component="executor", label=plan.label,
+                         seconds=plan.cost.get("compile_seconds"),
+                         flops=plan.cost.get("flops"),
+                         bytes_accessed=plan.cost.get("bytes_accessed"),
+                         peak_bytes=plan.cost.get("peak_bytes"))
+        with _span("executor.dispatch"):
+            try:
+                fetched, buf_updates, new_params, new_state = (
+                    plan.compiled if plan.compiled is not None else plan.fn)(*run_args)
+            except (TypeError, ValueError):
+                if plan.compiled is None:
+                    raise
+                # AOT executables validate input avals strictly; on drift
+                # (weak types, device placement) fall back to the jit path
+                # permanently for this plan
+                plan.compiled = None
+                fetched, buf_updates, new_params, new_state = plan.fn(*run_args)
         if train and opt is not None:
             for p, v in zip(params, new_params):
                 p._value = v
@@ -369,28 +407,43 @@ class Executor:
         # — through the plan's cached Variable slots, not per-run gs.var()
         from ..framework.scope import global_scope as _gs
 
-        plan.bind_scope(_gs(), fetch_names)
-        for p, var in plan.param_vars:
-            var._value = p._value
-        out = []
-        track = bool(_flag("FLAGS_executor_donate")) and not return_numpy
-        for i in range(len(fetch_list)):
-            if i in passthrough:
-                v = passthrough[i]._value
-            else:
-                v = fetched[fetch_names[i]]
-                if fetch_names[i]:
-                    plan.fetch_vars[fetch_names[i]]._value = v
-            if return_numpy:
-                out.append(np.asarray(v))  # host transfer = device sync
-            else:
-                t = _wrap_value(v)  # device handle, no sync
-                if track:
-                    import weakref
+        with _span("executor.fetch"):
+            plan.bind_scope(_gs(), fetch_names)
+            for p, var in plan.param_vars:
+                var._value = p._value
+            out = []
+            track = bool(_flag("FLAGS_executor_donate")) and not return_numpy
+            for i in range(len(fetch_list)):
+                if i in passthrough:
+                    v = passthrough[i]._value
+                else:
+                    v = fetched[fetch_names[i]]
+                    if fetch_names[i]:
+                        plan.fetch_vars[fetch_names[i]]._value = v
+                if return_numpy:
+                    out.append(np.asarray(v))  # host transfer = device sync
+                else:
+                    t = _wrap_value(v)  # device handle, no sync
+                    if track:
+                        import weakref
 
-                    self._fetch_watch.append(weakref.ref(t))
-                out.append(t)
+                        self._fetch_watch.append(weakref.ref(t))
+                    out.append(t)
         return out
+
+    def explain(self) -> List[dict]:
+        """Per-specialization cost table for every cached compiled program:
+        one row per :class:`_RunPlan` with the XLA ``cost_analysis``/
+        ``memory_analysis`` captured at its compile (flops, bytes accessed,
+        peak device memory, compile seconds). Render with
+        ``paddle_tpu.observability.format_cost_table``."""
+        rows = []
+        for plan in self._cache.values():
+            row = {"label": plan.label, "train": plan.train,
+                   "donate": plan.donate}
+            row.update(plan.cost or {})
+            rows.append(row)
+        return rows
 
     def _sweep_stale(self, donated_ids):
         """Poison previously returned device handles whose buffer the donated

@@ -46,6 +46,14 @@ _fired: set = set()  # (point, step) crash points that already fired
 _dropped: dict = {}  # drop spec -> count of failures injected so far
 
 
+def _emit_inject(step=None, **payload):
+    """Record the injected fault in the structured run log (chaos fires are
+    exactly the events a postmortem wants timestamped)."""
+    from ..observability import runlog
+
+    runlog.emit("chaos_inject", step=step, **payload)
+
+
 def reset():
     """Forget fired crash points and drop counters (fresh experiment)."""
     _fired.clear()
@@ -70,6 +78,7 @@ def crash_if_due(point: str, step=None):
     if key in _fired:
         return
     _fired.add(key)
+    _emit_inject(kind="crash", point=point, step=step)
     raise ChaosCrash(f"chaos: injected crash at point {point!r} step {step}")
 
 
@@ -94,6 +103,7 @@ def store_op(op: str, key: str):
         if limit >= 0 and n >= limit:
             return  # healed: budget of injected failures spent
         _dropped[spec] = n + 1
+        _emit_inject(kind="store_drop", op=op, key=key)
         raise ChaosError(f"chaos: dropped store op {op}({key!r}) "
                          f"[{n + 1}{'/' + str(limit) if limit >= 0 else ''}]")
 

@@ -1,0 +1,420 @@
+"""Unified runtime telemetry: metrics registry, run log, spans,
+compiled-program introspection, report CLI, and the profiler satellites
+(host-event leak, Profiler.step, stop/export hardening, chrome fallback).
+"""
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, observability as obs
+from paddle_tpu import profiler
+from paddle_tpu.observability import metrics
+
+
+@pytest.fixture
+def run_log_dir(tmp_path):
+    """Route the global Monitor into a fresh dir; restore + close after."""
+    prev = paddle.get_flags("FLAGS_run_log_dir")["FLAGS_run_log_dir"]
+    paddle.set_flags({"FLAGS_run_log_dir": str(tmp_path)})
+    obs.monitor().clear()
+    yield tmp_path
+    obs.monitor().flush()
+    paddle.set_flags({"FLAGS_run_log_dir": prev})
+    obs.monitor().close()
+
+
+def _read_log(tmp_path):
+    files = sorted(tmp_path.glob("run-*.jsonl"))
+    assert files, f"no run log written under {tmp_path}"
+    obs.monitor().flush()
+    return [json.loads(l) for l in files[-1].read_text().splitlines() if l]
+
+
+# ---------------------------------------------------------------- metrics
+class TestMetricsRegistry:
+    def test_counter_gauge_histogram_snapshot(self):
+        metrics.reset_counters("t.")
+        metrics.counter_inc("t.c")
+        metrics.counter_inc("t.c", 4)
+        metrics.gauge_set("t.g", 2.5)
+        for v in [0.001, 0.002, 0.004, 0.2]:
+            metrics.observe("t.h", v)
+        snap = metrics.snapshot()
+        assert snap["counters"]["t.c"] == 5
+        assert snap["gauges"]["t.g"] == 2.5
+        h = snap["histograms"]["t.h"]
+        assert h["count"] == 4
+        assert h["min"] == 0.001 and h["max"] == 0.2
+        assert abs(h["sum"] - 0.207) < 1e-9
+        assert h["p50"] <= h["p90"] <= h["p99"] <= 0.2 + 1e-9
+
+    def test_histogram_bounded(self):
+        h = metrics.Histogram(bounds=[0.1, 1.0])
+        for v in [0.05, 0.5, 5.0, 50.0]:
+            h.observe(v)
+        assert h.bucket_counts == [1, 1, 2]  # overflow bucket catches the tail
+        assert h.count == 4
+
+    def test_declared_counters_survive_reset(self):
+        metrics.counter_inc("executor.runs", 3)
+        metrics.reset_counters("executor.")
+        assert metrics.counters("executor.")["executor.runs"] == 0
+
+    def test_prometheus_text_format(self):
+        metrics.counter_inc("t.prom.c", 2)
+        metrics.observe("t.prom.h", 0.01)
+        text = metrics.prometheus_text()
+        assert "# TYPE paddle_tpu_t_prom_c_total counter" in text
+        assert "paddle_tpu_t_prom_c_total 2" in text
+        assert "# TYPE paddle_tpu_t_prom_h_seconds histogram" in text
+        assert 'paddle_tpu_t_prom_h_seconds_bucket{le="+Inf"} 1' in text
+        assert "paddle_tpu_t_prom_h_seconds_count 1" in text
+
+    def test_prometheus_always_carries_runtime_series(self):
+        """executor/train_step/dataloader/collective series export from
+        process start (declared at 0), not only after first use."""
+        text = metrics.prometheus_text()
+        for name in ("paddle_tpu_executor_runs_total",
+                     "paddle_tpu_train_step_dispatches_total",
+                     "paddle_tpu_dataloader_batches_total",
+                     "paddle_tpu_collective_all_reduce_calls_total"):
+            assert name in text
+
+    def test_profiler_counters_are_registry_views(self):
+        profiler.reset_counters("t.view.")
+        profiler.counter_inc("t.view.x", 7)
+        assert metrics.counters("t.view.")["t.view.x"] == 7
+        assert profiler.counters("t.view.")["t.view.x"] == 7
+
+
+# ------------------------------------------------------------------ spans
+class TestSpans:
+    def test_span_records_histogram(self):
+        before = metrics.histogram("t.span").count
+        with obs.span("t.span") as sp:
+            time.sleep(0.001)
+        assert metrics.histogram("t.span").count == before + 1
+        assert sp.seconds >= 0.001
+
+    def test_span_noop_when_monitor_off(self):
+        paddle.set_flags({"FLAGS_monitor": False})
+        try:
+            before = metrics.histogram("t.span.off").count
+            with obs.span("t.span.off") as sp:
+                pass
+            assert metrics.histogram("t.span.off").count == before
+            assert sp.seconds is None
+        finally:
+            paddle.set_flags({"FLAGS_monitor": True})
+
+    def test_emit_noop_when_monitor_off(self):
+        paddle.set_flags({"FLAGS_monitor": False})
+        try:
+            obs.monitor().clear()
+            obs.emit("t_off_event")
+            assert obs.monitor().events("t_off_event") == []
+        finally:
+            paddle.set_flags({"FLAGS_monitor": True})
+
+    def test_nested_spans(self):
+        with obs.span("t.outer"):
+            with obs.span("t.inner"):
+                pass
+        assert metrics.histogram("t.outer").count >= 1
+        assert metrics.histogram("t.inner").count >= 1
+
+
+# ---------------------------------------------------- run log + train loop
+def _tiny_train(n_steps=4, run_steps_k=None):
+    model = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+    step = paddle.jit.TrainStep(model, opt, nn.CrossEntropyLoss())
+    X = np.random.randn(8, 4).astype("float32")
+    Y = np.random.randint(0, 2, (8,)).astype("int64")
+    if run_steps_k:
+        out = step.run_steps((np.stack([X] * run_steps_k),
+                              np.stack([Y] * run_steps_k)), k=run_steps_k)
+    else:
+        for _ in range(n_steps):
+            out = step(X, Y)
+    return step, out
+
+
+class TestRunLog:
+    def test_train_loop_writes_parseable_jsonl(self, run_log_dir):
+        """Tier-1 acceptance: a tiny train loop under FLAGS_monitor=1 yields
+        a parseable run log containing compile + step events with span
+        timings."""
+        _tiny_train(n_steps=3)
+        events = _read_log(run_log_dir)
+        kinds = [e["event"] for e in events]
+        assert "compile" in kinds
+        steps = [e for e in events if e["event"] == "step"]
+        assert len(steps) == 3
+        for e in steps:
+            assert e["seconds"] > 0 and e["k"] == 1 and "ts" in e
+        comp = next(e for e in events if e["event"] == "compile")
+        assert comp["component"] == "train_step"
+        assert comp["seconds"] > 0
+        assert comp["flops"] is None or comp["flops"] >= 0
+
+    def test_run_steps_emits_fused_step_event(self, run_log_dir):
+        _tiny_train(run_steps_k=4)
+        steps = [e for e in _read_log(run_log_dir) if e["event"] == "step"]
+        assert steps and steps[-1]["k"] == 4 and steps[-1]["step"] == 4
+
+    def test_executor_compile_event_and_explain(self, run_log_dir):
+        from paddle_tpu import static
+        from paddle_tpu.framework.static_trace import Program
+
+        prog = Program()
+        with static.program_guard(prog):
+            x = static.data("x", [None, 4])
+            w = paddle.create_parameter([4, 2], "float32")
+            y = paddle.matmul(x, w)
+        exe = static.Executor()
+        exe.run(prog, feed={"x": np.ones((3, 4), "float32")}, fetch_list=[y])
+        exe.run(prog, feed={"x": np.ones((3, 4), "float32")}, fetch_list=[y])
+        rows = exe.explain()
+        assert len(rows) == 1
+        assert "flops" in rows[0] and "peak_bytes" in rows[0]
+        assert rows[0]["compile_seconds"] > 0
+        comps = [e for e in _read_log(run_log_dir)
+                 if e["event"] == "compile" and e["component"] == "executor"]
+        assert len(comps) == 1
+
+    def test_trainstep_explain_cost_rows(self):
+        step, _ = _tiny_train(n_steps=1)
+        rows = step.explain()
+        assert len(rows) == 1 and rows[0]["kind"] == "step"
+        # on CPU XLA still reports flops; None only if the backend cannot
+        assert rows[0]["flops"] is None or rows[0]["flops"] > 0
+        table = obs.format_cost_table(rows)
+        assert "GFLOP" in table and rows[0]["label"] in table
+
+    def test_checkpoint_events(self, run_log_dir, tmp_path):
+        import jax.numpy as jnp
+
+        from paddle_tpu.distributed.resilience import CheckpointManager
+
+        mgr = CheckpointManager(str(tmp_path / "ckpt"), keep_last_k=2)
+        state = {"w": jnp.ones((3,))}
+        mgr.save(state, step=1)
+        restored = mgr.restore_latest(target=state)
+        assert restored is not None and restored[1] == 1
+        events = _read_log(run_log_dir)
+        saves = [e for e in events if e["event"] == "checkpoint_save"]
+        loads = [e for e in events if e["event"] == "checkpoint_restore"]
+        assert saves and saves[0]["step"] == 1 and saves[0]["seconds"] > 0
+        assert loads and loads[0]["step"] == 1
+
+    def test_chaos_inject_event(self, run_log_dir):
+        from paddle_tpu.testing import chaos
+
+        with chaos.inject(FLAGS_chaos_crash_point="t_obs_point"):
+            with pytest.raises(chaos.ChaosCrash):
+                chaos.crash_if_due("t_obs_point", 5)
+        inj = [e for e in _read_log(run_log_dir) if e["event"] == "chaos_inject"]
+        assert inj and inj[0]["kind"] == "crash" and inj[0]["point"] == "t_obs_point"
+
+    def test_collective_and_dataloader_counters(self):
+        from paddle_tpu.distributed import collective
+        from paddle_tpu.io import DataLoader
+
+        before = metrics.counters("collective.barrier.")["collective.barrier.calls"]
+        collective.barrier()
+        assert metrics.counters("collective.barrier.")["collective.barrier.calls"] == before + 1
+
+        ds = [(np.ones(2, np.float32), np.int64(0)) for _ in range(6)]
+        before = metrics.counters("dataloader.")["dataloader.batches"]
+        list(DataLoader(ds, batch_size=2))
+        assert metrics.counters("dataloader.")["dataloader.batches"] == before + 3
+
+    def test_hapi_metrics_logger_bridges_fit(self, run_log_dir):
+        net = nn.Sequential(nn.Linear(4, 2))
+        model = paddle.Model(net)
+        model.prepare(
+            optimizer=paddle.optimizer.SGD(learning_rate=0.1,
+                                           parameters=net.parameters()),
+            loss=nn.CrossEntropyLoss(),
+        )
+        X = np.random.randn(16, 4).astype("float32")
+        Y = np.random.randint(0, 2, (16,)).astype("int64")
+        ds = [(X[i:i + 8], Y[i:i + 8]) for i in range(0, 16, 8)]
+        model.fit(ds, epochs=2, verbose=0)
+        events = _read_log(run_log_dir)
+        kinds = [e["event"] for e in events]
+        assert "fit_begin" in kinds and "fit_end" in kinds
+        epochs = [e for e in events if e["event"] == "epoch"]
+        assert len(epochs) == 2 and "loss" in epochs[0]
+        assert "hapi.loss" in metrics.gauges("hapi.")
+        assert metrics.histogram("hapi.step").count >= 4
+
+
+# -------------------------------------------------------------- report CLI
+class TestReportCLI:
+    def _write_log(self, path):
+        events = [
+            {"ts": 100.0, "event": "run_start", "pid": 1},
+            {"ts": 100.1, "event": "compile", "component": "train_step",
+             "seconds": 2.0, "flops": 1e9},
+            {"ts": 102.2, "event": "step", "step": 1, "k": 1, "seconds": 0.010},
+            {"ts": 102.3, "event": "step", "step": 2, "k": 1, "seconds": 0.020},
+            {"ts": 102.4, "event": "step", "step": 6, "k": 4, "seconds": 0.040},
+            {"ts": 102.5, "event": "checkpoint_save", "step": 6, "seconds": 0.5},
+        ]
+        path.write_text("".join(json.dumps(e) + "\n" for e in events))
+
+    def test_analyze(self, tmp_path):
+        from paddle_tpu.observability.__main__ import analyze, load_events
+
+        p = tmp_path / "run.jsonl"
+        self._write_log(p)
+        a = analyze(load_events(str(p)))
+        assert a["counts"]["step"] == 3
+        assert a["steps"] == 6  # k-fused steps counted individually
+        assert a["step_time"]["count"] == 6
+        assert a["step_time"]["p50_seconds"] <= a["step_time"]["p99_seconds"]
+        assert a["phase_seconds"]["compile[train_step]"] == 2.0
+
+    def test_cli_main(self, tmp_path, capsys):
+        from paddle_tpu.observability.__main__ import main
+
+        p = tmp_path / "run.jsonl"
+        self._write_log(p)
+        assert main(["report", str(p)]) == 0
+        out = capsys.readouterr().out
+        assert "p50" in out and "step time" in out and "compile" in out
+        assert main(["report", str(p), "--json"]) == 0
+        d = json.loads(capsys.readouterr().out)
+        assert d["steps"] == 6
+
+    def test_cli_end_to_end(self, run_log_dir):
+        from paddle_tpu.observability.__main__ import main
+
+        _tiny_train(n_steps=2)
+        obs.monitor().flush()
+        path = sorted(run_log_dir.glob("run-*.jsonl"))[-1]
+        assert main(["report", str(path)]) == 0
+
+
+# ------------------------------------------------------ profiler satellites
+class TestProfilerSatellites:
+    def test_host_events_do_not_leak_without_session(self):
+        """RecordEvent outside a Profiler session must not grow the
+        module-global buffer (long annotated loops leaked before)."""
+        assert not profiler._session_active
+        profiler._HOST_EVENTS.clear()
+        for _ in range(5):
+            with profiler.RecordEvent("leaky"):
+                pass
+        assert len(profiler._HOST_EVENTS) == 0
+
+    def test_host_events_recorded_inside_session(self):
+        prof = profiler.Profiler(timer_only=True)
+        prof.start()
+        with profiler.RecordEvent("in_session"):
+            pass
+        prof.stop()
+        assert len(profiler._HOST_EVENTS["in_session"]) == 1
+
+    def test_profiler_step_counts_and_marks(self):
+        metrics.reset_counters("profiler.")
+        prof = profiler.Profiler(timer_only=True)
+        prof.start()
+        for _ in range(3):
+            time.sleep(0.001)
+            prof.step()
+        prof.stop()
+        assert profiler.counters("profiler.")["profiler.steps"] == 3
+        assert len(profiler._HOST_EVENTS["profiler.step"]) == 3
+        out = prof.summary()
+        assert "steps: 3" in out
+
+    def test_profiler_step_outside_session_only_counts(self):
+        metrics.reset_counters("profiler.")
+        prof = profiler.Profiler(timer_only=True)
+        profiler._HOST_EVENTS.clear()
+        prof.step()  # start() never ran: counter bumps, no trace event
+        assert profiler.counters("profiler.")["profiler.steps"] == 1
+        assert len(profiler._HOST_EVENTS) == 0
+
+    def test_stop_without_start_is_safe_noop(self):
+        prof = profiler.Profiler(timer_only=True)
+        with pytest.warns(UserWarning, match="start"):
+            prof.stop()
+        assert not prof._running
+
+    def test_export_without_start_is_safe_noop(self, tmp_path):
+        prof = profiler.Profiler(timer_only=True)
+        with pytest.warns(UserWarning, match="start"):
+            assert prof.export(tmp_path / "t.json") is None
+        assert not (tmp_path / "t.json").exists()
+
+    def test_summary_without_start_is_safe(self):
+        assert "no profiling session" in profiler.Profiler().summary()
+
+
+class TestChromeTraceFallback:
+    """Export path without the native toolchain: pure-python span export."""
+
+    @pytest.fixture
+    def no_native(self, monkeypatch):
+        monkeypatch.setattr(profiler, "_native", lambda build=False: None)
+
+    def test_fallback_export_valid_and_nested(self, no_native, tmp_path):
+        prof = profiler.Profiler(timer_only=True)
+        prof.start()
+        with profiler.RecordEvent("outer") as outer:
+            time.sleep(0.002)
+            with profiler.RecordEvent("inner") as inner:
+                time.sleep(0.001)
+        prof.stop()
+        # nesting must not corrupt either span's begin/end
+        assert outer.begin_ns <= inner.begin_ns <= inner.end_ns <= outer.end_ns
+        out = prof.export(tmp_path / "trace.json")
+        doc = json.loads(open(out).read())
+        events = {e["name"]: e for e in doc["traceEvents"] if e.get("ph") == "X"}
+        assert "outer" in events and "inner" in events
+        for e in events.values():
+            assert e["ph"] == "X" and "ts" in e and e["dur"] >= 0
+        # chrome-trace timestamps are µs: inner nests inside outer there too
+        o, i = events["outer"], events["inner"]
+        assert o["ts"] <= i["ts"]
+        assert i["ts"] + i["dur"] <= o["ts"] + o["dur"] + 1e-3
+
+    def test_fallback_span_histograms_flow_too(self, no_native):
+        before = metrics.histogram("t.fb.span").count
+        with obs.span("t.fb.span"):
+            pass
+        assert metrics.histogram("t.fb.span").count == before + 1
+
+
+class TestMonitorOverheadPath:
+    def test_train_loop_with_monitor_off_still_works(self):
+        paddle.set_flags({"FLAGS_monitor": False})
+        try:
+            obs.monitor().clear()
+            step, out = _tiny_train(n_steps=2)
+            assert np.isfinite(float(out["loss"]))
+            assert obs.monitor().events("step") == []
+            # introspection still captured (compile-time, not per-step)
+            assert step.explain()
+        finally:
+            paddle.set_flags({"FLAGS_monitor": True})
+
+    def test_profiler_export_has_span_events_from_train(self, tmp_path):
+        """Acceptance: a train loop inside a Profiler session exports a
+        valid chrome trace carrying the runtime spans."""
+        prof = profiler.Profiler(timer_only=True)
+        prof.start()
+        _tiny_train(n_steps=2)
+        prof.stop()
+        out = prof.export(tmp_path / "trace.json")
+        doc = json.loads(open(out).read())
+        names = {e.get("name") for e in doc["traceEvents"]}
+        assert "train_step.step" in names
