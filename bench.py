@@ -98,6 +98,42 @@ def _measure(flash_flat: bool):
         "dispatches_per_step": round(
             counts["train_step.dispatches"] / counts["train_step.steps"], 4),
     }
+    if not on_tpu:
+        # training-health guard overhead on the fused tiny-GPT microbench
+        # (CPU smoke path; the in-graph finite checks + where-selects must
+        # stay <2% of fused steps/sec — tracked via BENCH_* history).
+        # Measured SYMMETRICALLY: both sides warm, interleaved repeats of
+        # the same K-step dispatch, best-of taken per side — a single
+        # dispatch timing is ±10% noise on CPU.
+        paddle.seed(0)
+        model_g = GPTForPretraining(cfg)
+        opt_g = paddle.optimizer.AdamW(learning_rate=1e-4, parameters=model_g.parameters())
+        step_g = TrainStep(model_g, opt_g, crit, amp_level=amp_level, guard=True)
+        out = step_g.run_steps(stacked, k=K)  # warmup compile
+        float(np.asarray(out["loss"]._value)[-1])
+
+        def _time_fused(s, reps=8):
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                o = s.run_steps(stacked, k=K)
+            float(np.asarray(o["loss"]._value)[-1])
+            return (time.perf_counter() - t0) / reps
+
+        base_dt, guard_dt = [], []
+        for _ in range(4):  # interleave so drift hits both sides equally
+            base_dt.append(_time_fused(step))
+            guard_dt.append(_time_fused(step_g))
+        base_sps = K / min(base_dt)
+        guarded_sps = K / min(guard_dt)
+        extras["steps_per_sec_fused_guarded"] = round(guarded_sps, 3)
+        extras["guard_overhead_pct"] = round(
+            100.0 * (1.0 - guarded_sps / base_sps), 2)
+    from paddle_tpu.observability.metrics import counters as _counters
+
+    stab = _counters()
+    extras["skipped_steps"] = stab.get("train_step.skipped", 0) + stab.get(
+        "amp.skipped_steps", 0)
+    extras["rollbacks"] = stab.get("stability.rollbacks", 0)
     # observability snapshot: dispatch counters + span-histogram summaries
     # (p50/p90/p99 step/compile timings), plus the per-specialization XLA
     # cost rows behind TrainStep.explain()
@@ -146,7 +182,8 @@ def main():
         print(json.dumps({"metric": "gpt_pretrain_throughput", "value": None,
                           "unit": "tokens/sec/chip", "vs_baseline": None,
                           "steps_per_sec": None, "steps_per_sec_fused": None,
-                          "dispatches_per_step": None, "error": reason}))
+                          "dispatches_per_step": None, "skipped_steps": None,
+                          "rollbacks": None, "error": reason}))
 
     verdict = _probe_default_backend(timeout=75.0)
     if verdict is False:
@@ -207,6 +244,12 @@ def main():
         "steps_per_sec": extras.get("steps_per_sec"),
         "steps_per_sec_fused": extras.get("steps_per_sec_fused"),
         "dispatches_per_step": extras.get("dispatches_per_step"),
+        # training-health guard telemetry: fused guarded steps/sec + overhead
+        # vs unguarded (CPU microbench), and the run's skip/rollback counts
+        "steps_per_sec_fused_guarded": extras.get("steps_per_sec_fused_guarded"),
+        "guard_overhead_pct": extras.get("guard_overhead_pct"),
+        "skipped_steps": extras.get("skipped_steps"),
+        "rollbacks": extras.get("rollbacks"),
         # observability snapshot (counters + span-histogram summaries) and
         # the compiled-specialization cost captured at TrainStep compile
         "metrics": extras.get("metrics"),

@@ -59,6 +59,7 @@ from . import hub  # noqa: F401,E402
 from . import callbacks  # noqa: F401,E402
 from . import analysis  # noqa: F401,E402
 from . import observability  # noqa: F401,E402
+from . import stability  # noqa: F401,E402
 from .static import disable_static, enable_static, in_dynamic_mode  # noqa: E402
 from .framework.io import load, save  # noqa: E402
 from .hapi.model import Model  # noqa: E402

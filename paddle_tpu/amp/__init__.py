@@ -1,10 +1,17 @@
 """AMP (parity: python/paddle/amp — auto_cast + GradScaler).
 
 TPU-first: bfloat16 is the native mixed-precision dtype; it shares float32's
-exponent range so loss scaling is unnecessary — ``GradScaler`` exists for
-fp16 API parity and is an identity pass-through for bf16 (the reference's
-dynamic loss scaling machinery, python/paddle/amp/grad_scaler.py:26 +
-check_finite_and_unscale op, is only needed for fp16).
+exponent range so loss scaling is unnecessary — for bf16 construct
+``GradScaler(enable=False)`` (a pass-through, and what ``decorate`` implies).
+For fp16, ``GradScaler`` implements the reference's REAL dynamic loss
+scaling (python/paddle/amp/grad_scaler.py:26 + check_finite_and_unscale op,
+per Micikevicius et al. 2018): grow the scale every ``incr_every_n_steps``
+clean steps, back it off after ``decr_every_n_nan_or_inf`` overflowed steps,
+and skip the optimizer update on overflow. The found-inf flag comes from ONE
+fused on-device all-nonfinite reduction over the unscaled grads (a single
+host sync per step, not per tensor), and every transition is visible through
+the observability spine: ``amp.loss_scale`` gauge, ``amp.skipped_steps``
+counter, ``loss_scale`` run-log events.
 """
 from __future__ import annotations
 
@@ -101,7 +108,7 @@ def decorate(models=None, optimizers=None, level="O2", dtype="bfloat16", master_
 
 class GradScaler:
     """Dynamic loss scaling (parity: python/paddle/amp/grad_scaler.py:26).
-    No-op for bf16; functional for fp16."""
+    Pass ``enable=False`` for bf16 (no scaling needed); functional for fp16."""
 
     def __init__(self, enable=True, init_loss_scaling=2.0**15, incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=1000, decr_every_n_nan_or_inf=2, use_dynamic_loss_scaling=True):
         self._enable = enable
@@ -113,6 +120,11 @@ class GradScaler:
         self._bad_steps = 0
         self._found_inf = False
         self._unscaled = False
+        self._host_step = 0
+        if enable:
+            from ..observability.metrics import gauge_set
+
+            gauge_set("amp.loss_scale", float(self._scale))
 
     def scale(self, loss):
         if not self._enable:
@@ -142,8 +154,16 @@ class GradScaler:
             return
         if not self._unscaled:
             self.unscale_(optimizer)
+        self._host_step += 1
         if not self._found_inf:
             optimizer.step()
+        else:
+            from ..observability import runlog
+            from ..observability.metrics import counter_inc
+
+            counter_inc("amp.skipped_steps")
+            runlog.emit("bad_step", step=self._host_step, component="amp",
+                        loss_scale=float(self._scale))
         self.update()
 
     def minimize(self, optimizer, scaled_loss):
@@ -154,18 +174,28 @@ class GradScaler:
         self._unscaled = False
         if not (self._enable and self._dynamic):
             return
+        prev, reason = self._scale, None
         if self._found_inf:
             self._bad_steps += 1
             self._good_steps = 0
             if self._bad_steps >= self._decr_every:
                 self._scale = max(self._scale * self._decr_ratio, 1.0)
                 self._bad_steps = 0
+                reason = "backoff"
         else:
             self._good_steps += 1
             self._bad_steps = 0
             if self._good_steps >= self._incr_every:
                 self._scale *= self._incr_ratio
                 self._good_steps = 0
+                reason = "grow"
+        if reason is not None:
+            from ..observability import runlog
+            from ..observability.metrics import gauge_set
+
+            gauge_set("amp.loss_scale", float(self._scale))
+            runlog.emit("loss_scale", step=self._host_step, reason=reason,
+                        value=float(self._scale), prev=float(prev))
 
     def is_enable(self):
         return self._enable

@@ -339,6 +339,11 @@ def run_resilient(train_step_fn: Callable[[Any, int, List[int]], Any], *,
       RESUME    restore the newest valid checkpoint and continue from its
                 step with the rescaled membership
 
+    A :class:`paddle_tpu.stability.DivergenceFault` (raised by a
+    ``HealthMonitor`` inside ``train_step_fn``) follows the same protocol
+    EXCEPT the HOLD save: numerically poisoned state is never persisted —
+    the restore rewinds past the divergence instead.
+
     Restart attempts are bounded by ``max_restarts`` with exponential
     backoff; the fault that exhausts the budget propagates. Returns
     ``(final_state, restarts_used)``.
@@ -379,7 +384,16 @@ def run_resilient(train_step_fn: Callable[[Any, int, List[int]], Any], *,
                 raise
             restarts += 1
             _emit("hold", step=step, fault=repr(fault), restart=restarts)
-            manager.save(state, step)  # HOLD: make current progress durable
+            from ..stability import DivergenceFault
+
+            if isinstance(fault, DivergenceFault):
+                # divergence rewind: the in-flight state is numerically
+                # poisoned — restore WITHOUT persisting it first
+                _counter_inc("stability.rollbacks")
+                _runlog.emit("rollback", step=step, reason=str(fault),
+                             rollbacks=restarts)
+            else:
+                manager.save(state, step)  # HOLD: make current progress durable
             time.sleep(backoff * (2 ** (restarts - 1)))
             prev_members = members
             members = node.wait_for(min_nodes, max_nodes, settle=settle,

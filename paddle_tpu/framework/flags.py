@@ -91,6 +91,10 @@ define_flag("FLAGS_run_log_dir", "", "directory for the structured run log (JSON
 # Fault-tolerance runtime (distributed/resilience.py).
 define_flag("FLAGS_collective_timeout_s", 0.0, "watchdog: report a cross-process collective still pending after this many seconds (0 = off)")
 
+# Training-health guard (jit.TrainStep guard / paddle_tpu.stability).
+define_flag("FLAGS_train_guard", False, "fuse an all-finite check over loss+grads into every jit.TrainStep program and skip the param/opt/rng update in-graph when it trips (state stays bitwise at its pre-step value); read at TrainStep construction")
+define_flag("FLAGS_dataloader_max_bad_batches", 0, "DataLoader: skip up to this many batches whose sample/collate raised (per iteration) instead of killing the iterator; 0 keeps the raise-through behavior")
+
 # Deterministic fault injection (testing/chaos.py). All hooks are no-ops
 # unless FLAGS_chaos is on; each knob below selects one failure mode.
 define_flag("FLAGS_chaos", False, "master switch for deterministic fault injection")
@@ -101,3 +105,5 @@ define_flag("FLAGS_chaos_store_drop_ops", "", "comma list of store ops to fail, 
 define_flag("FLAGS_chaos_store_drop_count", -1, "fail only the first N matching store ops, then heal (-1: always)")
 define_flag("FLAGS_chaos_store_delay_s", 0.0, "sleep this long before every store op")
 define_flag("FLAGS_chaos_freeze_heartbeat", "", "comma list of elastic node ids whose heartbeat stops refreshing")
+define_flag("FLAGS_chaos_nan_at_step", -1, "inject non-finite gradients in-graph at this TrainStep step index (fires exactly once; read at TrainStep construction; -1 = off)")
+define_flag("FLAGS_chaos_nan_steps", 1, "number of consecutive steps the NaN-gradient injection fires for (default 1)")

@@ -183,6 +183,62 @@ class ModelCheckpoint(Callback):
             self.model.save(f"{self.save_dir}/final")
 
 
+class TrainingHealth(Callback):
+    """Divergence guard for ``Model.fit`` (paddle_tpu.stability wiring):
+    watches the per-batch loss with a :class:`~paddle_tpu.stability.
+    HealthMonitor` (non-finite losses and sustained loss-EMA spikes count
+    as bad steps). With a ``CheckpointManager`` the monitor periodically
+    checkpoints the fitted TrainStep state (``checkpoint_every`` batches)
+    and on divergence rewinds it via ``restore_latest`` — fit just keeps
+    going with the restored weights. Without a manager (or when recovery
+    is impossible) divergence stops training like EarlyStopping, instead
+    of burning the rest of the epochs on NaN."""
+
+    def __init__(self, manager=None, k_bad_steps=3, spike_factor=4.0,
+                 spike_patience=5, ema_alpha=0.05, checkpoint_every=0,
+                 lr_backoff=None, max_rollbacks=3, stop_on_divergence=True,
+                 verbose=1):
+        super().__init__()
+        self.manager = manager
+        self.stop_on_divergence = stop_on_divergence
+        self.verbose = verbose
+        self._kw = dict(k_bad_steps=k_bad_steps, spike_factor=spike_factor,
+                        spike_patience=spike_patience, ema_alpha=ema_alpha,
+                        checkpoint_every=checkpoint_every,
+                        lr_backoff=lr_backoff, max_rollbacks=max_rollbacks)
+        self.monitor = None
+
+    def on_train_begin(self, logs=None):
+        from ..stability import HealthMonitor
+
+        self.monitor = HealthMonitor(manager=self.manager, **self._kw)
+
+    def on_train_batch_end(self, step, logs=None):
+        from ..stability import DivergenceError
+
+        if self.monitor is None:
+            return
+        if self.monitor.train_step is None:
+            # the fitted TrainStep exists only once fit() built it
+            self.monitor.train_step = getattr(self.model, "_train_step", None)
+        loss = (logs or {}).get("loss")
+        if loss is None:
+            return
+        try:
+            info = self.monitor.observe_loss(float(np.asarray(loss)))
+        except DivergenceError as exc:
+            if not self.stop_on_divergence:
+                raise
+            if self.model is not None:
+                self.model.stop_training = True
+            if self.verbose:
+                print(f"TrainingHealth: stopping fit — {exc}")
+            return
+        if info is not None and self.verbose:
+            print(f"TrainingHealth: rolled back to step "
+                  f"{info['restored_step']} ({info['reason']})")
+
+
 class LRScheduler(Callback):
     """Steps the optimizer's LRScheduler (by_step or by_epoch)."""
 

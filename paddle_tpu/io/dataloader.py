@@ -76,6 +76,12 @@ def stack_batches(it, k, to_device=True):
         yield prev
 
 
+# sentinel flowing through the worker/iterator plumbing in place of a batch
+# whose sample/collate raised (FLAGS_dataloader_max_bad_batches > 0); the
+# consumer-facing iterator filters it out
+_SKIPPED_BATCH = object()
+
+
 def default_collate_fn(batch):
     sample = batch[0]
     if isinstance(sample, (list, tuple)):
@@ -122,6 +128,7 @@ class DataLoader:
             raise ValueError(f"worker_mode must be 'thread' or 'process', got {worker_mode!r}")
         self.worker_mode = worker_mode
         self._pool = None  # persistent WorkerPool (process mode)
+        self._bad_count = 0  # skipped batches this iteration (poison samples)
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
             self.batch_sampler = None
@@ -142,12 +149,36 @@ class DataLoader:
         return len(self.batch_sampler)
 
     def _fetch(self, indices):
-        with _span("dataloader.fetch"):
-            batch = self.collate_fn([self.dataset[i] for i in indices])
+        try:
+            with _span("dataloader.fetch"):
+                batch = self.collate_fn([self.dataset[i] for i in indices])
+        except Exception as exc:
+            return self._bad_batch(exc, indices=list(indices))
         _counter_inc("dataloader.batches")
         return batch
 
+    def _bad_batch(self, exc, **info):
+        """Poison-sample resilience (FLAGS_dataloader_max_bad_batches > 0):
+        a sample/collate exception yields a skip sentinel — bounded per
+        iteration — instead of killing the iterator mid-epoch."""
+        from ..framework.flags import flag
+        from ..observability import runlog
+
+        limit = int(flag("FLAGS_dataloader_max_bad_batches"))
+        if limit <= 0:
+            raise exc
+        self._bad_count += 1
+        _counter_inc("dataloader.bad_batches")
+        runlog.emit("bad_batch", count=self._bad_count, limit=limit,
+                    error=f"{type(exc).__name__}: {exc}", **info)
+        if self._bad_count > limit:
+            raise RuntimeError(
+                f"DataLoader: {self._bad_count} bad batches in one iteration "
+                f"exceeds FLAGS_dataloader_max_bad_batches={limit}") from exc
+        return _SKIPPED_BATCH
+
     def __iter__(self):
+        self._bad_count = 0  # bad-batch budget is per iteration
         if self._iterable_mode:
             it = self._iter_iterable()
         elif self.num_workers == 0:
@@ -156,6 +187,7 @@ class DataLoader:
             it = self._iter_multiprocess()
         else:
             it = self._iter_threaded()
+        it = (b for b in it if b is not _SKIPPED_BATCH)
         if self.fuse_steps is not None:
             # stack granularity subsumes per-batch prefetch: one async
             # device_put per K batches, still one stack ahead
@@ -229,8 +261,11 @@ class DataLoader:
 
     def _iter_iterable(self):
         def collate(b):
-            with _span("dataloader.fetch"):
-                out = self.collate_fn(b)
+            try:
+                with _span("dataloader.fetch"):
+                    out = self.collate_fn(b)
+            except Exception as exc:
+                return self._bad_batch(exc)
             _counter_inc("dataloader.batches")
             return out
 

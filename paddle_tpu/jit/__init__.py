@@ -54,15 +54,42 @@ class TrainStep:
     ``loss_fn(output, *labels)`` runs on Tensors (any paddle_tpu loss).
     Donates the state buffers so param memory stays flat (reference analog:
     inplace/vars GC in interpretercore; here it's XLA buffer donation).
+
+    ``guard=True`` (or ``FLAGS_train_guard``) fuses the training-health
+    guard into the program: an all-finite reduction over loss+grads whose
+    bad-step flag masks the param/opt/buffer/step update with ``jnp.where``
+    — state stays bitwise at its pre-step value on a NaN/Inf gradient, with
+    no extra dispatch and no host sync. Metrics gain a device-resident
+    ``health`` leaf ``{bad_step, grad_norm, skipped}`` (stacked ``[K]``
+    under ``run_steps``) for :class:`paddle_tpu.stability.HealthMonitor`.
+    A skipped step does NOT advance ``state["step"]`` (rng fold-in and LR
+    schedule stay aligned with a run that never saw the bad batch); the
+    cumulative skip count lives in ``state["skipped"]``.
     """
 
-    def __init__(self, model, optimizer, loss_fn, mesh=None, state_shardings=None, batch_shardings=None, remat=False, seed=0, amp_level=None, amp_dtype="bfloat16", accumulate_steps=1, return_outputs=False):
+    def __init__(self, model, optimizer, loss_fn, mesh=None, state_shardings=None, batch_shardings=None, remat=False, seed=0, amp_level=None, amp_dtype="bfloat16", accumulate_steps=1, return_outputs=False, guard=None):
         self.model = model
         self.optimizer = optimizer
         self.loss_fn = loss_fn
         self.mesh = mesh
         self.accumulate_steps = int(accumulate_steps)
         self.return_outputs = return_outputs  # include model outputs in metrics (hapi train-metric path)
+        from ..framework.flags import flag as _flag
+
+        # Training-health guard (stability subsystem): fuse an all-finite
+        # reduction over loss+grads into the step program and skip the
+        # param/opt/step update in-graph when it trips — state stays bitwise
+        # at its pre-step value (correct under donation: the select happens
+        # inside the compiled program). Metrics gain a device-resident
+        # "health" leaf; no extra dispatch, no per-step host sync.
+        self.guard = bool(_flag("FLAGS_train_guard")) if guard is None else bool(guard)
+        # Deterministic chaos: inject non-finite gradients at a named step
+        # (read HERE, at construction — the injection compiles into the
+        # program, gated by an armed budget carried in the state so it fires
+        # exactly once per process even across scans and rollbacks).
+        from ..testing import chaos as _chaos
+
+        self._nan_chaos = _chaos.nan_grads_due()
         # AMP (reference amp.decorate semantics, bf16-first for TPU).
         # O2: master params stay f32 in state; compute casts params+inputs to
         #     amp_dtype so matmuls hit the MXU at bf16; loss input back to f32.
@@ -89,20 +116,55 @@ class TrainStep:
             "step": jnp.zeros((), jnp.int32),
             "rng": jax.random.key(seed),
         }
+        if self.guard:
+            # dispatched-but-skipped update count; step + skipped together
+            # form the monotonic dispatch counter (step alone freezes on a
+            # skipped update so rng fold-in stays aligned with a clean run)
+            self.state["skipped"] = jnp.zeros((), jnp.int32)
+        if self._nan_chaos is not None:
+            self.state["chaos_nan_armed"] = jnp.asarray(self._nan_chaos[1], jnp.int32)
+        self._remat = remat
+        self._batch_shardings = batch_shardings
+        self._state_shardings = state_shardings
+        if mesh is not None and isinstance(state_shardings, dict):
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            extras = [e for e in ("skipped", "chaos_nan_armed")
+                      if e in self.state and e not in state_shardings]
+            if extras:  # guard/chaos scalar leaves ride along replicated
+                state_shardings = dict(state_shardings)
+                for extra in extras:
+                    state_shardings[extra] = NamedSharding(mesh, P())
+                self._state_shardings = state_shardings
         self._build(remat)
         if mesh is not None and state_shardings is not None:
             self.state = jax.device_put(self.state, state_shardings)
-            self._jit = jax.jit(self._step, donate_argnums=0, in_shardings=(state_shardings, batch_shardings), out_shardings=(state_shardings, None))
-            self._jit_multi = jax.jit(self._multi_step, donate_argnums=0, in_shardings=(state_shardings, None), out_shardings=(state_shardings, None))
-        else:
-            self._jit = jax.jit(self._step, donate_argnums=0)
-            self._jit_multi = jax.jit(self._multi_step, donate_argnums=0)
+        self._make_jits()
         # observability: per-batch-signature AOT executables (the retained
         # XLA Compiled handles behind explain()), their cost rows, and the
         # host-side step counter the run log indexes by
         self._compiled: Dict[tuple, Any] = {}
         self._specializations: list = []
         self._host_step = 0
+
+    def _make_jits(self):
+        if self.mesh is not None and self._state_shardings is not None:
+            self._jit = jax.jit(self._step, donate_argnums=0, in_shardings=(self._state_shardings, self._batch_shardings), out_shardings=(self._state_shardings, None))
+            self._jit_multi = jax.jit(self._multi_step, donate_argnums=0, in_shardings=(self._state_shardings, None), out_shardings=(self._state_shardings, None))
+        else:
+            self._jit = jax.jit(self._step, donate_argnums=0)
+            self._jit_multi = jax.jit(self._multi_step, donate_argnums=0)
+
+    def rebuild(self):
+        """Re-trace and re-jit the step programs against the CURRENT
+        optimizer/model hyperparameters (the compiled programs bake closed-
+        over host scalars — e.g. a plain-float learning rate — so a
+        divergence rollback's LR backoff only takes effect through a
+        rebuild). State is preserved; compiled-specialization caches are
+        dropped (next dispatch recompiles)."""
+        self._build(self._remat)
+        self._make_jits()
+        self._compiled = {}
 
     def _build(self, remat):
         model, optimizer, loss_fn = self.model, self.optimizer, self.loss_fn
@@ -151,6 +213,8 @@ class TrainStep:
             return call(params)
 
         k = self.accumulate_steps
+        guard = self.guard
+        nan_chaos = self._nan_chaos
 
         def _step(state, batch):
             inputs, labels = batch
@@ -192,16 +256,50 @@ class TrainStep:
                     from ..distributed.pipeline import unmicrobatch as _unmb
 
                     out = jax.tree_util.tree_map(_unmb, mb_out)
+            new_state = {"rng": state["rng"]}
+            if nan_chaos is not None:
+                # deterministic non-finite-gradient injection: fires while
+                # the armed budget lasts, counted on the monotonic dispatch
+                # counter (step+skipped), then drains — exactly once per
+                # process under __call__, run_steps AND post-rollback replay
+                at, _n = nan_chaos
+                ctr = state["step"] + (state["skipped"] if guard else 0)
+                fire = (state["chaos_nan_armed"] > 0) & (ctr >= at)
+                grads = jax.tree_util.tree_map(
+                    lambda g: jnp.where(fire, jnp.full_like(g, jnp.nan), g), grads)
+                new_state["chaos_nan_armed"] = (
+                    state["chaos_nan_armed"] - fire.astype(jnp.int32))
+            if guard:
+                # ONE fused reduction per grad leaf: the f32 sum-of-squares
+                # feeds both the global grad norm and the finite flag (any
+                # NaN/Inf grad makes the accumulator non-finite; an
+                # accumulator that overflows f32 marks the step bad too —
+                # such a step is garbage regardless). Cheaper than a second
+                # isfinite pass over every gradient.
+                sumsq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                            for g in jax.tree_util.tree_leaves(grads))
+                gnorm = jnp.sqrt(sumsq)
+                bad = ~(jnp.isfinite(sumsq) & jnp.isfinite(loss))
             new_params, new_opt, lr = optimizer._traced_update(
                 grads, state["opt"], state["params"], state["step"])
-            new_state = {
-                "params": new_params,
-                "buffers": new_buffers,
-                "opt": new_opt,
-                "step": state["step"] + 1,
-                "rng": state["rng"],
-            }
+            if guard:
+                # bad-step skip: select the PRE-step value for every state
+                # leaf inside the compiled program — bitwise no-op update,
+                # correct under donate_argnums (nothing escaped the program)
+                sel = lambda new, old: jnp.where(bad, old, new)  # noqa: E731
+                new_params = jax.tree_util.tree_map(sel, new_params, state["params"])
+                new_opt = jax.tree_util.tree_map(sel, new_opt, state["opt"])
+                new_buffers = jax.tree_util.tree_map(sel, new_buffers, state["buffers"])
+                new_step = jnp.where(bad, state["step"], state["step"] + 1)
+                new_state["skipped"] = state["skipped"] + bad.astype(jnp.int32)
+            else:
+                new_step = state["step"] + 1
+            new_state.update(params=new_params, buffers=new_buffers,
+                             opt=new_opt, step=new_step)
             metrics = {"loss": loss, "lr": lr}
+            if guard:
+                metrics["health"] = {"bad_step": bad, "grad_norm": gnorm,
+                                     "skipped": new_state["skipped"]}
             if self.return_outputs:
                 metrics["outputs"] = out
             return new_state, metrics
@@ -372,29 +470,45 @@ class MultiStepRunner:
     the host-side stacking here. Iterating the runner yields one stacked
     metrics dict per dispatch; a trailing group smaller than K still runs
     (one extra specialization compile for that size).
+
+    ``monitor`` (a :class:`paddle_tpu.stability.HealthMonitor`) makes the
+    runner health-aware: every dispatch's stacked metrics are fed to the
+    monitor, which handles periodic checkpointing and divergence rollback
+    (restoring ``step.state`` in place — the stream just keeps going with
+    the rewound state). One observe per K steps: the guard's no-per-step-
+    sync property is preserved.
     """
 
-    def __init__(self, step: TrainStep, k: int, prestacked: bool = False):
+    def __init__(self, step: TrainStep, k: int, prestacked: bool = False,
+                 monitor=None):
         if int(k) < 1:
             raise ValueError(f"k must be >= 1, got {k}")
         self.step = step
         self.k = int(k)
         self.prestacked = prestacked
+        self.monitor = monitor
+        if monitor is not None and monitor.train_step is None:
+            monitor.train_step = step
+
+    def _emit(self, metrics):
+        if self.monitor is not None:
+            self.monitor.observe(metrics)
+        return metrics
 
     def run(self, batch_iter):
         if self.prestacked:
             for stacked in batch_iter:
                 lead = jax.tree_util.tree_leaves(stacked)[0].shape[0]
-                yield self.step.run_steps(tuple(stacked), k=lead)
+                yield self._emit(self.step.run_steps(tuple(stacked), k=lead))
             return
         group = []
         for batch in batch_iter:
             group.append(batch)
             if len(group) == self.k:
-                yield self.step.run_steps(group)
+                yield self._emit(self.step.run_steps(group))
                 group = []
         if group:
-            yield self.step.run_steps(group)
+            yield self._emit(self.step.run_steps(group))
 
     __call__ = run
 

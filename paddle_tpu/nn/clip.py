@@ -58,7 +58,13 @@ class ClipGradByGlobalNorm(ClipGradBase):
     per-leaf square-sums over sharded grads compile to psums across the mesh,
     matching HybridParallelOptimizer's cross-group norm reduction
     (fleet/meta_optimizers/dygraph_optimizer/hybrid_parallel_optimizer.py:50)
-    with zero extra code."""
+    with zero extra code.
+
+    NaN behavior (explicit, pinned by test): a non-finite global norm makes
+    the clip scale non-finite, so every clipped gradient PROPAGATES as
+    NaN — the clip never silently "fixes" a blown-up step by scaling it
+    down. Downstream, the jit TrainStep guard (``guard=True``) detects the
+    non-finite grads and skips the update bitwise."""
 
     def __init__(self, clip_norm=1.0, group_name="default_group"):
         self.clip_norm = clip_norm
@@ -72,12 +78,35 @@ class ClipGradByGlobalNorm(ClipGradBase):
 
 
 def clip_grad_norm_(parameters, max_norm, norm_type=2.0, error_if_nonfinite=False):
-    """torch-style utility over eager parameters with .grad set."""
+    """torch-style utility over eager parameters with .grad set.
+
+    ``error_if_nonfinite=True`` raises RuntimeError when the total norm is
+    NaN/Inf (before touching any gradient); with the default False the
+    non-finite norm flows through the scale like torch: a NaN norm makes
+    every clipped gradient NaN, an Inf norm scales them to 0 — never a
+    silent "fix". The downstream train guard / GradScaler is the layer
+    expected to skip such a step.
+    """
     params = [p for p in parameters if p.grad is not None]
     if not params:
         return None
-    total = sum(jnp.sum(jnp.square(p.grad._value.astype(jnp.float32))) for p in params)
-    gnorm = jnp.sqrt(total)
+    norm_type = float(norm_type)
+    if norm_type == float("inf"):
+        gnorm = jnp.max(jnp.stack(
+            [jnp.max(jnp.abs(p.grad._value.astype(jnp.float32))) for p in params]))
+    elif norm_type == 2.0:
+        total = sum(jnp.sum(jnp.square(p.grad._value.astype(jnp.float32))) for p in params)
+        gnorm = jnp.sqrt(total)
+    else:
+        total = sum(jnp.sum(jnp.abs(p.grad._value.astype(jnp.float32)) ** norm_type)
+                    for p in params)
+        gnorm = total ** (1.0 / norm_type)
+    if error_if_nonfinite and not bool(jnp.isfinite(gnorm)):
+        raise RuntimeError(
+            f"The total norm of order {norm_type} for gradients from "
+            "`parameters` is non-finite, so it cannot be clipped. To disable "
+            "this error and scale the gradients by the non-finite norm "
+            "anyway, set `error_if_nonfinite=False`")
     scale = jnp.minimum(1.0, max_norm / jnp.maximum(gnorm, 1e-12))
     for p in params:
         p.grad._value = (p.grad._value.astype(jnp.float32) * scale).astype(p.grad._value.dtype)

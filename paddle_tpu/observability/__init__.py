@@ -42,7 +42,8 @@ for _name in (
     "executor.runs", "executor.cache_hits", "executor.cache_misses",
     "executor.compiles", "executor.donated_runs",
     "train_step.dispatches", "train_step.steps", "train_step.compiles",
-    "dataloader.batches", "dataloader.device_puts",
+    "dataloader.batches", "dataloader.device_puts", "dataloader.bad_batches",
+    "train_step.skipped", "stability.rollbacks", "amp.skipped_steps",
     "collective.all_reduce.calls", "collective.all_gather.calls",
     "collective.reduce_scatter.calls", "collective.alltoall.calls",
     "collective.broadcast.calls", "collective.barrier.calls",

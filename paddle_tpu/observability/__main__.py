@@ -5,7 +5,10 @@ Prints, from one structured run log (see :mod:`.runlog`):
 - event counts per kind and the run's wall span,
 - a per-phase time breakdown (every event carrying ``seconds``, grouped by
   event kind / component — compile vs step vs checkpoint vs dataloader),
-- step-time percentiles (p50/p90/p99) and fused-dispatch stats.
+- step-time percentiles (p50/p90/p99) and fused-dispatch stats,
+- a training-stability section (bad-step rate, loss spikes, rollbacks,
+  final loss scale) when the run produced any ``bad_step``/``loss_spike``/
+  ``rollback``/``loss_scale`` events.
 
 ``--json`` emits the same analysis as one JSON object for tooling.
 """
@@ -81,6 +84,25 @@ def analyze(events: List[dict]) -> dict:
             "p99_seconds": _percentile(step_secs, 99),
             "steps_per_sec": (len(step_secs) / total) if total > 0 else None,
         }
+    # training-stability events (bad_step / loss_spike / rollback from the
+    # HealthMonitor + train guard, loss_scale from the fp16 GradScaler)
+    bad = counts.get("bad_step", 0)
+    spikes = counts.get("loss_spike", 0)
+    rollbacks = counts.get("rollback", 0)
+    scale_evs = [ev for ev in events if ev.get("event") == "loss_scale"]
+    if bad or spikes or rollbacks or scale_evs:
+        stability = {
+            "bad_steps": bad,
+            "bad_step_rate": (bad / step_count) if step_count else None,
+            "loss_spikes": spikes,
+            "rollbacks": rollbacks,
+        }
+        if scale_evs:
+            stability["final_loss_scale"] = scale_evs[-1].get("value")
+            stability["loss_scale_transitions"] = {
+                r: sum(1 for ev in scale_evs if ev.get("reason") == r)
+                for r in ("grow", "backoff")}
+        out["stability"] = stability
     return out
 
 
@@ -106,6 +128,18 @@ def print_report(path: str, a: dict) -> None:
               f"p99 {st['p99_seconds'] * 1e3:.3f} ms")
         if st.get("steps_per_sec"):
             print(f"    {st['steps_per_sec']:.2f} steps/sec (dispatch-span based)")
+    sb = a.get("stability")
+    if sb:
+        print("  training stability:")
+        rate = sb.get("bad_step_rate")
+        print(f"    bad steps: {sb['bad_steps']}"
+              + (f" ({rate * 100:.2f}% of steps)" if rate is not None else ""))
+        print(f"    loss spikes: {sb['loss_spikes']}   "
+              f"rollbacks: {sb['rollbacks']}")
+        if "final_loss_scale" in sb:
+            tr = sb.get("loss_scale_transitions", {})
+            print(f"    loss scale: final {sb['final_loss_scale']:g} "
+                  f"(grow x{tr.get('grow', 0)}, backoff x{tr.get('backoff', 0)})")
 
 
 def main(argv=None) -> int:

@@ -41,6 +41,7 @@ __all__ = [
     "save_inference_model", "load_inference_model", "enable_static",
     "disable_static", "in_dynamic_mode", "gradients", "name_scope", "py_func",
     "global_scope", "scope_guard", "Scope", "StaleHandleError",
+    "NonFiniteError",
 ]
 
 _default_main = Program()
@@ -164,6 +165,22 @@ class StaleHandleError(RuntimeError):
     donated back to the compiled program (``FLAGS_executor_donate``)."""
 
 
+class NonFiniteError(FloatingPointError):
+    """``FLAGS_check_nan_inf`` on the jitted Executor path: a fetched output
+    or gradient came back NaN/Inf. Carries the FIRST offending variable name
+    (``.name``) — the finite checks are fused into the compiled program
+    (one reduction per checked var, no extra dispatch); only the tiny flag
+    scalars sync to host."""
+
+    def __init__(self, name: str, kind: str = "fetch"):
+        self.name = name
+        self.kind = kind
+        super().__init__(
+            f"Executor.run: {kind} variable {name!r} contains NaN/Inf "
+            "(FLAGS_check_nan_inf is set; the check is fused into the "
+            "compiled program)")
+
+
 class _StaleArray:
     """Poison value installed into Tensors whose buffer a donated run
     consumed: any use (shape/dtype/np.asarray/ops) raises StaleHandleError
@@ -190,16 +207,17 @@ class _RunPlan:
     the scope-publish targets — resolved once at build time so the per-run
     hot path is: read feed arrays, call, write back."""
 
-    __slots__ = ("fn", "params", "others", "train", "donate",
+    __slots__ = ("fn", "params", "others", "train", "donate", "check",
                  "scope", "param_vars", "fetch_vars", "compiled", "cost",
                  "label")
 
-    def __init__(self, fn, params, others, train, donate, label=""):
+    def __init__(self, fn, params, others, train, donate, label="", check=False):
         self.fn = fn
         self.params = params
         self.others = others
         self.train = train
         self.donate = donate
+        self.check = check         # FLAGS_check_nan_inf fused finite checks
         self.scope = None          # scope the publish targets below belong to
         self.param_vars = ()       # [(param Tensor, scope Variable)]
         self.fetch_vars = {}       # fetch name -> scope Variable
@@ -307,10 +325,11 @@ class Executor:
         opt = prog.optimizer
         donate = (bool(_flag("FLAGS_executor_donate")) and train
                   and opt is not None and prog.loss_var is not None)
+        check = bool(_flag("FLAGS_check_nan_inf"))
 
         with _span("executor.plan_lookup"):
             feed_sig = tuple(sorted((k, v.shape, str(v.dtype)) for k, v in feed_arrays.items()))
-            key = (prog.id, prog.version, feed_sig, tuple(fetch_names), train, donate)
+            key = (prog.id, prog.version, feed_sig, tuple(fetch_names), train, donate, check)
             plan = self._cache.get(key)
             if plan is None:
                 counter_inc("executor.cache_misses")
@@ -331,13 +350,14 @@ class Executor:
                 param_ids = {id(t) for t in params}
                 others = [t for t in refs if id(t) not in param_ids]
                 fn = self._build(prog, tuple(sorted(feed_arrays)), fetch_names,
-                                 params, others, train, donate)
+                                 params, others, train, donate, check=check)
                 label = (f"prog{prog.id}.v{prog.version}"
                          + ("/train" if train else "/infer")
                          + ("/donated" if donate else "")
                          + "/" + ",".join(f"{k}{list(s)}" for k, s, _ in feed_sig))
                 plan = self._cache[key] = _RunPlan(fn, tuple(params), tuple(others),
-                                                  train, donate, label=label)
+                                                  train, donate, label=label,
+                                                  check=check)
                 while len(self._cache) > self._CACHE_CAPACITY:
                     self._cache.popitem(last=False)  # LRU eviction
             else:
@@ -381,7 +401,7 @@ class Executor:
                          peak_bytes=plan.cost.get("peak_bytes"))
         with _span("executor.dispatch"):
             try:
-                fetched, buf_updates, new_params, new_state = (
+                fetched, buf_updates, new_params, new_state, finite = (
                     plan.compiled if plan.compiled is not None else plan.fn)(*run_args)
             except (TypeError, ValueError):
                 if plan.compiled is None:
@@ -390,7 +410,19 @@ class Executor:
                 # (weak types, device placement) fall back to the jit path
                 # permanently for this plan
                 plan.compiled = None
-                fetched, buf_updates, new_params, new_state = plan.fn(*run_args)
+                fetched, buf_updates, new_params, new_state, finite = plan.fn(*run_args)
+        if plan.check and finite:
+            # FLAGS_check_nan_inf: the all-finite flags were computed inside
+            # the compiled program; this host sync reads len(finite) booleans
+            ordered = ([n for n in fetch_names if n in finite]
+                       + sorted(set(finite) - {n for n in fetch_names if n}))
+            for name in ordered:
+                if not bool(finite[name]):
+                    from ..observability import runlog as _runlog_nf
+
+                    _runlog_nf.emit("bad_step", component="executor", var=name)
+                    raise NonFiniteError(
+                        name, kind="gradient" if name.endswith("@GRAD") else "fetch")
         if train and opt is not None:
             for p, v in zip(params, new_params):
                 p._value = v
@@ -479,11 +511,15 @@ class Executor:
             raise ProgramAnalysisError(errors)
 
     def _build(self, prog: Program, feed_names, fetch_names, params, others, train,
-               donate=False):
+               donate=False, check=False):
         opt = prog.optimizer
         param_ids = [id(p) for p in params]
         other_ids = [id(t) for t in others]
         grad_names = {id_: sv.name for id_, sv in prog.grad_vars.items()}
+
+        def _is_float(v):
+            return hasattr(v, "dtype") and (
+                jnp.issubdtype(v.dtype, jnp.floating) or v.dtype == jnp.bfloat16)
 
         def run_fn(feed_arrays, param_vals, other_vals, state):
             tensor_vals = dict(zip(other_ids, other_vals))
@@ -517,7 +553,21 @@ class Executor:
             fetched = {n: env[n] for n in fetch_names if n is not None}
             buf_updates = {sym.name: env[sym.name] for _, sym in prog.buffer_writes
                            if sym.name in env}
-            return fetched, buf_updates, new_params, new_state
+            finite = {}
+            if check:
+                # FLAGS_check_nan_inf, fused: one all-finite reduction per
+                # float fetch + per gradient, inside this same program (the
+                # eager path's per-op host-sync check has no jit analog)
+                for n, v in fetched.items():
+                    if _is_float(v):
+                        finite[n] = jnp.all(jnp.isfinite(v.astype(jnp.float32)))
+                for pid in param_ids:
+                    gname = grad_names.get(pid)
+                    if gname is not None and gname in env and gname not in finite \
+                            and _is_float(env[gname]):
+                        finite[gname] = jnp.all(jnp.isfinite(
+                            env[gname].astype(jnp.float32)))
+            return fetched, buf_updates, new_params, new_state, finite
 
         if donate:
             # donate param_vals + opt state (the two pytrees the update

@@ -23,6 +23,11 @@ Injection points:
 - **freeze heartbeat**: ``heartbeat_frozen(node_id)`` silences an
   ElasticNode's refresh thread — the node stays up but looks dead to
   the membership view (a zombie/partitioned host).
+- **NaN gradients in-graph**: ``nan_grads_due()`` tells a compiling
+  ``jit.TrainStep`` to fuse a deterministic non-finite-gradient
+  injection into its program (``FLAGS_chaos_nan_at_step``; an armed
+  budget carried in the step state makes it fire exactly once per
+  process, even across ``run_steps`` scans and divergence rollbacks).
 """
 from __future__ import annotations
 
@@ -106,6 +111,21 @@ def store_op(op: str, key: str):
         _emit_inject(kind="store_drop", op=op, key=key)
         raise ChaosError(f"chaos: dropped store op {op}({key!r}) "
                          f"[{n + 1}{'/' + str(limit) if limit >= 0 else ''}]")
+
+
+def nan_grads_due():
+    """``(step, n_steps)`` when the in-graph NaN-gradient injection is armed
+    (FLAGS_chaos + FLAGS_chaos_nan_at_step >= 0), else None. Read by
+    ``jit.TrainStep`` at construction — the injection compiles into the step
+    program, so arming after the TrainStep is built has no effect."""
+    if not enabled():
+        return None
+    at = flag("FLAGS_chaos_nan_at_step")
+    if at < 0:
+        return None
+    n = max(int(flag("FLAGS_chaos_nan_steps")), 1)
+    _emit_inject(step=at, kind="nan_grads", n_steps=n)
+    return int(at), n
 
 
 def heartbeat_frozen(node_id) -> bool:

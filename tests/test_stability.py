@@ -1,0 +1,658 @@
+"""Training-health guard: in-graph anomaly detection, bad-step skip, and
+divergence rollback (paddle_tpu.stability + TrainStep(guard=True)).
+
+Pinned contracts:
+
+- A guarded step with non-finite gradients leaves params/opt-state/step/rng
+  BITWISE at their pre-step values (the where-select happens inside the
+  compiled, donated program), and the run ends bitwise-equal to the same
+  program run without the bad batch.
+- run_steps stays ONE dispatch per call with the guard fused in, and
+  donation stays on.
+- The chaos NaN injector (FLAGS_chaos_nan_at_step) fires exactly once,
+  under both __call__ and run_steps.
+- HealthMonitor: K consecutive bad steps trigger a CheckpointManager
+  rollback and training resumes to completion; spikes are detected against
+  a quarantined loss EMA; run_resilient answers DivergenceFault without
+  persisting the diverged state.
+- fp16 GradScaler: overflow -> backoff + skipped update; incr_every_n
+  clean steps -> scale grows; loss_scale gauge + run-log events track both.
+"""
+import json
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import observability, profiler
+from paddle_tpu.framework.flags import get_flags, set_flags
+from paddle_tpu.jit import MultiStepRunner, TrainStep
+from paddle_tpu.observability import metrics as obs_metrics
+from paddle_tpu.stability import (
+    DivergenceError,
+    DivergenceFault,
+    HealthMonitor,
+    state_to_savable,
+)
+from paddle_tpu.testing import chaos
+
+
+def _make_step(seed=1, guard=True, **kw):
+    paddle.seed(seed)
+    net = nn.Sequential(nn.Linear(8, 16), nn.GELU(), nn.Linear(16, 4))
+    return TrainStep(net, paddle.optimizer.Adam(learning_rate=1e-2),
+                     nn.CrossEntropyLoss(), guard=guard, **kw)
+
+
+def _batches(n, rng_seed=0):
+    rng = np.random.default_rng(rng_seed)
+    return [(rng.normal(size=(4, 8)).astype("float32"),
+             rng.integers(0, 4, 4).astype("int64")) for _ in range(n)]
+
+
+def _assert_states_equal(a, b, keys=("params", "opt", "step")):
+    for key in keys:
+        la = jax.tree_util.tree_leaves(a[key])
+        lb = jax.tree_util.tree_leaves(b[key])
+        for x, y in zip(la, lb):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+    np.testing.assert_array_equal(jax.random.key_data(a["rng"]),
+                                  jax.random.key_data(b["rng"]))
+
+
+class TestGuardInGraph:
+    def test_health_leaf_and_clean_run(self):
+        """Guarded clean run: health leaf present, no skips, finite grad
+        norm, state numerically equal to the unguarded program."""
+        batches = _batches(4)
+        a = _make_step(guard=False)
+        b = _make_step(guard=True)
+        for x, y in batches:
+            a(x, y)
+            m = b(x, y)
+        h = m["health"]
+        assert not bool(np.asarray(h["bad_step"]._value))
+        assert np.isfinite(float(np.asarray(h["grad_norm"]._value)))
+        assert int(np.asarray(h["skipped"]._value)) == 0
+        assert int(np.asarray(b.state["skipped"])) == 0
+        # different XLA program (guard ops fused in) -> allclose, not bitwise
+        for k in a.state["params"]:
+            np.testing.assert_allclose(np.asarray(a.state["params"][k]),
+                                       np.asarray(b.state["params"][k]),
+                                       rtol=1e-5, atol=1e-7)
+
+    def test_bad_step_freezes_state_bitwise(self):
+        """Params/opt-state after the injected-NaN step are bitwise equal to
+        their pre-step values; step counter does not advance."""
+        with chaos.inject(FLAGS_chaos_nan_at_step=2):
+            g = _make_step()
+        batches = _batches(6)
+        for x, y in batches[:2]:
+            g(x, y)
+        snap_p = {k: np.asarray(v) for k, v in g.state["params"].items()}
+        snap_o = [np.asarray(l) for l in jax.tree_util.tree_leaves(g.state["opt"])]
+        m = g(*batches[2])
+        assert bool(np.asarray(m["health"]["bad_step"]._value))
+        for k in snap_p:
+            np.testing.assert_array_equal(snap_p[k], np.asarray(g.state["params"][k]))
+        for a, b in zip(snap_o, jax.tree_util.tree_leaves(g.state["opt"])):
+            np.testing.assert_array_equal(a, np.asarray(b))
+        assert int(np.asarray(g.state["step"])) == 2   # frozen
+        assert int(np.asarray(g.state["skipped"])) == 1
+
+    def test_guarded_run_bitwise_equals_clean_run_without_bad_batch(self):
+        """Tier-1 pin: a guarded run with one injected NaN step ends bitwise
+        equal to the same program run without that batch (rng fold-in and LR
+        schedule stay aligned because a skipped step does not advance
+        state['step'])."""
+        batches = _batches(6)
+        with chaos.inject(FLAGS_chaos_nan_at_step=2):
+            g = _make_step()   # armed: fires at dispatch 2
+            c = _make_step()   # same program, disarmed below
+        c.state["chaos_nan_armed"] = jnp.zeros((), jnp.int32)
+        for x, y in batches:
+            g(x, y)
+        for i, (x, y) in enumerate(batches):
+            if i == 2:
+                continue
+            c(x, y)
+        _assert_states_equal(g.state, c.state)
+        assert int(np.asarray(g.state["skipped"])) == 1
+        assert int(np.asarray(g.state["chaos_nan_armed"])) == 0  # fired once
+
+    def test_run_steps_guarded_single_dispatch_and_donation(self):
+        """The scan path: injection + skip inside ONE dispatch, stacked [K]
+        health leaves, state buffers still donated."""
+        batches = _batches(6)
+        with chaos.inject(FLAGS_chaos_nan_at_step=2):
+            g = _make_step()
+            c = _make_step()
+        c.state["chaos_nan_armed"] = jnp.zeros((), jnp.int32)
+        old_leaf = next(iter(g.state["params"].values()))
+        profiler.reset_counters("train_step.")
+        metrics = g.run_steps(batches)
+        counts = profiler.counters("train_step.")
+        assert counts["train_step.dispatches"] == 1
+        assert counts["train_step.steps"] == 6
+        assert old_leaf.is_deleted()  # donation stays on with the guard fused
+        bad = np.asarray(metrics["health"]["bad_step"]._value)
+        assert bad.shape == (6,)
+        assert list(bad.astype(int)) == [0, 0, 1, 0, 0, 0]
+        skipped = np.asarray(metrics["health"]["skipped"]._value)
+        assert list(skipped.astype(int)) == [0, 0, 1, 1, 1, 1]
+        for i, (x, y) in enumerate(batches):
+            if i == 2:
+                continue
+            c(x, y)
+        _assert_states_equal(g.state, c.state)
+
+    def test_chaos_fires_once_under_call_and_run_steps(self):
+        """The injector drains its armed budget: a second pass over the same
+        step index does NOT re-fire."""
+        with chaos.inject(FLAGS_chaos_nan_at_step=1):
+            g = _make_step()
+        batches = _batches(4)
+        m = g.run_steps(batches)
+        bad = np.asarray(m["health"]["bad_step"]._value).astype(int)
+        assert list(bad) == [0, 1, 0, 0]
+        assert int(np.asarray(g.state["chaos_nan_armed"])) == 0
+        m2 = g.run_steps(batches)
+        assert not np.asarray(m2["health"]["bad_step"]._value).any()
+
+    def test_flag_enables_guard(self):
+        prev = get_flags(["FLAGS_train_guard"])
+        set_flags({"FLAGS_train_guard": True})
+        try:
+            step = _make_step(guard=None)
+        finally:
+            set_flags(prev)
+        assert step.guard
+        assert "skipped" in step.state
+        m = step(*_batches(1)[0])
+        assert "health" in m
+
+    def test_unguarded_chaos_poisons_params(self):
+        """Without the guard the injected NaN propagates into params — the
+        failure mode the guard exists to stop."""
+        with chaos.inject(FLAGS_chaos_nan_at_step=0):
+            u = _make_step(guard=False)
+        x, y = _batches(1)[0]
+        u(x, y)
+        leaf = np.asarray(next(iter(u.state["params"].values())))
+        assert np.isnan(leaf).any()
+        assert "skipped" not in u.state  # unguarded state schema unchanged
+
+
+class TestGradScalerDynamic:
+    def _setup(self):
+        paddle.seed(0)
+        lin = paddle.nn.Linear(4, 4)
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=lin.parameters())
+        return lin, opt
+
+    def test_growth_after_clean_steps(self):
+        lin, opt = self._setup()
+        scaler = paddle.amp.GradScaler(init_loss_scaling=8.0,
+                                       incr_every_n_steps=2,
+                                       decr_every_n_nan_or_inf=1)
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        for _ in range(2):
+            scaler.scale(paddle.mean(lin(x))).backward()
+            scaler.step(opt)
+            opt.clear_grad()
+        assert scaler.get_loss_scaling() == 16.0  # doubled after 2 clean
+        assert obs_metrics.gauges("amp.")["amp.loss_scale"] == 16.0
+        evs = observability.monitor().events("loss_scale")
+        assert any(e.get("reason") == "grow" and e.get("value") == 16.0
+                   for e in evs)
+
+    def test_overflow_backoff_and_skip(self):
+        lin, opt = self._setup()
+        scaler = paddle.amp.GradScaler(init_loss_scaling=8.0,
+                                       incr_every_n_steps=1000,
+                                       decr_every_n_nan_or_inf=1)
+        obs_metrics.reset_counters("amp.")
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        scaler.scale(paddle.mean(lin(x))).backward()
+        p = opt._params[0]
+        p.grad._value = p.grad._value * np.inf
+        w = np.asarray(p._value).copy()
+        scaler.step(opt)
+        assert scaler.get_loss_scaling() == 4.0  # backed off
+        np.testing.assert_array_equal(w, np.asarray(p._value))  # skipped
+        assert obs_metrics.counters("amp.")["amp.skipped_steps"] == 1
+        evs = observability.monitor().events("loss_scale")
+        assert any(e.get("reason") == "backoff" and e.get("value") == 4.0
+                   for e in evs)
+        assert obs_metrics.gauges("amp.")["amp.loss_scale"] == 4.0
+
+    def test_disabled_passthrough(self):
+        lin, opt = self._setup()
+        scaler = paddle.amp.GradScaler(enable=False)
+        loss = paddle.mean(lin(paddle.to_tensor(np.ones((2, 4), np.float32))))
+        assert scaler.scale(loss) is loss  # bf16-style pass-through
+
+
+class TestHealthMonitor:
+    def test_k_consecutive_bad_steps_roll_back_and_resume(self, tmp_path):
+        """Acceptance pin: unguarded NaN injection poisons the params, the
+        monitor sees K consecutive non-finite losses, restores the newest
+        valid checkpoint via CheckpointManager.restore_latest, and training
+        runs to completion with a finite loss."""
+        from paddle_tpu.distributed.resilience import CheckpointManager
+
+        with chaos.inject(FLAGS_chaos_nan_at_step=4):
+            ts = _make_step(seed=3, guard=False)
+        mgr = CheckpointManager(str(tmp_path / "ckpt"), keep_last_k=3)
+        mon = HealthMonitor(manager=mgr, train_step=ts, k_bad_steps=3,
+                            checkpoint_every=2, max_rollbacks=2)
+        obs_metrics.reset_counters("stability.")
+        rolled = []
+        for x, y in _batches(12):
+            m = ts(x, y)
+            info = mon.observe(m)
+            if info:
+                rolled.append(info)
+        assert len(rolled) == 1
+        assert rolled[0]["reason"].endswith("consecutive bad steps")
+        assert rolled[0]["restored_step"] == 4
+        assert np.isfinite(float(m["loss"]))
+        for leaf in jax.tree_util.tree_leaves(ts.state["params"]):
+            assert np.isfinite(np.asarray(leaf)).all()
+        assert obs_metrics.counters("stability.")["stability.rollbacks"] == 1
+        assert observability.monitor().events("rollback")
+
+    def test_guarded_bad_steps_counted_from_health_leaf(self, tmp_path):
+        """With the guard on, the monitor counts skips from the device-side
+        cumulative counter (no double counting across stacked leaves)."""
+        with chaos.inject(FLAGS_chaos_nan_at_step=1, FLAGS_chaos_nan_steps=2):
+            ts = _make_step()
+        obs_metrics.reset_counters("train_step.skipped")
+        mon = HealthMonitor(k_bad_steps=5)
+        mon.observe(ts.run_steps(_batches(6)))
+        assert obs_metrics.counters("train_step.skipped")["train_step.skipped"] == 2
+        assert int(np.asarray(ts.state["skipped"])) == 2
+
+    def test_spike_detection_with_quarantined_ema(self):
+        """A sustained spike trips after spike_patience steps; the spiking
+        losses never feed the EMA (the spike cannot normalize itself)."""
+        mon = HealthMonitor(k_bad_steps=100, spike_factor=3.0,
+                            spike_patience=3, ema_alpha=0.5,
+                            raise_on_divergence=True)
+        for _ in range(5):
+            mon.observe_loss(1.0)
+        mon.observe_loss(10.0)
+        mon.observe_loss(10.0)
+        assert mon.ema == pytest.approx(1.0)  # quarantined
+        with pytest.raises(DivergenceFault):
+            mon.observe_loss(10.0)
+        assert observability.monitor().events("loss_spike")
+
+    def test_divergence_without_manager_raises(self):
+        mon = HealthMonitor(k_bad_steps=2)
+        mon.observe_loss(float("nan"))
+        with pytest.raises(DivergenceError, match="no CheckpointManager"):
+            mon.observe_loss(float("nan"))
+
+    def test_check_every_buffers_without_sync(self):
+        mon = HealthMonitor(k_bad_steps=1, check_every=3,
+                            raise_on_divergence=True)
+        assert mon.observe({"loss": float("nan")}) is None
+        assert mon.observe({"loss": float("nan")}) is None
+        assert mon.step == 0  # nothing materialized yet
+        with pytest.raises(DivergenceFault):
+            mon.observe({"loss": float("nan")})
+
+    def test_rollback_budget_exhaustion(self, tmp_path):
+        from paddle_tpu.distributed.resilience import CheckpointManager
+
+        ts = _make_step(seed=5, guard=False)
+        mgr = CheckpointManager(str(tmp_path / "ck"), keep_last_k=2)
+        mgr.save(state_to_savable(ts.state), 0)
+        mon = HealthMonitor(manager=mgr, train_step=ts, k_bad_steps=1,
+                            max_rollbacks=1)
+        assert mon.observe_loss(float("nan"))["rollbacks"] == 1
+        with pytest.raises(DivergenceError, match="budget"):
+            mon.observe_loss(float("nan"))
+
+    def test_lr_backoff_rebuilds_step(self, tmp_path):
+        from paddle_tpu.distributed.resilience import CheckpointManager
+
+        ts = _make_step(seed=6)
+        mgr = CheckpointManager(str(tmp_path / "ck"), keep_last_k=2)
+        mgr.save(state_to_savable(ts.state), 0)
+        mon = HealthMonitor(manager=mgr, train_step=ts, k_bad_steps=1,
+                            lr_backoff=0.5)
+        seeds = []
+        mon.reshuffle = seeds.append
+        info = mon.observe_loss(float("nan"))
+        assert info["restored_step"] == 0
+        assert ts.optimizer.get_lr() == pytest.approx(5e-3)  # 1e-2 * 0.5
+        assert seeds == [1]  # reshuffle hook saw the bumped seed
+        # the rebuilt program bakes the new LR
+        m = ts(*_batches(1)[0])
+        assert float(m["lr"]) == pytest.approx(5e-3)
+
+    def test_multi_step_runner_monitor_wiring(self, tmp_path):
+        """MultiStepRunner(monitor=...) feeds every stacked dispatch to the
+        monitor, which checkpoints and rolls back in place."""
+        from paddle_tpu.distributed.resilience import CheckpointManager
+
+        with chaos.inject(FLAGS_chaos_nan_at_step=4):
+            ts = _make_step(seed=3, guard=False)
+        mgr = CheckpointManager(str(tmp_path / "ck"), keep_last_k=3)
+        mon = HealthMonitor(manager=mgr, k_bad_steps=3, checkpoint_every=2,
+                            max_rollbacks=2)
+        runner = MultiStepRunner(ts, 2, monitor=mon)
+        assert mon.train_step is ts  # attached by the runner
+        outs = list(runner.run(iter(_batches(12))))
+        assert len(outs) == 6
+        assert mon.rollbacks == 1
+        for leaf in jax.tree_util.tree_leaves(ts.state["params"]):
+            assert np.isfinite(np.asarray(leaf)).all()
+
+    def test_run_resilient_divergence_fault_skips_hold_save(self, tmp_path):
+        """run_resilient answers DivergenceFault with restore WITHOUT the
+        HOLD save: the diverged state is never persisted."""
+        from paddle_tpu.distributed.elastic import ElasticNode
+        from paddle_tpu.distributed.resilience import (
+            CheckpointManager,
+            run_resilient,
+        )
+
+        class _Node:
+            def alive_nodes(self):
+                return [0]
+
+            def wait_for(self, *a, **kw):
+                return [0]
+
+        mgr = CheckpointManager(str(tmp_path / "ck"), keep_last_k=5)
+        mon = HealthMonitor(k_bad_steps=1, raise_on_divergence=True)
+        poisoned_saves = []
+        orig_save = mgr.save
+
+        def spy_save(state, step):
+            poisoned_saves.append((step, float(state["w"][0])))
+            return orig_save(state, step)
+
+        mgr.save = spy_save
+        fired = []
+
+        def step_fn(state, step, members):
+            w = state["w"] + 1.0
+            if step == 3 and not fired:
+                fired.append(step)
+                w = w * np.nan
+            mon.observe_loss(float(w[0]))
+            return {"w": w}
+
+        state, restarts = run_resilient(
+            step_fn, node=_Node(), manager=mgr,
+            init_state={"w": np.zeros((1,), np.float32)}, num_steps=6,
+            checkpoint_every=1, backoff=0.0, settle=0.0)
+        assert restarts == 1
+        assert np.isfinite(state["w"]).all()
+        assert all(np.isfinite(v) for _, v in poisoned_saves)  # never saved NaN
+
+    def test_rollback_preserves_drained_chaos_budget(self, tmp_path):
+        """Restoring a checkpoint saved while the injector was still armed
+        must NOT re-arm it (the injected fault would replay forever)."""
+        from paddle_tpu.distributed.resilience import CheckpointManager
+
+        with chaos.inject(FLAGS_chaos_nan_at_step=3):
+            ts = _make_step(seed=3, guard=False)
+        mgr = CheckpointManager(str(tmp_path / "ck"), keep_last_k=5)
+        mgr.save(state_to_savable(ts.state), 0)  # armed=1 in this checkpoint
+        for x, y in _batches(5):
+            ts(x, y)  # injector fires at step 3 and drains
+        assert int(np.asarray(ts.state["chaos_nan_armed"])) == 0
+        mon = HealthMonitor(manager=mgr, train_step=ts, k_bad_steps=1)
+        mon.observe_loss(float("nan"))
+        assert int(np.asarray(ts.state["chaos_nan_armed"])) == 0  # stays drained
+
+
+class TestExecutorNonFinite:
+    def _program(self):
+        from paddle_tpu import static
+
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [4, 3], "float32")
+            w = paddle.create_parameter([3, 2], "float32")
+            loss = paddle.mean(paddle.matmul(x, w))
+            paddle.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        return main, startup, loss
+
+    def test_raises_named_structured_error(self):
+        from paddle_tpu import static
+
+        paddle.enable_static()
+        try:
+            main, startup, loss = self._program()
+            exe = static.Executor()
+            exe.run(startup)
+            prev = get_flags(["FLAGS_check_nan_inf"])
+            set_flags({"FLAGS_check_nan_inf": True})
+            try:
+                out = exe.run(main, feed={"x": np.ones((4, 3), np.float32)},
+                              fetch_list=[loss])
+                assert np.isfinite(out[0]).all()  # clean run passes
+                with pytest.raises(static.NonFiniteError) as ei:
+                    exe.run(main,
+                            feed={"x": np.full((4, 3), np.nan, np.float32)},
+                            fetch_list=[loss])
+                assert ei.value.name == loss._value.name  # first bad fetch named
+                assert ei.value.name in str(ei.value)
+                assert isinstance(ei.value, FloatingPointError)
+            finally:
+                set_flags(prev)
+        finally:
+            paddle.disable_static()
+
+    def test_off_by_default_passes_nan_through(self):
+        from paddle_tpu import static
+
+        paddle.enable_static()
+        try:
+            main, startup, loss = self._program()
+            exe = static.Executor()
+            exe.run(startup)
+            out = exe.run(main, feed={"x": np.full((4, 3), np.nan, np.float32)},
+                          fetch_list=[loss])
+            assert np.isnan(out[0]).all()
+        finally:
+            paddle.disable_static()
+
+
+class TestDataLoaderPoisonSamples:
+    class _PoisonDataset:
+        def __init__(self, n=16, bad={3}):
+            self.n, self.bad = n, set(bad)
+
+        def __len__(self):
+            return self.n
+
+        def __getitem__(self, i):
+            if i in self.bad:
+                raise ValueError(f"poison sample {i}")
+            return np.float32([i]), np.int64(i % 2)
+
+    def test_skips_bad_batches_bounded(self):
+        from paddle_tpu.io import DataLoader
+
+        prev = get_flags(["FLAGS_dataloader_max_bad_batches"])
+        set_flags({"FLAGS_dataloader_max_bad_batches": 2})
+        obs_metrics.reset_counters("dataloader.bad_batches")
+        try:
+            dl = DataLoader(self._PoisonDataset(), batch_size=2, shuffle=False)
+            batches = list(dl)
+            assert len(batches) == 7  # 8 batches, 1 poisoned and skipped
+            assert obs_metrics.counters("dataloader.bad_batches")[
+                "dataloader.bad_batches"] == 1
+            evs = observability.monitor().events("bad_batch")
+            assert evs and "poison sample 3" in evs[-1]["error"]
+            # budget is per-iteration: a second epoch works too
+            assert len(list(dl)) == 7
+        finally:
+            set_flags(prev)
+
+    def test_budget_exceeded_raises(self):
+        from paddle_tpu.io import DataLoader
+
+        prev = get_flags(["FLAGS_dataloader_max_bad_batches"])
+        set_flags({"FLAGS_dataloader_max_bad_batches": 1})
+        try:
+            dl = DataLoader(self._PoisonDataset(bad={1, 5}), batch_size=2,
+                            shuffle=False)
+            with pytest.raises(RuntimeError, match="exceeds"):
+                list(dl)
+        finally:
+            set_flags(prev)
+
+    def test_off_by_default_raises_original(self):
+        from paddle_tpu.io import DataLoader
+
+        dl = DataLoader(self._PoisonDataset(), batch_size=2, shuffle=False)
+        with pytest.raises(ValueError, match="poison sample"):
+            list(dl)
+
+    def test_threaded_workers_skip_too(self):
+        from paddle_tpu.io import DataLoader
+
+        prev = get_flags(["FLAGS_dataloader_max_bad_batches"])
+        set_flags({"FLAGS_dataloader_max_bad_batches": 4})
+        try:
+            dl = DataLoader(self._PoisonDataset(bad={0, 7}), batch_size=2,
+                            shuffle=False, num_workers=2)
+            assert len(list(dl)) == 6
+        finally:
+            set_flags(prev)
+
+
+class TestClipNonFinite:
+    def _params_with_grads(self, bad=False):
+        paddle.seed(0)
+        lin = paddle.nn.Linear(4, 4)
+        loss = paddle.mean(lin(paddle.to_tensor(np.ones((2, 4), np.float32))))
+        loss.backward()
+        params = list(lin.parameters())
+        if bad:
+            params[0].grad._value = params[0].grad._value * np.nan
+        return params
+
+    def test_error_if_nonfinite_raises(self):
+        params = self._params_with_grads(bad=True)
+        with pytest.raises(RuntimeError, match="non-finite"):
+            nn.clip_grad_norm_(params, 1.0, error_if_nonfinite=True)
+
+    def test_default_propagates_nan(self):
+        params = self._params_with_grads(bad=True)
+        gnorm = nn.clip_grad_norm_(params, 1.0)
+        assert not np.isfinite(float(gnorm))
+        for p in params:
+            assert np.isnan(np.asarray(p.grad._value)).all()
+
+    def test_finite_path_and_inf_norm(self):
+        params = self._params_with_grads()
+        gnorm = nn.clip_grad_norm_(params, 1e-3, norm_type=float("inf"))
+        assert float(gnorm) > 0
+        mx = max(np.abs(np.asarray(p.grad._value)).max() for p in params)
+        assert mx <= 1e-3 + 1e-9
+
+    def test_global_norm_clip_propagates_nan(self):
+        """ClipGradByGlobalNorm: a non-finite global norm propagates into
+        every clipped grad — documented, never a silent clip."""
+        clip = nn.ClipGradByGlobalNorm(1.0)
+        grads = {0: jnp.ones((3,)), 1: jnp.asarray([np.nan, 1.0])}
+        out = clip.apply_tree(grads)
+        assert np.isnan(np.asarray(out[0])).all()
+        assert np.isnan(np.asarray(out[1])).all()
+
+
+class TestHapiTrainingHealth:
+    @staticmethod
+    def _model():
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(4, 4), nn.ReLU(), nn.Linear(4, 2))
+        model = paddle.Model(net)
+        model.prepare(paddle.optimizer.Adam(learning_rate=1e-2,
+                                            parameters=net.parameters()),
+                      nn.CrossEntropyLoss())
+        return model
+
+    @staticmethod
+    def _batches(n, poison=()):
+        rng = np.random.default_rng(0)
+        out = []
+        for i in range(n):
+            x = rng.normal(size=(4, 4)).astype("float32")
+            if i in poison:
+                x[:] = np.nan
+            out.append((x, np.zeros((4,), np.int64)))
+        return out
+
+    def test_stops_fit_on_divergence(self):
+        """NaN inputs from some batch on make every loss non-finite; the
+        callback stops fit instead of burning the remaining epochs."""
+        from paddle_tpu.hapi.callbacks import TrainingHealth
+
+        model = self._model()
+        cb = TrainingHealth(k_bad_steps=2, verbose=0)
+        model.fit(self._batches(8, poison=range(3, 8)), epochs=3,
+                  callbacks=[cb], verbose=0)
+        assert model.stop_training
+
+    def test_rolls_back_with_manager(self, tmp_path):
+        from paddle_tpu.distributed.resilience import CheckpointManager
+        from paddle_tpu.hapi.callbacks import TrainingHealth
+
+        model = self._model()
+        mgr = CheckpointManager(str(tmp_path / "ck"), keep_last_k=3)
+        cb = TrainingHealth(manager=mgr, k_bad_steps=2, checkpoint_every=2,
+                            verbose=0)
+        model.fit(self._batches(8, poison=(4, 5)), epochs=1,
+                  callbacks=[cb], verbose=0)
+        assert cb.monitor.rollbacks == 1
+        assert not model.stop_training
+
+
+class TestReportStability:
+    def test_analyze_and_cli(self, tmp_path, capsys):
+        from paddle_tpu.observability.__main__ import analyze, main
+
+        events = [
+            {"event": "step", "ts": 0.0, "k": 4, "seconds": 0.4},
+            {"event": "bad_step", "ts": 0.1, "step": 2},
+            {"event": "loss_spike", "ts": 0.2, "step": 3, "loss": 9.0},
+            {"event": "loss_scale", "ts": 0.3, "reason": "grow", "value": 16.0},
+            {"event": "loss_scale", "ts": 0.4, "reason": "backoff", "value": 8.0},
+            {"event": "rollback", "ts": 0.5, "restored_step": 2},
+        ]
+        path = tmp_path / "run.jsonl"
+        path.write_text("\n".join(json.dumps(e) for e in events) + "\n")
+        a = analyze(events)
+        sb = a["stability"]
+        assert sb["bad_steps"] == 1
+        assert sb["bad_step_rate"] == pytest.approx(0.25)
+        assert sb["rollbacks"] == 1
+        assert sb["loss_spikes"] == 1
+        assert sb["final_loss_scale"] == 8.0
+        assert sb["loss_scale_transitions"] == {"grow": 1, "backoff": 1}
+        assert main(["report", str(path)]) == 0
+        out = capsys.readouterr().out
+        assert "training stability:" in out
+        assert "rollbacks: 1" in out
+        assert main(["report", str(path), "--json"]) == 0
+        parsed = json.loads(capsys.readouterr().out)
+        assert parsed["stability"]["final_loss_scale"] == 8.0
+
+    def test_no_stability_section_when_clean(self):
+        from paddle_tpu.observability.__main__ import analyze
+
+        a = analyze([{"event": "step", "ts": 0.0, "k": 1, "seconds": 0.1}])
+        assert "stability" not in a
