@@ -1,0 +1,234 @@
+"""Serving benchmark: continuous-batching GPT decode on one chip.
+
+Prints ONE JSON line on the bench.py schema: {"metric", "value", "unit",
+"vs_baseline", ...}. Three measurements:
+
+1. **decode tokens/sec** through the static-KV-cache DecodeEngine (exactly
+   two compiled programs: bucketed prefill + the decode step, donated cache
+   buffers) vs the legacy growing-concat eager cache decode
+   (``GPTBlock(cache=gen_cache(...))``) — ``decode_speedup`` is the
+   engine-vs-concat ratio the serving tentpole is gated on (≥3x on CPU);
+2. **requests/sec + latency p50/p99 + TTFT** from a continuous-batching run:
+   R requests with mixed prompt lengths admitted into B slots in flight;
+3. **time_to_first_token** cold: build + 2 compiles + first prefill.
+
+Like bench.py, the process NEVER hangs into the driver's timeout and never
+exits non-zero: the default backend is probed in a throwaway child first and
+the run falls back to the CPU platform when the TPU is unreachable.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def _percentile(sorted_vals, q):
+    if not sorted_vals:
+        return None
+    idx = (q / 100.0) * (len(sorted_vals) - 1)
+    lo = int(idx)
+    hi = min(lo + 1, len(sorted_vals) - 1)
+    return sorted_vals[lo] + (sorted_vals[hi] - sorted_vals[lo]) * (idx - lo)
+
+
+def _measure():
+    import jax
+
+    if os.environ.get("BENCH_FORCE_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+
+    import paddle_tpu as paddle
+    from paddle_tpu import profiler
+    from paddle_tpu.inference import ContinuousBatchingScheduler, DecodeEngine
+    from paddle_tpu.models.gpt import GPTConfig, GPTForPretraining
+
+    t_start = time.perf_counter()
+    d0 = jax.devices()[0]
+    on_tpu = d0.platform in ("tpu", "axon") or "TPU" in getattr(d0, "device_kind", "")
+    if on_tpu:
+        cfg = GPTConfig(vocab_size=50304, hidden_size=1024, num_layers=16,
+                        num_heads=16, max_seq_len=1024)
+        slots, max_seq, max_new, n_requests, decode_tokens = 8, 1024, 64, 32, 128
+        buckets = (64, 128, 256, 512)
+    else:
+        cfg = GPTConfig.tiny()
+        slots, max_seq, max_new, n_requests, decode_tokens = 4, 128, 12, 12, 48
+        buckets = (8, 16, 32)
+
+    paddle.seed(0)
+    model = GPTForPretraining(cfg)
+    model.eval()
+    rng = np.random.default_rng(0)
+
+    # --- engine decode throughput (and the 2-compile pin + TTFT cold) ----
+    profiler.reset_counters("infer.")
+    engine = DecodeEngine(model, max_batch_slots=slots, max_seq_len=max_seq,
+                          prefill_buckets=buckets)
+    prompt = rng.integers(0, cfg.vocab_size, (slots, buckets[0] // 2)).astype("int32")
+    t0 = time.perf_counter()
+    engine.generate(prompt, max_new_tokens=2)  # compiles prefill + step
+    ttft_cold = time.perf_counter() - t_start
+    compiles = int(profiler.counters("infer.").get("infer.compiles", 0))
+    # warm decode: one prefill per slot then decode_tokens fused steps
+    engine.generate(prompt, max_new_tokens=2)  # warm both programs
+    t0 = time.perf_counter()
+    out = engine.generate(prompt, max_new_tokens=decode_tokens)
+    dt_engine = time.perf_counter() - t0
+    engine_tps = slots * decode_tokens / dt_engine
+    assert out.shape == (slots, prompt.shape[1] + decode_tokens)
+
+    # --- growing-concat baseline (the legacy eager cache= decode path) ---
+    from paddle_tpu.models.gpt import GPTBlock
+
+    concat_tokens = max(8, decode_tokens // 4)  # eager is slow; scale count
+    blocks = [GPTBlock(cfg) for _ in range(cfg.num_layers)]
+    for b in blocks:
+        b.eval()
+    emb = model.gpt.embeddings
+    x = paddle.to_tensor(prompt[:, :1])
+
+    def concat_decode(n_tokens):
+        caches = [b.gen_cache(emb(x)) for b in blocks]
+        h = emb(x)
+        for _ in range(n_tokens):
+            for i, b in enumerate(blocks):
+                h, caches[i] = b(h, cache=caches[i])
+            h = h[:, -1:].detach()
+        return h
+
+    concat_decode(2)  # warm eager dispatch paths
+    t0 = time.perf_counter()
+    concat_decode(concat_tokens)
+    dt_concat = time.perf_counter() - t0
+    concat_tps = slots * concat_tokens / dt_concat
+    speedup = engine_tps / concat_tps if concat_tps > 0 else None
+
+    # --- continuous batching: requests/sec + latency percentiles ---------
+    engine2 = DecodeEngine(model, max_batch_slots=slots, max_seq_len=max_seq,
+                           prefill_buckets=buckets)
+    # warm every prefill bucket + the decode step BEFORE any request's
+    # latency clock starts — the serving numbers measure dispatch, not
+    # compile (compile cost is reported separately as TTFT cold)
+    for blen in buckets:
+        engine2.generate(rng.integers(0, cfg.vocab_size, (1, blen)).astype("int32"),
+                         max_new_tokens=2)
+    sched = ContinuousBatchingScheduler(engine2)
+    lens = rng.integers(buckets[0] // 2, buckets[-1] // 2, n_requests)
+    for n in lens:
+        sched.submit(rng.integers(0, cfg.vocab_size, (int(n),)).astype("int32"),
+                     max_new_tokens=max_new)
+    t0 = time.perf_counter()
+    done = sched.run()
+    dt_serve = time.perf_counter() - t0
+    lat = sorted(r.total_seconds for r in done.values())
+    ttft = sorted(r.ttft_seconds for r in done.values())
+    requests_per_sec = len(done) / dt_serve if dt_serve > 0 else None
+
+    config_key = (f"{d0.device_kind or d0.platform}/h{cfg.hidden_size}"
+                  f"L{cfg.num_layers}b{slots}s{max_seq}")
+    return {
+        "value": round(requests_per_sec, 3),
+        "config": config_key,
+        "on_tpu": on_tpu,
+        "requests_per_sec": round(requests_per_sec, 3),
+        "latency_p50_ms": round(_percentile(lat, 50) * 1e3, 2),
+        "latency_p99_ms": round(_percentile(lat, 99) * 1e3, 2),
+        "ttft_p50_ms": round(_percentile(ttft, 50) * 1e3, 2),
+        "requests": len(done),
+        "tokens_generated": int(sum(len(r.tokens) for r in done.values())),
+        "decode_tokens_per_sec": round(engine_tps, 1),
+        "decode_tokens_per_sec_concat": round(concat_tps, 1),
+        "decode_speedup": round(speedup, 2) if speedup else None,
+        "decode_compiles": compiles,
+        "time_to_first_token_cold": round(ttft_cold, 3),
+    }
+
+
+def main():
+    if os.environ.get("BENCH_ONE"):
+        print(json.dumps(_measure()))
+        return
+
+    from __graft_entry__ import _probe_default_backend
+
+    budget = float(os.environ.get("BENCH_BUDGET_SERVE", 420))
+    verdict = _probe_default_backend(timeout=75.0)
+    extras = None
+    error = None
+    fallback = None
+    if verdict is None:
+        try:  # no subprocess machinery: measure in-process (CPU sandboxes)
+            extras = _measure()
+        except Exception as exc:
+            error = f"{type(exc).__name__}: {exc}"
+    else:
+        import subprocess
+
+        def _child(force_cpu):
+            env = dict(os.environ, BENCH_ONE="serve")
+            if force_cpu:
+                env["BENCH_FORCE_CPU"] = "1"
+                env["JAX_PLATFORMS"] = "cpu"
+            r = subprocess.run([sys.executable, os.path.abspath(__file__)], env=env,
+                               capture_output=True, text=True, timeout=budget)
+            line = [l for l in r.stdout.splitlines() if l.startswith("{")][-1]
+            return json.loads(line)
+
+        if verdict is True:
+            try:
+                extras = _child(force_cpu=False)
+            except Exception:
+                fallback = "serve_bench_failed"
+        else:
+            fallback = "tpu_unreachable"
+        if extras is None:
+            try:  # graceful CPU fallback: still a real serving signal
+                extras = _child(force_cpu=True)
+            except Exception as exc:
+                error = fallback or f"{type(exc).__name__}"
+
+    if extras is None:
+        print(json.dumps({"metric": "gpt_serving_throughput", "value": None,
+                          "unit": "requests/sec", "vs_baseline": None,
+                          "requests_per_sec": None, "latency_p50_ms": None,
+                          "latency_p99_ms": None, "error": error or "bench_error"}))
+        return
+
+    base_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "bench_serve_baseline.json")
+    vs = 1.0
+    if os.path.exists(base_path):
+        try:
+            prior = json.load(open(base_path))
+            if prior.get("config") == extras.get("config") and prior.get("value"):
+                vs = extras["value"] / prior["value"]
+        except Exception:
+            pass
+    else:
+        try:
+            json.dump({"metric": "gpt_serving_throughput", "value": extras["value"],
+                       "unit": "requests/sec", "config": extras.get("config")},
+                      open(base_path, "w"))
+        except OSError:
+            pass
+
+    out = {"metric": "gpt_serving_throughput", "value": extras["value"],
+           "unit": "requests/sec", "vs_baseline": round(vs, 4)}
+    out.update({k: v for k, v in extras.items() if k not in ("value",)})
+    if fallback:
+        out["fallback"] = fallback
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except Exception as exc:  # any unplanned failure still emits one line
+        print(json.dumps({"metric": "gpt_serving_throughput", "value": None,
+                          "unit": "requests/sec", "vs_baseline": None,
+                          "error": f"{type(exc).__name__}: {exc}"}))
+    sys.exit(0)

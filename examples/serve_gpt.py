@@ -1,0 +1,76 @@
+"""GPT serving end to end: export → Predictor → generate → continuous
+batching.
+
+1. export a decoder artifact (StableHLO: prefill + KV-cache token loop) and
+   serve it through paddle.inference.create_predictor;
+2. serve the LIVE model through the static-KV-cache DecodeEngine — exactly
+   two compiled programs (bucketed prefill + decode step, donated cache
+   buffers) for the whole request stream;
+3. run a continuous-batching burst: requests with mixed prompt lengths
+   admitted into free batch slots mid-flight, with request-level telemetry.
+
+Run:  python examples/serve_gpt.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+if not any(d.platform in ("tpu", "axon") for d in jax.devices()):
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import profiler
+from paddle_tpu.inference import Config, ContinuousBatchingScheduler, DecodeEngine, create_predictor
+from paddle_tpu.models.gpt import GPTConfig, GPTForPretraining
+
+OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_out")
+
+
+def main():
+    paddle.seed(0)
+    cfg = GPTConfig.tiny()
+    model = GPTForPretraining(cfg)
+    model.eval()
+    rng = np.random.default_rng(0)
+
+    # 1) export the whole decode loop as a deployable StableHLO artifact
+    prefix = os.path.join(OUT, "gpt_decoder")
+    model.export_decoder(prefix, prompt_len=8, max_new_tokens=8)
+    pred = create_predictor(Config(prefix))
+    ids = rng.integers(0, cfg.vocab_size, (2, 8)).astype("int32")
+    tokens = pred.generate(ids)
+    print(f"predictor[{pred.get_resolved_backend()}] served {tokens.shape[1] - 8} "
+          f"tokens/row from the exported artifact")
+
+    # 2) the serving engine: static KV cache, 2 compiled programs total
+    profiler.reset_counters("infer.")
+    engine = DecodeEngine(model, max_batch_slots=4, max_seq_len=64,
+                          prefill_buckets=(8, 16, 32))
+    out = engine.generate(ids, max_new_tokens=12)
+    c = profiler.counters("infer.")
+    print(f"engine decoded {out.shape[1] - ids.shape[1]} tokens/row with "
+          f"{int(c['infer.compiles'])} compiled programs "
+          f"(prefill + step), cache {engine.cache_bytes() // 1024} KiB")
+
+    # 3) continuous batching: admit-into-free-slots over mixed prompts
+    sched = ContinuousBatchingScheduler(engine)
+    for n in (5, 9, 3, 14, 7, 11):
+        sched.submit(rng.integers(0, cfg.vocab_size, (n,)).astype("int32"),
+                     max_new_tokens=6)
+    done = sched.run()
+    for rid in sorted(done):
+        r = done[rid]
+        print(f"  request {rid}: prompt {len(r.prompt):>2} tok (bucket {r.bucket:>2}) "
+              f"slot {r.slot} -> {len(r.tokens)} tokens in {r.total_seconds * 1e3:6.1f} ms "
+              f"(ttft {r.ttft_seconds * 1e3:5.1f} ms)")
+    lat = sorted(r.total_seconds for r in done.values())
+    print(f"served {len(done)} requests, p50 latency {lat[len(lat) // 2] * 1e3:.1f} ms")
+
+
+if __name__ == "__main__":
+    main()

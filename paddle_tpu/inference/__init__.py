@@ -1,28 +1,82 @@
-"""paddle_tpu.inference — deployment API.
+"""paddle_tpu.inference — the serving tier.
 
 Parity: ``paddle.inference`` (reference AnalysisPredictor
 paddle/fluid/inference/api/analysis_predictor.h:93, Config
 paddle_analysis_config.h, Tensor handles paddle_tensor.h). TPU-first design:
 the serialized model is a StableHLO artifact (jax.export) produced by
-``paddle.static.save_inference_model`` or ``paddle.jit.save``; "IR pass
-pipeline + TensorRT subgraphs" collapse into XLA compilation at load, so
-Config's optimization toggles are accepted no-ops.
+``paddle.static.save_inference_model`` or ``paddle.jit.save`` (the pickled
+``.pdiparams`` metadata / ``.pdparams`` state dicts remain as the legacy
+non-executable format); "IR pass pipeline + TensorRT subgraphs" collapse
+into XLA compilation at load. The :class:`Predictor` compiles ahead of time
+through the observability AOT ``lower().compile()`` path, so the first
+``run()`` is a dispatch, not a trace, and ``explain()`` answers
+cost/memory questions per compiled specialization.
+
+On top of the artifact predictor sit the serving-engine pieces:
+
+- :class:`DecodeEngine` (``.engine``) — static-shape device-resident KV
+  cache decode: prefill + decode-step as exactly TWO compiled programs
+  with donated cache buffers;
+- :class:`ContinuousBatchingScheduler` (``.scheduler``) — in-flight
+  batching: requests admitted into free batch slots mid-stream, bucketed
+  prefill padding, request-level telemetry.
+
+Backend placement is honest: ``Config.enable_use_gpu`` records the REQUEST
+and the resolved backend is whatever the runtime actually has (TPU when
+present — the accelerator alias — else CPU); ``Config.summary()``,
+``Predictor.backend`` and :func:`get_version` report the resolution instead
+of silently aliasing.
 """
 from __future__ import annotations
 
 import pickle
 from pathlib import Path
-from typing import Dict, List, Optional
+from typing import Any, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["Config", "Predictor", "create_predictor", "PredictorTensor"]
+from .engine import DecodeEngine, default_buckets
+from .scheduler import ContinuousBatchingScheduler, Request
+
+__all__ = [
+    "Config", "Predictor", "create_predictor", "PredictorTensor",
+    "DecodeEngine", "ContinuousBatchingScheduler", "Request",
+    "default_buckets", "get_version",
+]
+
+
+def get_version() -> str:
+    """Version/introspection string (reference ``paddle.inference``'s
+    get_version/get_trt_compile_version): runtime versions plus the
+    backends actually present — what placement decisions resolve against."""
+    import paddle_tpu
+
+    try:
+        platforms = sorted({d.platform for d in jax.devices()})
+    except RuntimeError:
+        platforms = []
+    return (f"paddle_tpu {getattr(paddle_tpu, '__version__', '0.0.0')}; "
+            f"jax {jax.__version__}; default_backend={_default_backend()}; "
+            f"platforms={','.join(platforms) or 'none'}")
+
+
+def _default_backend() -> str:
+    try:
+        return jax.default_backend()
+    except RuntimeError:
+        return "unknown"
 
 
 class Config:
-    """reference AnalysisConfig: model paths + backend knobs."""
+    """reference AnalysisConfig: model paths + backend knobs.
+
+    Device knobs record the *request*; :meth:`resolved_backend` reports what
+    the runtime will actually use. ``enable_use_gpu`` on a TPU system
+    resolves to the TPU (the accelerator alias, now recorded instead of
+    silent); on a CPU-only system it resolves to CPU.
+    """
 
     def __init__(self, prog_file: Optional[str] = None, params_file: Optional[str] = None):
         # accept either a prefix ("model") or explicit "model.pdmodel"
@@ -30,18 +84,55 @@ class Config:
             prog_file = prog_file[: -len(".pdmodel")]
         self.prefix = prog_file
         self.params_file = params_file
-        self._device = "tpu"
+        self._requested_device: Optional[str] = None  # None = runtime default
         self._memory_optim = True
 
     def set_model(self, prog_file: str, params_file: Optional[str] = None):
+        requested, memopt = self._requested_device, self._memory_optim
         self.__init__(prog_file, params_file)
+        self._requested_device, self._memory_optim = requested, memopt
 
+    # ------------------------------------------------------------- devices
     def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
-        self._device = "tpu"  # accelerator alias
+        self._requested_device = "gpu"
 
     def disable_gpu(self):
-        self._device = "cpu"
+        self._requested_device = "cpu"
 
+    def use_gpu(self) -> bool:
+        """Whether an accelerator was requested (reference API shape)."""
+        return self._requested_device == "gpu"
+
+    def requested_device(self) -> Optional[str]:
+        return self._requested_device
+
+    def resolved_backend(self) -> str:
+        """The backend runs will actually execute on. ``cpu`` when CPU was
+        requested; otherwise the runtime's default backend (TPU when
+        present). A ``gpu`` request on a non-GPU runtime resolves to that
+        default — recorded here, surfaced by summary()/Predictor."""
+        if self._requested_device == "cpu":
+            return "cpu"
+        return _default_backend()
+
+    def summary(self) -> str:
+        """Human-readable config table (reference Config.summary), including
+        the requested-vs-resolved placement so accepted aliases are visible."""
+        requested = self._requested_device or "default"
+        resolved = self.resolved_backend()
+        rows = [
+            ("model prefix", str(self.prefix)),
+            ("params file", str(self.params_file)),
+            ("requested device", requested),
+            ("resolved backend", resolved),
+            ("memory optim", str(self._memory_optim)),
+        ]
+        if self._requested_device == "gpu" and resolved != "gpu":
+            rows.append(("placement note", f"gpu requested; runtime has {resolved} (accelerator alias)"))
+        w = max(len(k) for k, _ in rows)
+        return "\n".join(f"{k:<{w}}  {v}" for k, v in rows)
+
+    # ---------------------------------------------------- accepted no-ops
     def enable_memory_optim(self, x=True):
         self._memory_optim = x
 
@@ -91,8 +182,16 @@ class PredictorTensor:
 
 
 class Predictor:
-    """Loads a .pdmodel StableHLO artifact and runs it on the default device
-    (TPU when present). First run() compiles; later runs hit the XLA cache."""
+    """Loads a .pdmodel StableHLO artifact and serves it AOT-compiled.
+
+    Each distinct input-shape signature is lowered and compiled ONCE through
+    ``jit(...).lower().compile()`` (the observability introspect path) —
+    the retained XLA Compiled handle backs :meth:`explain` and run() is a
+    pure dispatch afterwards. The resolved backend (see
+    :meth:`Config.resolved_backend`) is honored: inputs are placed on that
+    backend's device, and :attr:`backend` / :meth:`get_resolved_backend`
+    report the actual placement.
+    """
 
     def __init__(self, config: Config):
         if not config.prefix:
@@ -106,14 +205,33 @@ class Predictor:
         self._exported = jax.export.deserialize(model_path.read_bytes())
         meta_path = Path(str(config.prefix) + ".pdiparams")
         if meta_path.exists():
+            # legacy pickle metadata sidecar (feed/fetch names + shapes)
             self._meta = pickle.loads(meta_path.read_bytes())
         else:  # artifact without metadata: positional names
             self._meta = {
                 "feed_names": [f"input_{i}" for i in range(len(self._exported.in_avals))],
                 "fetch_names": [f"output_{i}" for i in range(len(self._exported.out_avals))],
             }
+        self.backend = config.resolved_backend()
+        try:
+            self._device = jax.devices(self.backend)[0]
+        except RuntimeError:
+            self._device = None  # backend absent: let jax place on default
+        self._jit = jax.jit(self._exported.call)
+        self._compiled: Dict[tuple, Any] = {}
+        self._specializations: List[dict] = []
         self._inputs: Dict[str, jax.Array] = {}
         self._outputs: Dict[str, jax.Array] = {}
+        from ..observability import runlog as _runlog
+
+        _runlog.emit("predictor_load", component="infer", prefix=str(config.prefix),
+                     backend=self.backend,
+                     requested=config.requested_device() or "default")
+
+    def get_resolved_backend(self) -> str:
+        """The backend run() actually executes on (honest placement — an
+        accepted ``enable_use_gpu`` on TPU reports 'tpu', not 'gpu')."""
+        return self.backend
 
     # ------------------------------------------------------------- handles
     def get_input_names(self) -> List[str]:
@@ -137,9 +255,40 @@ class Predictor:
     get_output_tensor = get_output_handle
 
     # ----------------------------------------------------------------- run
+    def _compiled_for(self, vals):
+        """The AOT-compiled executable for this input-shape signature,
+        compiling (and recording cost/compile telemetry) on first sight.
+        Falls back to the plain jitted call when AOT is unavailable."""
+        sig = tuple((tuple(v.shape), str(v.dtype)) for v in vals)
+        entry = self._compiled.get(sig)
+        if entry is None:
+            from ..observability import introspect as _introspect
+            from ..observability import runlog as _runlog
+            from ..observability import span as _span
+            from ..profiler import counter_inc
+
+            label = "predictor/" + ",".join(f"{d}{list(s)}" for s, d in sig[:4])
+            with _span("infer.compile"):
+                compiled, info = _introspect.aot_compile(self._jit, tuple(vals))
+            entry = compiled if compiled is not None else self._jit
+            self._compiled[sig] = entry
+            counter_inc("infer.compiles")
+            info["label"] = label
+            info["kind"] = "predictor"
+            self._specializations.append(info)
+            _runlog.emit("compile", component="infer", label=label,
+                         seconds=info.get("compile_seconds"),
+                         flops=info.get("flops"),
+                         bytes_accessed=info.get("bytes_accessed"),
+                         peak_bytes=info.get("peak_bytes"))
+        return entry, sig
+
     def run(self, inputs: Optional[List[np.ndarray]] = None):
         """Execute. Either positional ``inputs`` or previously staged
         copy_from_cpu handles."""
+        from ..observability import span as _span
+        from ..profiler import counter_inc
+
         feed_names = self._meta["feed_names"]
         if inputs is not None:
             vals = [jnp.asarray(x) for x in inputs]
@@ -148,12 +297,50 @@ class Predictor:
             if missing:
                 raise RuntimeError(f"inputs not staged: {missing}")
             vals = [self._inputs[n] for n in feed_names]
-        outs = self._exported.call(*vals)
+        if self._device is not None:
+            vals = [jax.device_put(v, self._device) for v in vals]
+        entry, sig = self._compiled_for(vals)
+        with _span("infer.run"):
+            try:
+                outs = entry(*vals)
+            except (TypeError, ValueError):
+                if entry is self._jit:
+                    raise
+                # AOT executables validate avals strictly; on drift fall
+                # back to the jitted path permanently for this signature
+                self._compiled[sig] = self._jit
+                outs = self._jit(*vals)
+        counter_inc("infer.runs")
         outs = list(outs) if isinstance(outs, (tuple, list)) else [outs]
         self._outputs = dict(zip(self._meta["fetch_names"], outs))
         if inputs is not None:
             return [np.asarray(o) for o in outs]
         return True
+
+    def generate(self, ids, seed: int = 0) -> np.ndarray:
+        """Serve a decoder artifact (``GPTForPretraining.export_decoder``):
+        runs the exported prefill + KV-cache token loop. ``ids`` must match
+        the artifact's fixed ``prompt_len``; returns
+        ``[b, prompt_len + max_new_tokens]`` int32 tokens."""
+        dec = self._meta.get("decoder")
+        if not dec:
+            raise RuntimeError(
+                "this artifact has no decoder metadata; export it with "
+                "GPTForPretraining.export_decoder (or serve a live model "
+                "through paddle_tpu.inference.DecodeEngine)")
+        ids = np.asarray(ids, np.int32)
+        if ids.ndim == 1:
+            ids = ids[None]
+        if ids.shape[1] != dec["prompt_len"]:
+            raise ValueError(f"prompt length {ids.shape[1]} != artifact prompt_len "
+                             f"{dec['prompt_len']} (pad/bucket on the client side)")
+        (tokens,) = self.run([ids, np.int32(seed)])
+        return np.asarray(tokens)
+
+    def explain(self) -> List[dict]:
+        """Per-specialization XLA cost rows captured at AOT compile; render
+        with ``observability.format_cost_table``."""
+        return list(self._specializations)
 
     def clear_intermediate_tensor(self):
         self._inputs.clear()

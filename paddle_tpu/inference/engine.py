@@ -1,0 +1,372 @@
+"""Static KV-cache decode engine: the serving hot path.
+
+Replaces the growing-concat ``MultiHeadAttention.Cache`` decode (a new
+shape — and under jit a new compiled program — every token) with a
+preallocated device-resident cache updated in place at traced position
+indices. Exactly TWO compiled programs serve an entire request stream:
+
+- **prefill** — one compile per prompt-length bucket: runs the prompt
+  through the trunk on a fresh ``[L, 1, H, P, dh]`` cache segment, inserts
+  it into the engine's big ``[L, B, H, S, dh]`` cache at a batch *slot*
+  index, and samples the first token;
+- **decode step** — ONE compile total: advances every occupied slot one
+  token with per-slot position indices (slots at different depths share the
+  program), slot-masked sampling, and in-place K/V writes.
+
+Both programs donate the cache (and slot-state) buffers — the XLA executable
+updates them in place, so cache memory stays flat for the life of the engine
+(the PR-3 donation idiom from ``jit.TrainStep``/the static Executor, applied
+to serving). Compiles run through the observability AOT ``lower().compile()``
+path, so ``explain()`` answers cost/memory questions and the
+``infer.compiles`` counter lets tests pin "decode of N tokens compiles
+exactly 2 programs".
+
+Parity: the reference serves GPT decode through
+``fused_multi_transformer_op.cu`` driven by AnalysisPredictor; here the
+fused decoder is the compiled step program and the "predictor" is the
+:class:`~paddle_tpu.inference.scheduler.ContinuousBatchingScheduler` on top.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["DecodeEngine", "default_buckets"]
+
+
+def default_buckets(max_seq: int, start: int = 16) -> Tuple[int, ...]:
+    """Power-of-two prompt-padding buckets up to ``max_seq``: prompts pad to
+    the smallest bucket that fits, so prefill compiles once per bucket
+    instead of once per prompt length."""
+    out: List[int] = []
+    b = start
+    while b < max_seq:
+        out.append(b)
+        b *= 2
+    out.append(max_seq)
+    return tuple(sorted(set(out)))
+
+
+def _dequant(entry, dt):
+    """A params-pack entry is either a plain array or an int8 payload
+    ``{"q", "s"}``; dequantize the latter to ``dt`` (XLA folds the multiply
+    into the consuming matmul — the QuantizedLinear idiom on raw stacked
+    weights)."""
+    if isinstance(entry, dict):
+        return (entry["q"].astype(jnp.float32) * entry["s"]).astype(dt)
+    return entry
+
+
+class DecodeEngine:
+    """Slot-based autoregressive decode over a static KV cache.
+
+    ``model`` is a :class:`~paddle_tpu.models.gpt.GPTForPretraining` with the
+    stacked trunk. ``max_batch_slots`` fixes the decode batch width B: each
+    slot holds one in-flight request, and requests are admitted into free
+    slots mid-stream (continuous batching) — admission never recompiles.
+
+    ``int8=True`` quantizes the trunk matmul weights (qkv/out/ffn1/ffn2)
+    to int8 with per-layer × per-output-channel abs_max scales through
+    :mod:`paddle_tpu.quantization`; the compiled programs carry int8
+    constants and dequantize into the matmuls.
+
+    Sampling config (``do_sample``/``temperature``/``top_k``/``top_p``) is
+    compiled into the programs; per-request randomness comes from each
+    request's own ``seed`` folded with its absolute position, so a request's
+    tokens never depend on which slot it runs in or on its batch neighbours.
+    """
+
+    def __init__(self, model, max_batch_slots: int = 4, max_seq_len: Optional[int] = None,
+                 prefill_buckets: Optional[Sequence[int]] = None, do_sample: bool = False,
+                 temperature: float = 1.0, top_k: int = 0, top_p: float = 1.0,
+                 int8: bool = False, donate: bool = True):
+        from ..models.gpt import GPTBlockStack
+
+        if not isinstance(model.gpt.layers, GPTBlockStack):
+            raise NotImplementedError("DecodeEngine requires the stacked trunk (GPTConfig(stacked=True))")
+        cfg = model.gpt.cfg
+        S = int(max_seq_len) if max_seq_len is not None else int(cfg.max_seq_len)
+        if S > cfg.max_seq_len:
+            raise ValueError(f"max_seq_len {S} exceeds the model's positional table {cfg.max_seq_len}")
+        self.cfg = cfg
+        self.max_seq_len = S
+        self.max_batch_slots = B = int(max_batch_slots)
+        self.buckets = tuple(sorted(int(b) for b in prefill_buckets)) if prefill_buckets else default_buckets(S)
+        if any(b > S for b in self.buckets):
+            raise ValueError(f"prefill bucket larger than max_seq_len {S}: {self.buckets}")
+        self._sample = (bool(do_sample), float(temperature), int(top_k), float(top_p))
+        self.int8 = bool(int8)
+        self._donate = bool(donate)
+
+        stacked, wte, wpe, fnw, fnb = model._decode_params()
+        params, self._idx = stacked
+        self._stack_dts = tuple(w.dtype for w in params)  # dequant targets
+        if int8:
+            from .. import quantization as Q
+
+            order = model.gpt.layers._order
+            quant = {"qkv_w", "out_w", "ffn1_w", "ffn2_w"}
+            packed = []
+            for name, w in zip(order, params):
+                if name in quant:
+                    # per-layer × per-output-channel abs_max scales on the
+                    # [L, in, out]-stacked trunk weight (channel_wise_abs_max
+                    # over the stack) — int8 constants land in the compiled
+                    # programs, dequant folds into the matmul
+                    q, s = Q.quant_abs_max(np.asarray(w), channel_axis=(0, 2))
+                    packed.append({"q": jnp.asarray(q), "s": jnp.asarray(s)})
+                else:
+                    packed.append(w)
+            params = tuple(packed)
+        self._params = {"stack": params, "wte": wte, "wpe": wpe, "fnw": fnw, "fnb": fnb}
+
+        L = cfg.num_layers
+        H = cfg.num_heads
+        dh = cfg.hidden_size // cfg.num_heads
+        dt = wte.dtype
+        self._shape = (L, B, H, S, dh)
+        self._ck = jnp.zeros((L, B, H, S, dh), dt)
+        self._cv = jnp.zeros((L, B, H, S, dh), dt)
+        self._pos = jnp.zeros((B,), jnp.int32)
+        self._tok = jnp.zeros((B,), jnp.int32)
+        self._active = jnp.zeros((B,), bool)
+        # host mirrors / per-slot request metadata (tiny, resent per dispatch)
+        self._active_np = np.zeros((B,), bool)
+        self._occupied = np.zeros((B,), bool)
+        self._eos = np.full((B,), -1, np.int32)
+        self._limit = np.zeros((B,), np.int32)
+        self._seed = np.zeros((B,), np.int32)
+
+        self._build()
+        self._compiled: Dict[tuple, Any] = {}
+        self._specializations: List[dict] = []
+
+    # ------------------------------------------------------------ programs
+    def _build(self):
+        from ..models.gpt import _cache_forward, _select_token, _select_token_rows, _slot_decode_forward
+
+        cfg = self.cfg
+        num_heads = cfg.num_heads
+        L = cfg.num_layers
+        H = num_heads
+        dh = cfg.hidden_size // num_heads
+        do_sample, temperature, top_k, top_p = self._sample
+        idx = self._idx
+
+        dts = self._stack_dts
+
+        def unpack(p):
+            return ((tuple(_dequant(e, dt) for e, dt in zip(p["stack"], dts)), idx),
+                    p["wte"], p["wpe"], p["fnw"], p["fnb"])
+
+        def prefill_fn(p, ck, cv, pos, tok, active, ids, length, slot, eos, limit, seed):
+            stacked, wte, wpe, fnw, fnb = unpack(p)
+            P = ids.shape[1]
+            sk = jnp.zeros((L, 1, H, P, dh), wte.dtype)
+            sv = jnp.zeros((L, 1, H, P, dh), wte.dtype)
+            logits, sk, sv = _cache_forward(stacked, wte, wpe, fnw, fnb, ids, sk, sv,
+                                            jnp.int32(0), num_heads=num_heads)
+            ck = jax.lax.dynamic_update_slice(ck, sk, (0, slot, 0, 0, 0))
+            cv = jax.lax.dynamic_update_slice(cv, sv, (0, slot, 0, 0, 0))
+            last = jax.lax.dynamic_slice(logits, (0, length - 1, 0), (1, 1, logits.shape[2]))[:, 0]
+            key = jax.random.fold_in(jax.random.key(seed), length - 1)
+            first = _select_token(last.astype(jnp.float32), key, do_sample, temperature, top_k, top_p)[0]
+            done = (eos >= 0) & (first == eos)
+            more = (~done) & (length + 1 < limit)
+            dus = jax.lax.dynamic_update_slice
+            pos = dus(pos, length[None], (slot,))
+            tok = dus(tok, first[None], (slot,))
+            active = dus(active, more[None], (slot,))
+            return ck, cv, pos, tok, active, first, more
+
+        def decode_fn(p, ck, cv, pos, tok, active, eos_v, limit_v, seed_v):
+            stacked, wte, wpe, fnw, fnb = unpack(p)
+            logits, ck, cv = _slot_decode_forward(stacked, wte, wpe, fnw, fnb, tok, ck, cv,
+                                                  pos, num_heads=num_heads)
+            keys = jax.vmap(lambda s, q: jax.random.fold_in(jax.random.key(s), q))(seed_v, pos)
+            nxt = _select_token_rows(logits.astype(jnp.float32), keys, do_sample,
+                                     temperature, top_k, top_p)
+            nxt = jnp.where(active, nxt, tok)  # slot-masked: free slots hold
+            hit_eos = (eos_v >= 0) & (nxt == eos_v)
+            new_pos = pos + active.astype(jnp.int32)
+            new_active = active & ~hit_eos & (new_pos + 1 < limit_v)
+            return ck, cv, new_pos, nxt, new_active
+
+        donate = (1, 2, 3, 4, 5) if self._donate else ()
+        self._prefill_jit = jax.jit(prefill_fn, donate_argnums=donate)
+        self._decode_jit = jax.jit(decode_fn, donate_argnums=donate)
+
+    def _dispatch(self, which: str, jitfn, args):
+        """Run one dispatch, AOT-compiling on a new (kind, shape) signature
+        so the XLA Compiled handle is retained for ``explain()`` and the
+        compile is counted/logged — the TrainStep._dispatch idiom."""
+        sig = (which,) + tuple(
+            (tuple(l.shape), str(l.dtype)) for l in jax.tree_util.tree_leaves(args))
+        entry = self._compiled.get(sig)
+        if entry is None:
+            from ..observability import introspect as _introspect
+            from ..observability import runlog as _runlog
+            from ..observability import span as _span
+            from ..profiler import counter_inc
+
+            with _span("infer.compile"):
+                compiled, info = _introspect.aot_compile(jitfn, args)
+            entry = compiled if compiled is not None else jitfn
+            self._compiled[sig] = entry
+            counter_inc("infer.compiles")
+            info["label"] = which if which == "decode" else f"{which}/P{args[6].shape[1]}"
+            info["kind"] = which
+            self._specializations.append(info)
+            _runlog.emit("compile", component="infer", label=info["label"],
+                         seconds=info.get("compile_seconds"),
+                         flops=info.get("flops"),
+                         bytes_accessed=info.get("bytes_accessed"),
+                         peak_bytes=info.get("peak_bytes"))
+        try:
+            return entry(*args)
+        except (TypeError, ValueError):
+            if entry is jitfn:
+                raise
+            self._compiled[sig] = jitfn  # AOT aval drift: jit path forever
+            return jitfn(*args)
+
+    # ------------------------------------------------------------ slot API
+    def bucket_for(self, prompt_len: int) -> int:
+        for b in self.buckets:
+            if b >= prompt_len:
+                return b
+        raise ValueError(f"prompt of {prompt_len} tokens exceeds the largest "
+                         f"prefill bucket {self.buckets[-1]}")
+
+    def free_slots(self) -> List[int]:
+        return [i for i in range(self.max_batch_slots) if not self._occupied[i]]
+
+    def prefill(self, prompt, slot: int, max_new_tokens: int, eos_token_id: Optional[int] = None,
+                seed: int = 0) -> Tuple[int, bool]:
+        """Admit one prompt into ``slot``: run the bucketed prefill program,
+        write its KV into the slot's cache lanes, sample the first token.
+        Returns ``(first_token, more)`` — ``more`` False means the request
+        finished at its first token (eos or max_new_tokens == 1)."""
+        from ..observability import span as _span
+        from ..profiler import counter_inc
+
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        n = int(prompt.shape[0])
+        if n < 1:
+            raise ValueError("empty prompt")
+        if self._occupied[slot]:
+            raise ValueError(f"slot {slot} is occupied; free it first")
+        if n + int(max_new_tokens) > self.max_seq_len:
+            raise ValueError(f"prompt {n} + max_new_tokens {max_new_tokens} "
+                             f"exceeds max_seq_len {self.max_seq_len}")
+        P = self.bucket_for(n)
+        ids = np.zeros((1, P), np.int32)
+        ids[0, :n] = prompt
+        eos = -1 if eos_token_id is None else int(eos_token_id)
+        limit = n + int(max_new_tokens)
+        with _span("infer.prefill"):
+            out = self._dispatch(
+                "prefill", self._prefill_jit,
+                (self._params, self._ck, self._cv, self._pos, self._tok, self._active,
+                 jnp.asarray(ids), jnp.int32(n), jnp.int32(slot), jnp.int32(eos),
+                 jnp.int32(limit), jnp.int32(seed)))
+        self._ck, self._cv, self._pos, self._tok, self._active, first, more = out
+        more = bool(more)
+        self._occupied[slot] = True
+        self._active_np[slot] = more
+        self._eos[slot] = eos
+        self._limit[slot] = limit
+        self._seed[slot] = int(seed)
+        counter_inc("infer.prefill_dispatches")
+        counter_inc("infer.tokens")
+        return int(first), more
+
+    def decode_step(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """One token for every active slot in ONE dispatch. Returns
+        ``(tokens[B], emitted[B], active[B])`` where ``emitted`` marks slots
+        that produced a real token this step (their pre-step active mask)
+        and ``active`` is the post-step mask (False = request finished)."""
+        from ..observability import span as _span
+        from ..profiler import counter_inc
+
+        emitted = self._active_np.copy()
+        with _span("infer.decode_step"):
+            out = self._dispatch(
+                "decode", self._decode_jit,
+                (self._params, self._ck, self._cv, self._pos, self._tok, self._active,
+                 jnp.asarray(self._eos), jnp.asarray(self._limit), jnp.asarray(self._seed)))
+        self._ck, self._cv, self._pos, self._tok, self._active = out
+        toks = np.asarray(self._tok)
+        self._active_np = np.array(self._active)  # writable host mirror
+        counter_inc("infer.decode_dispatches")
+        counter_inc("infer.tokens", int(emitted.sum()))
+        return toks, emitted, self._active_np.copy()
+
+    def free_slot(self, slot: int) -> None:
+        """Release a slot for the next admission (cancels it if still live)."""
+        if self._active_np[slot]:
+            self._active = self._active.at[slot].set(False)
+            self._active_np[slot] = False
+        self._occupied[slot] = False
+
+    def reset(self) -> None:
+        """Drop every in-flight request and zero the slot state (the cache
+        keeps its buffers — stale K/V is always overwritten before it can be
+        attended)."""
+        B = self.max_batch_slots
+        self._pos = jnp.zeros((B,), jnp.int32)
+        self._tok = jnp.zeros((B,), jnp.int32)
+        self._active = jnp.zeros((B,), bool)
+        self._active_np[:] = False
+        self._occupied[:] = False
+        self._eos[:] = -1
+        self._limit[:] = 0
+        self._seed[:] = 0
+
+    # ------------------------------------------------------------- helpers
+    def generate(self, ids, max_new_tokens: int = 32, eos_token_id: Optional[int] = None,
+                 seed: int = 0) -> np.ndarray:
+        """Batch generate through the slot machinery (parity helper + the
+        bench decode path): each row takes one slot, prefill once per row,
+        then decode steps until every row finishes. Returns
+        ``[b, s0 + max_new_tokens]`` int32 (rows that hit eos pad with it) —
+        same contract as ``GPTForPretraining.generate``."""
+        ids = np.asarray(ids, np.int32)
+        if ids.ndim == 1:
+            ids = ids[None]
+        b, s0 = ids.shape
+        if b > self.max_batch_slots:
+            raise ValueError(f"batch {b} exceeds max_batch_slots {self.max_batch_slots}")
+        self.reset()
+        rows = [[] for _ in range(b)]
+        for i in range(b):
+            tok, _more = self.prefill(ids[i], slot=i, max_new_tokens=max_new_tokens,
+                                      eos_token_id=eos_token_id, seed=seed)
+            rows[i].append(tok)
+        while self._active_np.any():
+            toks, emitted, _ = self.decode_step()
+            for i in range(b):
+                if emitted[i]:
+                    rows[i].append(int(toks[i]))
+        for i in range(b):
+            self.free_slot(i)
+        out = np.zeros((b, s0 + int(max_new_tokens)), np.int32)
+        out[:, :s0] = ids
+        for i, r in enumerate(rows):
+            pad = r[-1] if eos_token_id is None else int(eos_token_id)
+            r = r + [pad] * (int(max_new_tokens) - len(r))
+            out[i, s0:] = r[:int(max_new_tokens)]
+        return out
+
+    def explain(self) -> List[dict]:
+        """Per-specialization cost rows (prefill buckets + the decode step)
+        captured at AOT compile — render with
+        ``observability.format_cost_table``."""
+        return list(self._specializations)
+
+    def cache_bytes(self) -> int:
+        """Device bytes held by the preallocated K/V cache."""
+        return 2 * int(np.prod(self._shape)) * self._ck.dtype.itemsize

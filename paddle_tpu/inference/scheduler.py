@@ -1,0 +1,184 @@
+"""Continuous (in-flight) batching scheduler over a :class:`DecodeEngine`.
+
+Requests arrive at any time and are admitted into free batch slots
+mid-stream: a new request's bucketed prefill runs while other slots keep
+decoding, and every decode dispatch advances ALL occupied slots one token
+(per-slot position indices, slot-masked sampling). No request waits for a
+batch to drain — the vLLM/Orca serving discipline on top of the two
+compiled programs.
+
+Telemetry rides the PR-4 spine: every request emits ``request`` run-log
+events (``submitted`` → ``admitted`` → ``finished``) with queue/prefill/
+decode timings, the ``serving.*`` counters/gauges/histograms feed the
+metrics registry, and ``python -m paddle_tpu.observability report`` renders
+a serving section (request rate, queue depth, prefill/decode split,
+p50/p99 latency) from the event stream.
+"""
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["Request", "ContinuousBatchingScheduler"]
+
+
+class Request:
+    """One in-flight generation request and its lifecycle timestamps."""
+
+    def __init__(self, rid: int, prompt: np.ndarray, max_new_tokens: int,
+                 eos_token_id: Optional[int], seed: int):
+        self.rid = rid
+        self.prompt = np.asarray(prompt, np.int32).reshape(-1)
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_token_id = eos_token_id
+        self.seed = int(seed)
+        self.tokens: List[int] = []
+        self.slot: Optional[int] = None
+        self.bucket: Optional[int] = None
+        self.submitted_ts = time.perf_counter()
+        self.admitted_ts: Optional[float] = None
+        self.first_token_ts: Optional[float] = None
+        self.finished_ts: Optional[float] = None
+
+    # -- derived timings (None until the request reaches that phase) -------
+    @property
+    def queue_seconds(self):
+        return None if self.admitted_ts is None else self.admitted_ts - self.submitted_ts
+
+    @property
+    def ttft_seconds(self):
+        return None if self.first_token_ts is None else self.first_token_ts - self.submitted_ts
+
+    @property
+    def prefill_seconds(self):
+        if self.admitted_ts is None or self.first_token_ts is None:
+            return None
+        return self.first_token_ts - self.admitted_ts
+
+    @property
+    def decode_seconds(self):
+        if self.finished_ts is None or self.first_token_ts is None:
+            return None
+        return self.finished_ts - self.first_token_ts
+
+    @property
+    def total_seconds(self):
+        return None if self.finished_ts is None else self.finished_ts - self.submitted_ts
+
+    def output_ids(self) -> np.ndarray:
+        """prompt + generated tokens, the served completion."""
+        return np.concatenate([self.prompt, np.asarray(self.tokens, np.int32)])
+
+
+class ContinuousBatchingScheduler:
+    """Admit-into-free-slots scheduler: FIFO queue in front of the engine's
+    batch slots. Drive it with :meth:`step` (one admission sweep + one
+    decode dispatch) or :meth:`run` (until drained)."""
+
+    def __init__(self, engine):
+        self.engine = engine
+        self.queue: deque = deque()
+        self.running: Dict[int, Request] = {}  # slot -> request
+        self.finished: Dict[int, Request] = {}  # rid -> request
+        self._next_rid = 0
+
+    # ----------------------------------------------------------- lifecycle
+    def submit(self, prompt, max_new_tokens: int = 16, eos_token_id: Optional[int] = None,
+               seed: int = 0) -> int:
+        """Enqueue one prompt; returns the request id. Validation happens
+        here (not at admission) so a bad request fails its caller, not the
+        serving loop."""
+        from ..observability import runlog as _runlog
+        from ..observability.metrics import counter_inc, gauge_set
+
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        n = int(prompt.shape[0])
+        if n + int(max_new_tokens) > self.engine.max_seq_len:
+            raise ValueError(f"prompt {n} + max_new_tokens {max_new_tokens} exceeds "
+                             f"engine max_seq_len {self.engine.max_seq_len}")
+        self.engine.bucket_for(n)  # raises if no bucket fits
+        r = Request(self._next_rid, prompt, max_new_tokens, eos_token_id, seed)
+        self._next_rid += 1
+        self.queue.append(r)
+        counter_inc("serving.requests_submitted")
+        gauge_set("serving.queue_depth", len(self.queue))
+        _runlog.emit("request", id=r.rid, status="submitted", component="serving",
+                     prompt_tokens=n, max_new_tokens=int(max_new_tokens),
+                     queue_depth=len(self.queue))
+        return r.rid
+
+    def _admit(self) -> None:
+        from ..observability import runlog as _runlog
+        from ..observability.metrics import counter_inc, gauge_set, observe
+
+        free = self.engine.free_slots()
+        while self.queue and free:
+            r = self.queue.popleft()
+            slot = free.pop(0)
+            r.slot = slot
+            r.bucket = self.engine.bucket_for(len(r.prompt))
+            r.admitted_ts = time.perf_counter()
+            tok, more = self.engine.prefill(
+                r.prompt, slot, max_new_tokens=r.max_new_tokens,
+                eos_token_id=r.eos_token_id, seed=r.seed)
+            r.first_token_ts = time.perf_counter()
+            r.tokens.append(tok)
+            counter_inc("serving.requests_admitted")
+            observe("serving.ttft_seconds", r.ttft_seconds)
+            observe("serving.queue_seconds", r.queue_seconds)
+            gauge_set("serving.queue_depth", len(self.queue))
+            gauge_set("serving.active_slots", len(self.running) + 1)
+            _runlog.emit("request", id=r.rid, status="admitted", component="serving",
+                         slot=slot, bucket=r.bucket, queue_depth=len(self.queue),
+                         queue_seconds=r.queue_seconds, seconds=r.prefill_seconds)
+            if more:
+                self.running[slot] = r
+            else:
+                self._finish(r)
+
+    def _finish(self, r: Request) -> None:
+        from ..observability import runlog as _runlog
+        from ..observability.metrics import counter_inc, gauge_set, observe
+
+        r.finished_ts = time.perf_counter()
+        self.engine.free_slot(r.slot)
+        self.running.pop(r.slot, None)
+        self.finished[r.rid] = r
+        counter_inc("serving.requests_completed")
+        counter_inc("serving.tokens_generated", len(r.tokens))
+        observe("serving.latency_seconds", r.total_seconds)
+        gauge_set("serving.active_slots", len(self.running))
+        _runlog.emit("request", id=r.rid, status="finished", component="serving",
+                     prompt_tokens=len(r.prompt), new_tokens=len(r.tokens),
+                     queue_seconds=r.queue_seconds, prefill_seconds=r.prefill_seconds,
+                     decode_seconds=r.decode_seconds, total_seconds=r.total_seconds,
+                     ttft_seconds=r.ttft_seconds)
+
+    def step(self) -> List[Request]:
+        """One scheduler tick: admit queued requests into free slots
+        (bucketed prefill each), then advance every occupied slot one token
+        in a single decode dispatch. Returns requests finished this tick."""
+        before = set(self.finished)
+        self._admit()
+        if self.running:
+            toks, emitted, active = self.engine.decode_step()
+            for slot, r in list(self.running.items()):
+                if emitted[slot]:
+                    r.tokens.append(int(toks[slot]))
+                if not active[slot]:
+                    self._finish(r)
+        return [self.finished[rid] for rid in self.finished if rid not in before]
+
+    def run(self, max_steps: Optional[int] = None) -> Dict[int, Request]:
+        """Drive :meth:`step` until queue and slots drain (or ``max_steps``
+        ticks); returns ``{rid: Request}`` for everything finished."""
+        steps = 0
+        while self.queue or self.running:
+            self.step()
+            steps += 1
+            if max_steps is not None and steps >= max_steps:
+                break
+        return dict(self.finished)

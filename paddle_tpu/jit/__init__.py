@@ -705,6 +705,10 @@ def save(layer, path, input_spec=None, **configs):
             "fetch_names": [f"output_{i}" for i in range(len(exported.out_avals))],
             "feed_shapes": meta_shapes,
             "feed_dtypes": [str(s.dtype) for s in specs],
+            # artifact provenance: .pdmodel is serialized StableHLO
+            # (jax.export); this pickle sidecar is the legacy metadata format
+            "format": "stablehlo",
+            "producer": f"paddle_tpu/jax {jax.__version__}",
         }
         Path(path + ".pdiparams").write_bytes(pickle.dumps(meta))
     return path
@@ -728,6 +732,15 @@ class TranslatedLayer:
         return wrapped[0] if len(wrapped) == 1 else tuple(wrapped)
 
     forward = __call__
+
+    def explain(self) -> list:
+        """Per-specialization XLA cost rows from the backing AOT Predictor."""
+        return self._predictor.explain()
+
+    @property
+    def backend(self) -> str:
+        """The resolved backend the artifact actually runs on."""
+        return self._predictor.get_resolved_backend()
 
     def eval(self):
         return self

@@ -122,10 +122,18 @@ class GPTAttention(nn.Layer):
         self.out_proj = RowParallelLinear(cfg.hidden_size, cfg.hidden_size, weight_attr=init, input_is_parallel=True)
         self.attn_dropout = cfg.attn_dropout
 
-    def gen_cache(self, x):
+    def gen_cache(self, x, static=False, max_seq=None):
         from ..nn.layer.transformer import MultiHeadAttention
         from ..tensor.creation import zeros
 
+        if static:
+            # fixed-shape serving cache: preallocated [b, max_seq, h, d],
+            # written in place at the carried position — decode keeps one
+            # set of shapes (and one compiled program) for the whole run
+            if max_seq is None:
+                raise ValueError("gen_cache(static=True) needs max_seq=")
+            empty = lambda: zeros([x.shape[0], int(max_seq), self.num_heads, self.head_dim], dtype=x.dtype)  # noqa: E731
+            return MultiHeadAttention.FixedCache(empty(), empty(), zeros([], dtype="int32"))
         empty = lambda: zeros([x.shape[0], 0, self.num_heads, self.head_dim], dtype=x.dtype)
         return MultiHeadAttention.Cache(empty(), empty())
 
@@ -136,6 +144,14 @@ class GPTAttention(nn.Layer):
         qkv = self.qkv_proj(x)
         qkv = M.reshape(qkv, [b, s, 3, self.num_heads, self.head_dim])
         q, k, v = (M.squeeze(t, 2) for t in M.split(qkv, 3, axis=2))
+        if isinstance(cache, MultiHeadAttention.FixedCache):
+            from ..nn.layer.transformer import _fixed_cache_mask, _fixed_cache_write
+
+            kf, vf = _fixed_cache_write(cache, k, v)
+            mask = _fixed_cache_mask(cache.pos, s, kf.shape[1])
+            out = F.scaled_dot_product_attention(q, kf, vf, attn_mask=mask, dropout_p=self.attn_dropout, training=self.training)
+            out = M.reshape(out, [b, s, self.num_heads * self.head_dim])
+            return self.out_proj(out), MultiHeadAttention.FixedCache(kf, vf, cache.pos + s)
         if cache is not None:
             if cache.k.shape[1] > 0:
                 k = M.concat([cache.k, k], axis=1)
@@ -179,8 +195,8 @@ class GPTBlock(nn.Layer):
             self.ffn2 = RowParallelLinear(cfg.ffn_hidden_size, cfg.hidden_size, weight_attr=init, input_is_parallel=True)
         self.dropout = nn.Dropout(cfg.dropout)
 
-    def gen_cache(self, x):
-        return self.attn.gen_cache(x)
+    def gen_cache(self, x, static=False, max_seq=None):
+        return self.attn.gen_cache(x, static=static, max_seq=max_seq)
 
     def forward(self, x, cache=None):
         if cache is not None:
@@ -490,6 +506,74 @@ def _cache_forward(stacked, wte, wpe, fnw, fnb, ids, cache_k, cache_v, start_pos
     return logits, jnp.stack(new_k), jnp.stack(new_v)
 
 
+def _slot_cache_block(lp, h, ck, cv, pos, *, num_heads, epsilon=1e-5):
+    """One decoder block over PER-SLOT cache positions (continuous-batching
+    decode). ``h`` [b, 1, d] holds one token per batch slot; ``ck``/``cv``
+    [b, H, S, dh]; ``pos`` [b] int32 is each slot's write index. K/V are
+    written at ``pos[b]`` via a vmapped ``dynamic_update_slice`` (write
+    BEFORE attend, so a stale cache entry is always overwritten before it
+    can become visible) and attention masks keys beyond each slot's own
+    position — slots at different sequence depths share one compiled
+    program. Same math as :func:`_cache_block` at s=1.
+    """
+    (n1w, n1b, qkvw, qkvb, ow, ob, n2w, n2b, f1w, f1b, f2w, f2b), _ = lp
+
+    def ln(v, w, bb):
+        mean = jnp.mean(v, axis=-1, keepdims=True)
+        var = jnp.var(v, axis=-1, keepdims=True)
+        return (v - mean) / jnp.sqrt(var + epsilon) * w + bb
+
+    b, s, d = h.shape
+    S = ck.shape[2]
+    hd = d // num_heads
+    x1 = ln(h, n1w, n1b)
+    qkv = (x1 @ qkvw + qkvb).reshape(b, s, 3, num_heads, hd)
+    q = jnp.swapaxes(qkv[:, :, 0], 1, 2)  # [b, H, 1, dh]
+    k = jnp.swapaxes(qkv[:, :, 1], 1, 2)
+    v = jnp.swapaxes(qkv[:, :, 2], 1, 2)
+    upd = jax.vmap(lambda c, u, p: jax.lax.dynamic_update_slice(c, u, (0, p, 0)))
+    ck = upd(ck, k, pos)
+    cv = upd(cv, v, pos)
+    scale = jnp.asarray(1.0 / (hd ** 0.5), q.dtype)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q * scale, ck,
+                        preferred_element_type=jnp.float32)
+    k_pos = jax.lax.broadcasted_iota(jnp.int32, (b, S), 1)
+    visible = k_pos <= pos[:, None]  # [b, S]: each slot sees its own prefix
+    scores = jnp.where(visible[:, None, None, :], scores, -jnp.inf)
+    p = jax.nn.softmax(scores, axis=-1).astype(cv.dtype)
+    att = jnp.einsum("bhqk,bhkd->bhqd", p, cv, preferred_element_type=jnp.float32)
+    att = jnp.swapaxes(att.astype(h.dtype), 1, 2).reshape(b, s, d)
+    h = h + att @ ow + ob
+    x2 = ln(h, n2w, n2b)
+    y = jax.nn.gelu(x2 @ f1w + f1b, approximate=True)
+    h = h + y @ f2w + f2b
+    return h, ck, cv
+
+
+def _slot_decode_forward(stacked, wte, wpe, fnw, fnb, tok, cache_k, cache_v, pos, *, num_heads):
+    """One-token trunk forward with per-slot positions: the decode-step
+    program of the serving engine. ``tok`` [b] int32 (last token per slot),
+    ``cache_k``/``cache_v`` [L, b, H, S, dh], ``pos`` [b] int32. Returns
+    (logits [b, V], cache_k, cache_v) — exactly one compiled program serves
+    every step of every request regardless of each slot's depth.
+    """
+    params, idx = stacked
+    num_layers = params[0].shape[0]
+    h = (jnp.take(wte, tok, axis=0) + jnp.take(wpe, pos, axis=0))[:, None, :]
+    h = h.astype(wte.dtype)
+    new_k, new_v = [], []
+    for i in range(num_layers):
+        lp = (tuple(p[i] for p in params), idx[i])
+        h, ck, cv = _slot_cache_block(lp, h, cache_k[i], cache_v[i], pos, num_heads=num_heads)
+        new_k.append(ck)
+        new_v.append(cv)
+    mean = jnp.mean(h, axis=-1, keepdims=True)
+    var = jnp.var(h, axis=-1, keepdims=True)
+    h = (h - mean) / jnp.sqrt(var + 1e-5) * fnw + fnb
+    logits = jnp.einsum("bsd,vd->bsv", h, wte)[:, 0]
+    return logits, jnp.stack(new_k), jnp.stack(new_v)
+
+
 def _select_token(logits, key, do_sample, temperature, top_k, top_p):
     """Greedy or temperature/top-k/top-p sampling over [b, V] logits."""
     if not do_sample:
@@ -506,6 +590,17 @@ def _select_token(logits, key, do_sample, temperature, top_k, top_p):
         threshold = jnp.min(jnp.where(keep, sl, jnp.inf), axis=-1, keepdims=True)
         logits = jnp.where(logits < threshold, -jnp.inf, logits)
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
+def _select_token_rows(logits, keys, do_sample, temperature, top_k, top_p):
+    """Per-row variant of :func:`_select_token` for slot-masked sampling:
+    ``keys`` carries one PRNG key PER batch slot so a request's sample stream
+    depends only on its own (seed, position) — never on which slot it landed
+    in or what its batch neighbours are doing (no cross-request leakage)."""
+    if not do_sample:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    pick = lambda lg, k: _select_token(lg[None], k, True, temperature, top_k, top_p)[0]  # noqa: E731
+    return jax.vmap(pick)(logits, keys)
 
 
 @functools.partial(jax.jit, static_argnames=("num_heads", "num_layers", "head_dim", "max_new", "do_sample", "temperature", "top_k", "top_p", "eos", "mesh"))
@@ -809,6 +904,8 @@ class GPTForPretraining(nn.Layer):
             "feed_shapes": [[-1, int(prompt_len)], []],
             "feed_dtypes": ["int32", "int32"],
             "decoder": {"prompt_len": int(prompt_len), "max_new_tokens": int(max_new_tokens)},
+            "format": "stablehlo",
+            "producer": f"paddle_tpu/jax {jax.__version__}",
         }
         Path(str(path) + ".pdiparams").write_bytes(pickle.dumps(meta))
         return str(path)

@@ -10,14 +10,21 @@ Incremental decoding (reference transformer.py:284 ``gen_cache`` /
 ``Cache``/``StaticCache``): every layer accepts ``cache=`` and, when given
 one, returns ``(output, updated_cache)`` with the newly projected K/V
 concatenated on the sequence axis — the reference's fused_multi_transformer
-decode semantics. For a jit-compiled fixed-shape decode loop see
-``models/gpt.py GPTForPretraining.generate``.
+decode semantics. The concat grows the cache by one position per token: a
+NEW shape (and, under jit, a new compiled program) every step. For serving,
+``gen_cache(..., static=True, max_seq=N)`` returns a :class:`FixedCache`
+instead — a preallocated ``[b, max_seq, h, d]`` device buffer updated via
+``lax.dynamic_update_slice`` at a traced position index, so every decode
+step has identical shapes and one compiled program serves the whole
+sequence. For the fully-compiled decode loop see ``models/gpt.py
+GPTForPretraining.generate`` and ``paddle_tpu.inference.DecodeEngine``.
 """
 from __future__ import annotations
 
 import collections
 
 from ...tensor import manipulation as M
+from ...tensor._helpers import op as _op
 from .. import functional as F
 from .. import initializer as I
 from .base import Layer
@@ -26,11 +33,43 @@ from .container import LayerList
 from .norm import LayerNorm
 
 
+def _fixed_cache_write(cache, k_new, v_new):
+    """Write ``k_new``/``v_new`` [b, s, h, d] into a :class:`FixedCache` at
+    its position index (``lax.dynamic_update_slice`` at a traced scalar —
+    shapes never change, so a jitted decode step compiles once)."""
+    import jax.lax as lax
+
+    upd = lambda c, u, p: lax.dynamic_update_slice(c, u, (0, p, 0, 0))  # noqa: E731
+    k = _op(upd, cache.k, k_new, cache.pos, _name="kv_cache_update")
+    v = _op(upd, cache.v, v_new, cache.pos, _name="kv_cache_update")
+    return k, v
+
+
+def _fixed_cache_mask(pos, s, max_seq):
+    """Bool [s, max_seq] attention mask for a FixedCache read: query row i
+    (absolute position pos+i) sees keys at positions <= pos+i; preallocated
+    positions beyond the write frontier stay invisible."""
+    import jax.lax as lax
+    import jax.numpy as jnp
+
+    def build(p):
+        k_pos = lax.broadcasted_iota(jnp.int32, (s, max_seq), 1)
+        q_pos = p + lax.broadcasted_iota(jnp.int32, (s, max_seq), 0)
+        return k_pos <= q_pos
+
+    return _op(build, pos, _name="kv_cache_mask")
+
+
 class MultiHeadAttention(Layer):
     """Parity: paddle.nn.MultiHeadAttention (transformer.py:77)."""
 
     Cache = collections.namedtuple("Cache", ["k", "v"])
     StaticCache = collections.namedtuple("StaticCache", ["k", "v"])
+    # Serving-path incremental cache: preallocated [b, max_seq, h, d] K/V
+    # plus the scalar write position. Unlike Cache (concat-grown), shapes
+    # are constant for the whole decode, so exactly one compiled program
+    # serves every step.
+    FixedCache = collections.namedtuple("FixedCache", ["k", "v", "pos"])
 
     def __init__(self, embed_dim, num_heads, dropout=0.0, kdim=None, vdim=None, need_weights=False, weight_attr=None, bias_attr=None):
         super().__init__()
@@ -52,10 +91,25 @@ class MultiHeadAttention(Layer):
         v = M.reshape(self.v_proj(value), [b, -1, self.num_heads, self.head_dim])
         return k, v
 
-    def gen_cache(self, key, value=None, type=None):
+    def gen_cache(self, key, value=None, type=None, static=False, max_seq=None):
         """Parity: transformer.py:284. ``type=StaticCache`` precomputes the
         cross-attention K/V from ``key``/``value``; ``type=Cache`` (default)
-        starts an empty incremental self-attention cache."""
+        starts an empty incremental self-attention cache.
+
+        ``static=True`` starts a :class:`FixedCache` instead: K/V are
+        preallocated ``[b, max_seq, h, d]`` zeros written in place at the
+        carried position — every decode step keeps identical shapes, so the
+        dygraph loop (or a jitted step over it) compiles exactly once
+        instead of once per sequence length."""
+        if static:
+            if max_seq is None:
+                raise ValueError("gen_cache(static=True) needs max_seq=")
+            b = key.shape[0]
+            from ...tensor.creation import zeros
+
+            dt = key.dtype
+            empty = lambda: zeros([b, int(max_seq), self.num_heads, self.head_dim], dtype=dt)  # noqa: E731
+            return self.FixedCache(empty(), empty(), zeros([], dtype="int32"))
         type = type or self.Cache
         if type is self.StaticCache:
             k, v = self._proj_kv(key, value if value is not None else key)
@@ -74,6 +128,18 @@ class MultiHeadAttention(Layer):
         q = M.reshape(self.q_proj(query), [b, -1, self.num_heads, self.head_dim])
         if isinstance(cache, self.StaticCache):
             k, v = cache.k, cache.v
+        elif isinstance(cache, self.FixedCache):
+            # static-shape incremental decode: write the new K/V at the
+            # carried position, attend over the full buffer under the
+            # position mask (attn_mask is ignored on this path — the cache
+            # mask IS the causal structure)
+            k_new, v_new = self._proj_kv(key, value)
+            s = q.shape[1]
+            k, v = _fixed_cache_write(cache, k_new, v_new)
+            attn_mask = _fixed_cache_mask(cache.pos, s, k.shape[1])
+            out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask, dropout_p=self.dropout, training=self.training)
+            out = M.reshape(out, [b, -1, self.embed_dim])
+            return self.out_proj(out), self.FixedCache(k, v, cache.pos + s)
         else:
             k, v = self._proj_kv(key, value)
             if isinstance(cache, self.Cache):

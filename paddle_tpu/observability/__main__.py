@@ -8,7 +8,10 @@ Prints, from one structured run log (see :mod:`.runlog`):
 - step-time percentiles (p50/p90/p99) and fused-dispatch stats,
 - a training-stability section (bad-step rate, loss spikes, rollbacks,
   final loss scale) when the run produced any ``bad_step``/``loss_spike``/
-  ``rollback``/``loss_scale`` events.
+  ``rollback``/``loss_scale`` events,
+- a serving section (request rate, queue depth, prefill/decode time split,
+  latency p50/p99 and time-to-first-token) when the run produced
+  ``request`` events (the continuous-batching scheduler's stream).
 
 ``--json`` emits the same analysis as one JSON object for tooling.
 """
@@ -103,6 +106,50 @@ def analyze(events: List[dict]) -> dict:
                 r: sum(1 for ev in scale_evs if ev.get("reason") == r)
                 for r in ("grow", "backoff")}
         out["stability"] = stability
+    # serving section from the scheduler's request-event stream
+    reqs = [ev for ev in events if ev.get("event") == "request"]
+    if reqs:
+        out["serving"] = _analyze_serving(reqs)
+    return out
+
+
+def _analyze_serving(reqs: List[dict]) -> dict:
+    """Request-level serving stats from ``request`` events (submitted →
+    admitted → finished) emitted by the continuous-batching scheduler."""
+    by_status = defaultdict(list)
+    for ev in reqs:
+        by_status[ev.get("status", "?")].append(ev)
+    finished = by_status.get("finished", [])
+    ts = [ev["ts"] for ev in reqs if isinstance(ev.get("ts"), (int, float))]
+    wall = (max(ts) - min(ts)) if len(ts) > 1 else 0.0
+    out = {
+        "submitted": len(by_status.get("submitted", [])),
+        "admitted": len(by_status.get("admitted", [])),
+        "finished": len(finished),
+        "wall_seconds": wall,
+        "requests_per_sec": (len(finished) / wall) if (finished and wall > 0) else None,
+    }
+    depths = [ev["queue_depth"] for ev in reqs
+              if isinstance(ev.get("queue_depth"), (int, float))]
+    if depths:
+        out["queue_depth"] = {"mean": sum(depths) / len(depths), "max": max(depths)}
+    if finished:
+        out["tokens_generated"] = sum(int(ev.get("new_tokens", 0)) for ev in finished)
+        for field, key in (("total_seconds", "latency"), ("ttft_seconds", "ttft")):
+            vals = sorted(ev[field] for ev in finished
+                          if isinstance(ev.get(field), (int, float)))
+            if vals:
+                out[key] = {
+                    "p50_seconds": _percentile(vals, 50),
+                    "p99_seconds": _percentile(vals, 99),
+                    "mean_seconds": sum(vals) / len(vals),
+                }
+        split = {}
+        for field in ("queue_seconds", "prefill_seconds", "decode_seconds"):
+            tot = sum(ev[field] for ev in finished
+                      if isinstance(ev.get(field), (int, float)))
+            split[field.replace("_seconds", "")] = tot
+        out["phase_split_seconds"] = split
     return out
 
 
@@ -140,6 +187,32 @@ def print_report(path: str, a: dict) -> None:
             tr = sb.get("loss_scale_transitions", {})
             print(f"    loss scale: final {sb['final_loss_scale']:g} "
                   f"(grow x{tr.get('grow', 0)}, backoff x{tr.get('backoff', 0)})")
+    sv = a.get("serving")
+    if sv:
+        print("  serving (continuous-batching request stream):")
+        rps = sv.get("requests_per_sec")
+        print(f"    requests: {sv['submitted']} submitted, {sv['admitted']} "
+              f"admitted, {sv['finished']} finished"
+              + (f"  ({rps:.2f} req/s)" if rps else ""))
+        qd = sv.get("queue_depth")
+        if qd:
+            print(f"    queue depth: mean {qd['mean']:.2f}  max {qd['max']:.0f}")
+        lat = sv.get("latency")
+        if lat:
+            print(f"    latency: p50 {lat['p50_seconds'] * 1e3:.2f} ms   "
+                  f"p99 {lat['p99_seconds'] * 1e3:.2f} ms")
+        tt = sv.get("ttft")
+        if tt:
+            print(f"    time to first token: p50 {tt['p50_seconds'] * 1e3:.2f} ms   "
+                  f"p99 {tt['p99_seconds'] * 1e3:.2f} ms")
+        sp = sv.get("phase_split_seconds")
+        if sp:
+            total = sum(sp.values()) or 1.0
+            parts = "  ".join(f"{k} {v:.4f}s ({100 * v / total:.0f}%)"
+                              for k, v in sp.items())
+            print(f"    phase split: {parts}")
+        if sv.get("tokens_generated") is not None:
+            print(f"    tokens generated: {sv['tokens_generated']}")
 
 
 def main(argv=None) -> int:

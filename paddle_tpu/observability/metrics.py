@@ -144,6 +144,17 @@ def declare_counter(name: str) -> None:
 
 _DECLARED_COUNTERS: set = set()
 
+# Serving-tier series (inference engine + continuous-batching scheduler):
+# pre-declared here so a scrape of an idle predictor process already shows
+# the full serving surface at 0. ``infer.compiles`` is the series the
+# "decode of N tokens compiles exactly 2 programs" regression pins.
+SERVING_COUNTERS: Tuple[str, ...] = (
+    "infer.compiles", "infer.runs",
+    "infer.prefill_dispatches", "infer.decode_dispatches", "infer.tokens",
+    "serving.requests_submitted", "serving.requests_admitted",
+    "serving.requests_completed", "serving.tokens_generated",
+)
+
 
 # -------------------------------------------------------------------- gauges
 def gauge_set(name: str, value: float) -> None:

@@ -35,16 +35,21 @@ __all__ = [
 ]
 
 
-def quant_abs_max(w: np.ndarray, channel_axis: Optional[int] = None):
+def quant_abs_max(w: np.ndarray, channel_axis=None):
     """int8 symmetric quantization. Per-channel when ``channel_axis`` given
-    (reference channel_wise_abs_max), else per-tensor abs_max.
+    (reference channel_wise_abs_max), else per-tensor abs_max. A tuple
+    ``channel_axis`` keeps a scale per index along EVERY listed axis — the
+    form the serving engine uses for [L, in, out]-stacked trunk weights
+    (per-layer × per-output-channel scales, axis (0, 2)).
     Returns (int8 array, f32 scale broadcastable against w)."""
     w = np.asarray(w, np.float32)
     if channel_axis is None:
         scale = np.maximum(np.abs(w).max(), 1e-8) / 127.0
         scale = np.asarray(scale, np.float32)
     else:
-        axes = tuple(i for i in range(w.ndim) if i != channel_axis)
+        keep = (channel_axis,) if isinstance(channel_axis, int) else tuple(channel_axis)
+        keep = tuple(a % w.ndim for a in keep)
+        axes = tuple(i for i in range(w.ndim) if i not in keep)
         scale = np.maximum(np.abs(w).max(axis=axes, keepdims=True), 1e-8) / 127.0
     q = np.clip(np.round(w / scale), -127, 127).astype(np.int8)
     return q, scale.astype(np.float32)

@@ -630,6 +630,10 @@ def save_inference_model(path_prefix: str, feed_vars: List[Tensor], fetch_vars: 
         # symbolic (dynamic) dims serialize as -1
         "feed_shapes": [[int(d) if isinstance(d, int) else -1 for d in s.shape] for s in specs],
         "feed_dtypes": [str(s.dtype) for s in specs],
+        # artifact provenance: .pdmodel is serialized StableHLO (jax.export);
+        # this pickle sidecar is the legacy metadata format
+        "format": "stablehlo",
+        "producer": f"paddle_tpu/jax {jax.__version__}",
     }
     Path(str(path) + ".pdiparams").write_bytes(pickle.dumps(meta))
 

@@ -32,3 +32,10 @@ def test_finetune_classifier_example():
 def test_serve_text_example():
     out = _run("serve_text.py")
     assert "->" in out
+
+
+@pytest.mark.slow
+def test_serve_gpt_example():
+    out = _run("serve_gpt.py")
+    assert "2 compiled programs" in out
+    assert "served 6 requests" in out

@@ -114,6 +114,60 @@ def test_gpt_block_cache_incremental_matches_full():
     np.testing.assert_allclose(np.concatenate(outs, axis=1), full, rtol=2e-5, atol=2e-5)
 
 
+def test_gpt_block_fixed_cache_matches_growing_concat():
+    """gen_cache(static=True, max_seq=...) decode == the growing-concat
+    cache decode AND the full forward, with CONSTANT cache shapes: the
+    dygraph path's fixed-shape serving cache (a jitted step over it
+    compiles once instead of once per sequence length)."""
+    from paddle_tpu.models.gpt import GPTBlock, GPTConfig
+    from paddle_tpu.nn.layer.transformer import MultiHeadAttention
+
+    cfg = GPTConfig.tiny()
+    blk = GPTBlock(cfg)
+    blk.eval()
+    x = paddle.to_tensor(np.random.default_rng(5).normal(size=(2, 6, cfg.hidden_size)).astype("float32"))
+    full = blk(x).numpy()
+    cache = blk.gen_cache(x, static=True, max_seq=16)
+    assert isinstance(cache, MultiHeadAttention.FixedCache)
+    outs, shapes = [], set()
+    for t in range(6):
+        o, cache = blk(x[:, t:t + 1], cache=cache)
+        outs.append(o.numpy())
+        shapes.add((tuple(cache.k.shape), tuple(cache.v.shape)))
+    assert shapes == {((2, 16, cfg.num_heads, cfg.hidden_size // cfg.num_heads),) * 2}
+    assert int(cache.pos.numpy()) == 6
+    np.testing.assert_allclose(np.concatenate(outs, axis=1), full, rtol=2e-5, atol=2e-5)
+    # chunked prefill + single-token steps agree too (the serving split)
+    cache2 = blk.gen_cache(x, static=True, max_seq=16)
+    o0, cache2 = blk(x[:, :4], cache=cache2)
+    o1, cache2 = blk(x[:, 4:5], cache=cache2)
+    np.testing.assert_allclose(np.concatenate([o0.numpy(), o1.numpy()], axis=1),
+                               full[:, :5], rtol=2e-5, atol=2e-5)
+    with pytest.raises(ValueError):
+        blk.gen_cache(x, static=True)  # max_seq is required
+
+
+def test_mha_fixed_cache_matches_growing_concat():
+    """nn.MultiHeadAttention: static fixed-shape cache == Cache concat."""
+    import paddle_tpu.nn as nn
+
+    mha = nn.MultiHeadAttention(32, 4)
+    mha.eval()
+    x = paddle.to_tensor(np.random.default_rng(9).normal(size=(2, 5, 32)).astype("float32"))
+    grow = mha.gen_cache(x)
+    fixed = mha.gen_cache(x, static=True, max_seq=12)
+    got_g, got_f = [], []
+    for t in range(5):
+        xt = x[:, t:t + 1]
+        og, grow = mha(xt, cache=grow)
+        of, fixed = mha(xt, cache=fixed)
+        got_g.append(og.numpy())
+        got_f.append(of.numpy())
+    np.testing.assert_allclose(np.concatenate(got_f, 1), np.concatenate(got_g, 1),
+                               rtol=2e-5, atol=2e-5)
+    assert tuple(fixed.k.shape) == (2, 12, 4, 8)
+
+
 def test_generate_mp_sharded_parity():
     """mp=2 tensor-parallel decode == replicated decode (greedy).
 
