@@ -38,12 +38,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from .engine import DecodeEngine, default_buckets
+from .prefix_cache import PrefixCache
 from .scheduler import ContinuousBatchingScheduler, Request
 
 __all__ = [
     "Config", "Predictor", "create_predictor", "PredictorTensor",
     "DecodeEngine", "ContinuousBatchingScheduler", "Request",
-    "default_buckets", "get_version",
+    "PrefixCache", "default_buckets", "get_version",
 ]
 
 

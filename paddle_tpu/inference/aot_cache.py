@@ -1,0 +1,97 @@
+"""Persist AOT-compiled serving executables across process restarts.
+
+A restarted engine pays the full prefill/decode compile family again before
+it can serve its first token — the ROADMAP restart-latency leftover. When
+``FLAGS_compile_cache_dir`` is set, every serving program the engine
+compiles is also serialized (``jax.experimental.serialize_executable`` —
+the raw PJRT executable plus its call trees) under
+``<dir>/serving/<key>.aotc``, keyed on the (kind, argument avals, engine
+fingerprint, jax version, backend) specialization. A fresh engine with the
+same specialization loads the executable instead of recompiling: restart
+``time_to_first_token`` drops to deserialize+dispatch cost
+(bench_serve.py reports it as ``restart_ttft``).
+
+Everything here is best-effort: backends without executable serialization,
+version drift, or a corrupt file all degrade to the normal compile path —
+persistence must never break dispatch. Writes are atomic
+(temp + ``os.replace``) so concurrent engines can share a directory.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+from pathlib import Path
+from typing import Any, Optional
+
+__all__ = ["cache_dir", "make_key", "load", "store"]
+
+_FORMAT = "aotc-v1"
+
+
+def cache_dir() -> Optional[Path]:
+    """The serving executable cache directory, or None when the
+    ``FLAGS_compile_cache_dir`` flag is unset."""
+    from ..framework.flags import flag
+
+    d = flag("FLAGS_compile_cache_dir")
+    if not d:
+        return None
+    return Path(str(d)) / "serving"
+
+
+def make_key(kind: str, sig: Any, fingerprint: str) -> str:
+    """Stable content key for one compiled specialization: the program kind
+    (prefill / decode / decode_xD / chunk / ...), the argument avals, the
+    engine's config fingerprint (model dims, sampling config, dtypes — the
+    host scalars baked into the trace), and the jax version + backend the
+    executable was built for."""
+    import jax
+
+    payload = repr((_FORMAT, kind, sig, fingerprint, jax.__version__,
+                    jax.default_backend()))
+    return hashlib.sha256(payload.encode()).hexdigest()[:32]
+
+
+def load(key: str):
+    """Deserialize + load the executable stored under ``key``; None on any
+    miss or failure (caller compiles normally)."""
+    d = cache_dir()
+    if d is None:
+        return None
+    path = d / f"{key}.aotc"
+    if not path.exists():
+        return None
+    try:
+        from jax.experimental.serialize_executable import deserialize_and_load
+
+        payload, in_tree, out_tree = pickle.loads(path.read_bytes())
+        return deserialize_and_load(payload, in_tree, out_tree)
+    except Exception:
+        return None
+
+
+def store(key: str, compiled) -> bool:
+    """Serialize ``compiled`` (an XLA ``Compiled`` from ``lower().compile()``)
+    under ``key``. False (and no file) when the backend can't serialize
+    executables or the directory is unwritable."""
+    d = cache_dir()
+    if d is None:
+        return False
+    tmp = None
+    try:
+        from jax.experimental.serialize_executable import serialize
+
+        payload, in_tree, out_tree = serialize(compiled)
+        d.mkdir(parents=True, exist_ok=True)
+        tmp = d / f".{key}.{os.getpid()}.tmp"
+        tmp.write_bytes(pickle.dumps((payload, in_tree, out_tree)))
+        os.replace(tmp, d / f"{key}.aotc")
+        return True
+    except Exception:
+        if tmp is not None:
+            try:
+                tmp.unlink()
+            except OSError:
+                pass
+        return False

@@ -3,23 +3,35 @@
 Replaces the growing-concat ``MultiHeadAttention.Cache`` decode (a new
 shape — and under jit a new compiled program — every token) with a
 preallocated device-resident cache updated in place at traced position
-indices. Exactly TWO compiled programs serve an entire request stream:
+indices. A small fixed family of compiled programs serves an entire
+request stream:
 
-- **prefill** — one compile per prompt-length bucket: runs the prompt
-  through the trunk on a fresh ``[L, 1, H, P, dh]`` cache segment, inserts
-  it into the engine's big ``[L, B, H, S, dh]`` cache at a batch *slot*
-  index, and samples the first token;
-- **decode step** — ONE compile total: advances every occupied slot one
-  token with per-slot position indices (slots at different depths share the
-  program), slot-masked sampling, and in-place K/V writes.
+- **prefill** — bucketed (one compile per prompt-length bucket, the PR-6
+  path) or **chunked** (``prefill_chunk=C``): the prompt runs as a sequence
+  of fixed-``C``-token dispatches directly against the big cache, so the
+  whole per-bucket compile family collapses into ONE chunk program plus one
+  final-chunk program (sampling fused), and a long admission can interleave
+  with decode instead of stalling it;
+- **decode step** — advances every occupied slot one token with per-slot
+  position indices; ``fuse=D`` runs D decode iterations inside ONE donated
+  ``lax.scan`` dispatch (the ``TrainStep.run_steps`` idiom via
+  ``jit.scan_steps``), with the eos/max-token stop flags carried in the
+  scan state so finished slots self-deactivate without a host round-trip —
+  one dispatch and one host sync per D tokens;
+- **prefix reuse** — ``prefix_cache_mb=M`` keeps an LRU cache of
+  chunk-aligned prompt-prefix KV segments (:mod:`.prefix_cache`); a request
+  whose prefix matches copies the cached chunks into its slot with one
+  compiled ``dynamic_update_slice`` program per chunk — no prefill compute
+  or compile for the shared portion.
 
-Both programs donate the cache (and slot-state) buffers — the XLA executable
-updates them in place, so cache memory stays flat for the life of the engine
-(the PR-3 donation idiom from ``jit.TrainStep``/the static Executor, applied
-to serving). Compiles run through the observability AOT ``lower().compile()``
-path, so ``explain()`` answers cost/memory questions and the
-``infer.compiles`` counter lets tests pin "decode of N tokens compiles
-exactly 2 programs".
+Both cache buffers (and the slot state) are donated — the XLA executable
+updates them in place, so cache memory stays flat for the life of the
+engine. Compiles run through the observability AOT ``lower().compile()``
+path, so ``explain()`` answers cost/memory questions, the
+``infer.compiles`` counter pins the program-family size in tests, and — with
+``FLAGS_compile_cache_dir`` set — every executable is serialized to disk
+(:mod:`.aot_cache`) so a RESTARTED engine skips the compile family
+entirely.
 
 Parity: the reference serves GPT decode through
 ``fused_multi_transformer_op.cu`` driven by AnalysisPredictor; here the
@@ -60,6 +72,37 @@ def _dequant(entry, dt):
     return entry
 
 
+class _PrefillJob:
+    """Host-side progress of one in-flight prompt admission: which slot it
+    owns, how far the cache is written (``next_pos``), how many tokens the
+    prefix cache supplied, and — once the final chunk ran — the sampled
+    first token."""
+
+    __slots__ = ("slot", "prompt", "n", "eos", "limit", "seed",
+                 "next_pos", "reused_tokens", "done", "first", "more")
+
+    def __init__(self, slot, prompt, n, eos, limit, seed):
+        self.slot = slot
+        self.prompt = prompt
+        self.n = n
+        self.eos = eos
+        self.limit = limit
+        self.seed = seed
+        self.next_pos = 0          # cache rows [0, next_pos) are written
+        self.reused_tokens = 0     # rows supplied by the prefix cache
+        self.done = False
+        self.first: Optional[int] = None
+        self.more: Optional[bool] = None
+
+    def chunks_left(self, chunk: Optional[int]) -> int:
+        """Model dispatches still needed to finish this prefill."""
+        if self.done:
+            return 0
+        if chunk is None:
+            return 1
+        return max(1, -(-(self.n - self.next_pos) // chunk))
+
+
 class DecodeEngine:
     """Slot-based autoregressive decode over a static KV cache.
 
@@ -73,6 +116,20 @@ class DecodeEngine:
     :mod:`paddle_tpu.quantization`; the compiled programs carry int8
     constants and dequantize into the matmuls.
 
+    Serving-throughput knobs (each defaults to the PR-6 behaviour):
+
+    - ``fuse=D`` — default decode fusion depth: :meth:`decode_step` runs D
+      iterations per dispatch (helps whenever per-dispatch host overhead is
+      visible, i.e. small models / fast devices; a slot that finishes
+      mid-scan idles until the dispatch drains, so very large D wastes
+      compute on short completions);
+    - ``prefill_chunk=C`` — chunked prefill: prompts prefill in fixed
+      C-token dispatches against the big cache (compile family becomes 2
+      programs for ALL prompt lengths; long prompts interleave with decode);
+    - ``prefix_cache_mb=M`` — prefix KV reuse over chunk-aligned prompt
+      prefixes (requires ``prefill_chunk``), LRU-evicted under an M-MiB
+      device-byte budget.
+
     Sampling config (``do_sample``/``temperature``/``top_k``/``top_p``) is
     compiled into the programs; per-request randomness comes from each
     request's own ``seed`` folded with its absolute position, so a request's
@@ -82,7 +139,8 @@ class DecodeEngine:
     def __init__(self, model, max_batch_slots: int = 4, max_seq_len: Optional[int] = None,
                  prefill_buckets: Optional[Sequence[int]] = None, do_sample: bool = False,
                  temperature: float = 1.0, top_k: int = 0, top_p: float = 1.0,
-                 int8: bool = False, donate: bool = True):
+                 int8: bool = False, donate: bool = True, fuse: int = 1,
+                 prefill_chunk: Optional[int] = None, prefix_cache_mb: float = 0.0):
         from ..models.gpt import GPTBlockStack
 
         if not isinstance(model.gpt.layers, GPTBlockStack):
@@ -100,6 +158,12 @@ class DecodeEngine:
         self._sample = (bool(do_sample), float(temperature), int(top_k), float(top_p))
         self.int8 = bool(int8)
         self._donate = bool(donate)
+        self.fuse = int(fuse)
+        if self.fuse < 1:
+            raise ValueError(f"fuse depth must be >= 1, got {fuse}")
+        self._chunk = int(prefill_chunk) if prefill_chunk else None
+        if self._chunk is not None and not (1 <= self._chunk <= S):
+            raise ValueError(f"prefill_chunk {prefill_chunk} must be in [1, max_seq_len={S}]")
 
         stacked, wte, wpe, fnw, fnb = model._decode_params()
         params, self._idx = stacked
@@ -140,13 +204,41 @@ class DecodeEngine:
         self._limit = np.zeros((B,), np.int32)
         self._seed = np.zeros((B,), np.int32)
 
+        self.prefix_cache = None
+        if prefix_cache_mb and float(prefix_cache_mb) > 0:
+            if self._chunk is None:
+                raise ValueError("prefix_cache_mb requires prefill_chunk= (prefix "
+                                 "entries are chunk-aligned KV segments)")
+            from .prefix_cache import PrefixCache
+
+            entry_bytes = 2 * L * H * self._chunk * dh * jnp.dtype(dt).itemsize
+            self.prefix_cache = PrefixCache(self._chunk,
+                                            int(float(prefix_cache_mb) * (1 << 20)),
+                                            entry_bytes)
+
+        # host scalars baked into the traced programs — part of the disk
+        # cache key so a restarted engine only reuses executables compiled
+        # for the exact same specialization
+        self._fingerprint = repr((
+            (cfg.vocab_size, cfg.hidden_size, cfg.num_layers, cfg.num_heads,
+             cfg.ffn_hidden_size, cfg.max_seq_len),
+            self._sample, self.int8, self._donate, S, B, self._chunk,
+            tuple(str(d) for d in self._stack_dts), str(dt)))
+
         self._build()
+        self._fused_jits: Dict[int, Any] = {}
         self._compiled: Dict[tuple, Any] = {}
         self._specializations: List[dict] = []
 
     # ------------------------------------------------------------ programs
     def _build(self):
-        from ..models.gpt import _cache_forward, _select_token, _select_token_rows, _slot_decode_forward
+        from ..models.gpt import (
+            _cache_forward,
+            _chunk_prefill_forward,
+            _select_token,
+            _select_token_rows,
+            _slot_decode_forward,
+        )
 
         cfg = self.cfg
         num_heads = cfg.num_heads
@@ -162,6 +254,17 @@ class DecodeEngine:
             return ((tuple(_dequant(e, dt) for e, dt in zip(p["stack"], dts)), idx),
                     p["wte"], p["wpe"], p["fnw"], p["fnb"])
 
+        def admit_state(pos, tok, active, first, length, slot, eos, limit):
+            """Shared tail of every first-token program: the in-graph
+            eos/limit check and the per-slot state writes."""
+            done = (eos >= 0) & (first == eos)
+            more = (~done) & (length + 1 < limit)
+            dus = jax.lax.dynamic_update_slice
+            pos = dus(pos, length[None], (slot,))
+            tok = dus(tok, first[None], (slot,))
+            active = dus(active, more[None], (slot,))
+            return pos, tok, active, more
+
         def prefill_fn(p, ck, cv, pos, tok, active, ids, length, slot, eos, limit, seed):
             stacked, wte, wpe, fnw, fnb = unpack(p)
             P = ids.shape[1]
@@ -174,18 +277,51 @@ class DecodeEngine:
             last = jax.lax.dynamic_slice(logits, (0, length - 1, 0), (1, 1, logits.shape[2]))[:, 0]
             key = jax.random.fold_in(jax.random.key(seed), length - 1)
             first = _select_token(last.astype(jnp.float32), key, do_sample, temperature, top_k, top_p)[0]
-            done = (eos >= 0) & (first == eos)
-            more = (~done) & (length + 1 < limit)
-            dus = jax.lax.dynamic_update_slice
-            pos = dus(pos, length[None], (slot,))
-            tok = dus(tok, first[None], (slot,))
-            active = dus(active, more[None], (slot,))
+            pos, tok, active, more = admit_state(pos, tok, active, first, length, slot, eos, limit)
             return ck, cv, pos, tok, active, first, more
 
-        def decode_fn(p, ck, cv, pos, tok, active, eos_v, limit_v, seed_v):
+        def chunk_fn(p, ck, cv, ids, slot, start):
+            stacked, wte, wpe, fnw, fnb = unpack(p)
+            _, ck, cv = _chunk_prefill_forward(stacked, wte, wpe, fnw, fnb, ids, ck, cv,
+                                               slot, start, num_heads=num_heads)
+            return ck, cv
+
+        def chunk_final_fn(p, ck, cv, pos, tok, active, ids, slot, start, last_row,
+                           length, eos, limit, seed):
+            stacked, wte, wpe, fnw, fnb = unpack(p)
+            logits, ck, cv = _chunk_prefill_forward(stacked, wte, wpe, fnw, fnb, ids, ck, cv,
+                                                    slot, start, num_heads=num_heads,
+                                                    last_row=last_row)
+            key = jax.random.fold_in(jax.random.key(seed), length - 1)
+            first = _select_token(logits.astype(jnp.float32), key, do_sample, temperature, top_k, top_p)[0]
+            pos, tok, active, more = admit_state(pos, tok, active, first, length, slot, eos, limit)
+            return ck, cv, pos, tok, active, first, more
+
+        def insert_fn(ck, cv, seg_k, seg_v, slot, start):
+            # prefix-cache hit: copy a cached chunk's KV into the slot's
+            # lanes — the whole "prefill" of the shared portion is this one
+            # dynamic_update_slice program
+            ck = jax.lax.dynamic_update_slice(ck, seg_k, (0, slot, 0, start, 0))
+            cv = jax.lax.dynamic_update_slice(cv, seg_v, (0, slot, 0, start, 0))
+            return ck, cv
+
+        chunk = self._chunk
+
+        def extract_fn(ck, cv, slot, start):
+            size = (L, 1, H, chunk if chunk else 1, dh)
+            seg_k = jax.lax.dynamic_slice(ck, (0, slot, 0, start, 0), size)
+            seg_v = jax.lax.dynamic_slice(cv, (0, slot, 0, start, 0), size)
+            return seg_k, seg_v
+
+        def decode_body(consts, carry, _x):
+            # ONE decode iteration — the scan body of the fused program and
+            # (at D=1) the whole single-step program, so every fuse depth is
+            # bitwise the same math
+            p, eos_v, limit_v, seed_v = consts
+            ck, cv, pos, tok, active = carry
             stacked, wte, wpe, fnw, fnb = unpack(p)
             logits, ck, cv = _slot_decode_forward(stacked, wte, wpe, fnw, fnb, tok, ck, cv,
-                                                  pos, num_heads=num_heads)
+                                                  pos, num_heads=num_heads, active=active)
             keys = jax.vmap(lambda s, q: jax.random.fold_in(jax.random.key(s), q))(seed_v, pos)
             nxt = _select_token_rows(logits.astype(jnp.float32), keys, do_sample,
                                      temperature, top_k, top_p)
@@ -193,16 +329,43 @@ class DecodeEngine:
             hit_eos = (eos_v >= 0) & (nxt == eos_v)
             new_pos = pos + active.astype(jnp.int32)
             new_active = active & ~hit_eos & (new_pos + 1 < limit_v)
-            return ck, cv, new_pos, nxt, new_active
+            # ys: the step's token per slot + which slots really emitted
+            return (ck, cv, new_pos, nxt, new_active), (nxt, active)
+
+        self._decode_body = decode_body
+
+        def decode_fn(p, ck, cv, pos, tok, active, eos_v, limit_v, seed_v):
+            carry, _ys = decode_body((p, eos_v, limit_v, seed_v),
+                                     (ck, cv, pos, tok, active), None)
+            return carry
 
         donate = (1, 2, 3, 4, 5) if self._donate else ()
+        donate_cache = (1, 2) if self._donate else ()
         self._prefill_jit = jax.jit(prefill_fn, donate_argnums=donate)
         self._decode_jit = jax.jit(decode_fn, donate_argnums=donate)
+        self._chunk_jit = jax.jit(chunk_fn, donate_argnums=donate_cache)
+        self._chunk_final_jit = jax.jit(chunk_final_fn, donate_argnums=donate)
+        self._insert_jit = jax.jit(insert_fn, donate_argnums=(0, 1) if self._donate else ())
+        self._extract_jit = jax.jit(extract_fn)  # pure read: nothing donated
 
-    def _dispatch(self, which: str, jitfn, args):
+    def _fused(self, depth: int):
+        """The fused-decode program for ``depth`` scan iterations (compiled
+        once per distinct depth; carry donated, params threaded as consts)."""
+        jitfn = self._fused_jits.get(depth)
+        if jitfn is None:
+            from ..jit import scan_steps
+
+            jitfn = scan_steps(self._decode_body, length=depth, with_consts=True,
+                               donate_argnums=(1,) if self._donate else ())
+            self._fused_jits[depth] = jitfn
+        return jitfn
+
+    def _dispatch(self, which: str, jitfn, args, label: Optional[str] = None):
         """Run one dispatch, AOT-compiling on a new (kind, shape) signature
         so the XLA Compiled handle is retained for ``explain()`` and the
-        compile is counted/logged — the TrainStep._dispatch idiom."""
+        compile is counted/logged — the TrainStep._dispatch idiom. With
+        ``FLAGS_compile_cache_dir`` set, executables round-trip through the
+        on-disk AOT cache: a restarted engine loads instead of compiling."""
         sig = (which,) + tuple(
             (tuple(l.shape), str(l.dtype)) for l in jax.tree_util.tree_leaves(args))
         entry = self._compiled.get(sig)
@@ -211,20 +374,33 @@ class DecodeEngine:
             from ..observability import runlog as _runlog
             from ..observability import span as _span
             from ..profiler import counter_inc
+            from . import aot_cache
 
-            with _span("infer.compile"):
-                compiled, info = _introspect.aot_compile(jitfn, args)
-            entry = compiled if compiled is not None else jitfn
-            self._compiled[sig] = entry
-            counter_inc("infer.compiles")
-            info["label"] = which if which == "decode" else f"{which}/P{args[6].shape[1]}"
-            info["kind"] = which
-            self._specializations.append(info)
-            _runlog.emit("compile", component="infer", label=info["label"],
-                         seconds=info.get("compile_seconds"),
-                         flops=info.get("flops"),
-                         bytes_accessed=info.get("bytes_accessed"),
-                         peak_bytes=info.get("peak_bytes"))
+            label = label or which
+            key = aot_cache.make_key(which, sig[1:], self._fingerprint)
+            entry = aot_cache.load(key)
+            if entry is not None:
+                self._compiled[sig] = entry
+                counter_inc("infer.aot_cache_hits")
+                self._specializations.append({"label": label, "kind": which,
+                                              "from_disk_cache": True})
+                _runlog.emit("compile", component="infer", label=label, cached=True)
+            else:
+                with _span("infer.compile"):
+                    compiled, info = _introspect.aot_compile(jitfn, args)
+                entry = compiled if compiled is not None else jitfn
+                self._compiled[sig] = entry
+                counter_inc("infer.compiles")
+                if compiled is not None and aot_cache.store(key, compiled):
+                    counter_inc("infer.aot_cache_stores")
+                info["label"] = label
+                info["kind"] = which
+                self._specializations.append(info)
+                _runlog.emit("compile", component="infer", label=label,
+                             seconds=info.get("compile_seconds"),
+                             flops=info.get("flops"),
+                             bytes_accessed=info.get("bytes_accessed"),
+                             peak_bytes=info.get("peak_bytes"))
         try:
             return entry(*args)
         except (TypeError, ValueError):
@@ -235,6 +411,13 @@ class DecodeEngine:
 
     # ------------------------------------------------------------ slot API
     def bucket_for(self, prompt_len: int) -> int:
+        """The padded prefill length for a prompt: its bucket, or — in
+        chunked mode — the chunk-rounded length (capped at max_seq_len)."""
+        if self._chunk is not None:
+            if prompt_len > self.max_seq_len:
+                raise ValueError(f"prompt of {prompt_len} tokens exceeds "
+                                 f"max_seq_len {self.max_seq_len}")
+            return min(self.max_seq_len, -(-prompt_len // self._chunk) * self._chunk)
         for b in self.buckets:
             if b >= prompt_len:
                 return b
@@ -244,14 +427,15 @@ class DecodeEngine:
     def free_slots(self) -> List[int]:
         return [i for i in range(self.max_batch_slots) if not self._occupied[i]]
 
-    def prefill(self, prompt, slot: int, max_new_tokens: int, eos_token_id: Optional[int] = None,
-                seed: int = 0) -> Tuple[int, bool]:
-        """Admit one prompt into ``slot``: run the bucketed prefill program,
-        write its KV into the slot's cache lanes, sample the first token.
-        Returns ``(first_token, more)`` — ``more`` False means the request
-        finished at its first token (eos or max_new_tokens == 1)."""
-        from ..observability import span as _span
-        from ..profiler import counter_inc
+    # ----------------------------------------------------------- prefill
+    def begin_prefill(self, prompt, slot: int, max_new_tokens: int,
+                      eos_token_id: Optional[int] = None, seed: int = 0) -> _PrefillJob:
+        """Claim ``slot`` for one prompt and apply any prefix-cache hits
+        (insert dispatches only — no model compute). Drive the returned job
+        with :meth:`prefill_step`; the scheduler interleaves those chunk
+        dispatches with decode so long admissions stop stalling the stream.
+        """
+        from ..observability.metrics import counter_inc, gauge_set
 
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         n = int(prompt.shape[0])
@@ -262,47 +446,168 @@ class DecodeEngine:
         if n + int(max_new_tokens) > self.max_seq_len:
             raise ValueError(f"prompt {n} + max_new_tokens {max_new_tokens} "
                              f"exceeds max_seq_len {self.max_seq_len}")
-        P = self.bucket_for(n)
-        ids = np.zeros((1, P), np.int32)
-        ids[0, :n] = prompt
         eos = -1 if eos_token_id is None else int(eos_token_id)
         limit = n + int(max_new_tokens)
-        with _span("infer.prefill"):
-            out = self._dispatch(
-                "prefill", self._prefill_jit,
-                (self._params, self._ck, self._cv, self._pos, self._tok, self._active,
-                 jnp.asarray(ids), jnp.int32(n), jnp.int32(slot), jnp.int32(eos),
-                 jnp.int32(limit), jnp.int32(seed)))
-        self._ck, self._cv, self._pos, self._tok, self._active, first, more = out
-        more = bool(more)
+        job = _PrefillJob(slot, prompt, n, eos, limit, int(seed))
         self._occupied[slot] = True
-        self._active_np[slot] = more
         self._eos[slot] = eos
         self._limit[slot] = limit
         self._seed[slot] = int(seed)
-        counter_inc("infer.prefill_dispatches")
-        counter_inc("infer.tokens")
-        return int(first), more
+        if self.prefix_cache is not None:
+            # reuse at most n-1 tokens: the prompt's last token must run
+            # through the model (its logits pick the first generated token)
+            matched = self.prefix_cache.match(prompt, max_tokens=n - 1)
+            for i, (seg_k, seg_v) in enumerate(matched):
+                self._ck, self._cv = self._dispatch(
+                    "prefix_insert", self._insert_jit,
+                    (self._ck, self._cv, seg_k, seg_v, jnp.int32(slot),
+                     jnp.int32(i * self._chunk)))
+                counter_inc("infer.prefix_insert_dispatches")
+            job.next_pos = job.reused_tokens = len(matched) * self._chunk
+            counter_inc("serving.prefix_hits" if matched else "serving.prefix_misses")
+            counter_inc("serving.prefix_tokens_reused", job.reused_tokens)
+            gauge_set("serving.prefix_cache_bytes", self.prefix_cache.bytes_used())
+        return job
 
-    def decode_step(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
-        """One token for every active slot in ONE dispatch. Returns
-        ``(tokens[B], emitted[B], active[B])`` where ``emitted`` marks slots
-        that produced a real token this step (their pre-step active mask)
-        and ``active`` is the post-step mask (False = request finished)."""
+    def prefill_step(self, job: _PrefillJob) -> bool:
+        """Run ONE prefill dispatch for ``job``: the whole bucket program in
+        bucketed mode, or one C-token chunk in chunked mode. Returns True
+        when the prompt is fully prefilled (``job.first``/``job.more`` are
+        then set and the slot starts decoding on the next decode dispatch).
+        """
         from ..observability import span as _span
         from ..profiler import counter_inc
 
-        emitted = self._active_np.copy()
+        if job.done:
+            return True
+        n, slot = job.n, job.slot
+        if self._chunk is None:
+            P = self.bucket_for(n)
+            ids = np.zeros((1, P), np.int32)
+            ids[0, :n] = job.prompt
+            with _span("infer.prefill"):
+                out = self._dispatch(
+                    "prefill", self._prefill_jit,
+                    (self._params, self._ck, self._cv, self._pos, self._tok, self._active,
+                     jnp.asarray(ids), jnp.int32(n), jnp.int32(slot), jnp.int32(job.eos),
+                     jnp.int32(job.limit), jnp.int32(job.seed)),
+                    label=f"prefill/P{P}")
+            self._ck, self._cv, self._pos, self._tok, self._active, first, more = out
+            job.next_pos = n
+        else:
+            C = self._chunk
+            if job.next_pos + C < n:
+                # intermediate chunk: KV writes only, no logits work
+                ids = job.prompt[job.next_pos:job.next_pos + C][None]
+                with _span("infer.prefill_chunk"):
+                    self._ck, self._cv = self._dispatch(
+                        "prefill_chunk", self._chunk_jit,
+                        (self._params, self._ck, self._cv, jnp.asarray(ids),
+                         jnp.int32(slot), jnp.int32(job.next_pos)),
+                        label=f"prefill_chunk/C{C}")
+                job.next_pos += C
+                counter_inc("infer.prefill_chunk_dispatches")
+                return False
+            # final chunk: cover the remaining rows [next_pos, n) inside one
+            # C-token window. The window start stays chunk-aligned unless the
+            # padded write would spill past the cache end, in which case it
+            # shifts back to [n-C, n) and re-writes a few rows bitwise.
+            w = job.next_pos if job.next_pos + C <= self.max_seq_len else n - C
+            ids = np.zeros((1, C), np.int32)
+            ids[0, :n - w] = job.prompt[w:n]
+            with _span("infer.prefill_chunk"):
+                out = self._dispatch(
+                    "prefill_final", self._chunk_final_jit,
+                    (self._params, self._ck, self._cv, self._pos, self._tok, self._active,
+                     jnp.asarray(ids), jnp.int32(slot), jnp.int32(w),
+                     jnp.int32(n - 1 - w), jnp.int32(n), jnp.int32(job.eos),
+                     jnp.int32(job.limit), jnp.int32(job.seed)),
+                    label=f"prefill_final/C{C}")
+            self._ck, self._cv, self._pos, self._tok, self._active, first, more = out
+            job.next_pos = n
+            counter_inc("infer.prefill_chunk_dispatches")
+        job.first = int(first)
+        job.more = bool(more)
+        job.done = True
+        self._active_np[slot] = job.more
+        counter_inc("infer.prefill_dispatches")
+        counter_inc("infer.tokens")
+        if self.prefix_cache is not None:
+            self._store_prefix_chunks(job)
+        return True
+
+    def _store_prefix_chunks(self, job: _PrefillJob) -> None:
+        """After a completed prefill, extract and cache every chunk-aligned
+        prefix segment of the prompt that isn't cached yet (the slot's rows
+        below n are final: decode writes only at positions >= n)."""
+        from ..observability.metrics import counter_inc, gauge_set
+
+        cache = self.prefix_cache
+        for i in range(job.n // self._chunk):
+            key = cache.key(job.prompt, i)
+            if cache.has(key):
+                continue
+            seg_k, seg_v = self._dispatch(
+                "prefix_extract", self._extract_jit,
+                (self._ck, self._cv, jnp.int32(job.slot), jnp.int32(i * self._chunk)))
+            counter_inc("infer.prefix_extract_dispatches")
+            cache.put(key, seg_k, seg_v)
+        gauge_set("serving.prefix_cache_bytes", cache.bytes_used())
+
+    def prefill(self, prompt, slot: int, max_new_tokens: int, eos_token_id: Optional[int] = None,
+                seed: int = 0) -> Tuple[int, bool]:
+        """Admit one prompt into ``slot`` synchronously (every chunk back to
+        back): prefix-cache inserts, prefill dispatches, first-token sample.
+        Returns ``(first_token, more)`` — ``more`` False means the request
+        finished at its first token (eos or max_new_tokens == 1)."""
+        job = self.begin_prefill(prompt, slot, max_new_tokens,
+                                 eos_token_id=eos_token_id, seed=seed)
+        while not self.prefill_step(job):
+            pass
+        return job.first, job.more
+
+    # ------------------------------------------------------------- decode
+    def decode_step(self, fuse: Optional[int] = None):
+        """Advance every active slot in ONE dispatch. At fuse depth 1
+        returns ``(tokens[B], emitted[B], active[B])``; at depth D > 1 the
+        dispatch runs D decode iterations inside one donated ``lax.scan``
+        and returns ``(tokens[D, B], emitted[D, B], active[B])`` — the
+        eos/limit stop flags ride the scan carry, so a slot that finishes at
+        iteration j self-deactivates in-graph (``emitted[j+1:, slot]`` is
+        False) with no host round-trip until the stack is drained."""
+        from ..observability import span as _span
+        from ..observability.metrics import observe
+        from ..profiler import counter_inc
+
+        depth = self.fuse if fuse is None else int(fuse)
+        if depth < 1:
+            raise ValueError(f"fuse depth must be >= 1, got {depth}")
+        if depth == 1:
+            emitted = self._active_np.copy()
+            with _span("infer.decode_step"):
+                out = self._dispatch(
+                    "decode", self._decode_jit,
+                    (self._params, self._ck, self._cv, self._pos, self._tok, self._active,
+                     jnp.asarray(self._eos), jnp.asarray(self._limit), jnp.asarray(self._seed)))
+            self._ck, self._cv, self._pos, self._tok, self._active = out
+            toks = np.asarray(self._tok)
+            self._active_np = np.array(self._active)  # writable host mirror
+            counter_inc("infer.decode_dispatches")
+            counter_inc("infer.tokens", int(emitted.sum()))
+            observe("infer.tokens_per_decode_dispatch", float(emitted.sum()))
+            return toks, emitted, self._active_np.copy()
+        consts = (self._params, jnp.asarray(self._eos), jnp.asarray(self._limit),
+                  jnp.asarray(self._seed))
+        carry = (self._ck, self._cv, self._pos, self._tok, self._active)
         with _span("infer.decode_step"):
-            out = self._dispatch(
-                "decode", self._decode_jit,
-                (self._params, self._ck, self._cv, self._pos, self._tok, self._active,
-                 jnp.asarray(self._eos), jnp.asarray(self._limit), jnp.asarray(self._seed)))
-        self._ck, self._cv, self._pos, self._tok, self._active = out
-        toks = np.asarray(self._tok)
-        self._active_np = np.array(self._active)  # writable host mirror
+            out = self._dispatch(f"decode_x{depth}", self._fused(depth), (consts, carry))
+        (self._ck, self._cv, self._pos, self._tok, self._active), (toks, emitted) = out
+        toks = np.asarray(toks)
+        emitted = np.asarray(emitted)
+        self._active_np = np.array(self._active)
         counter_inc("infer.decode_dispatches")
         counter_inc("infer.tokens", int(emitted.sum()))
+        observe("infer.tokens_per_decode_dispatch", float(emitted.sum()))
         return toks, emitted, self._active_np.copy()
 
     def free_slot(self, slot: int) -> None:
@@ -328,12 +633,13 @@ class DecodeEngine:
 
     # ------------------------------------------------------------- helpers
     def generate(self, ids, max_new_tokens: int = 32, eos_token_id: Optional[int] = None,
-                 seed: int = 0) -> np.ndarray:
+                 seed: int = 0, fuse: Optional[int] = None) -> np.ndarray:
         """Batch generate through the slot machinery (parity helper + the
         bench decode path): each row takes one slot, prefill once per row,
-        then decode steps until every row finishes. Returns
-        ``[b, s0 + max_new_tokens]`` int32 (rows that hit eos pad with it) —
-        same contract as ``GPTForPretraining.generate``."""
+        then decode steps (at ``fuse`` depth — default the engine's) until
+        every row finishes. Returns ``[b, s0 + max_new_tokens]`` int32 (rows
+        that hit eos pad with it) — same contract as
+        ``GPTForPretraining.generate``."""
         ids = np.asarray(ids, np.int32)
         if ids.ndim == 1:
             ids = ids[None]
@@ -347,10 +653,13 @@ class DecodeEngine:
                                       eos_token_id=eos_token_id, seed=seed)
             rows[i].append(tok)
         while self._active_np.any():
-            toks, emitted, _ = self.decode_step()
-            for i in range(b):
-                if emitted[i]:
-                    rows[i].append(int(toks[i]))
+            toks, emitted, _ = self.decode_step(fuse=fuse)
+            toks = np.atleast_2d(toks)
+            emitted = np.atleast_2d(emitted)
+            for d in range(toks.shape[0]):
+                for i in range(b):
+                    if emitted[d, i]:
+                        rows[i].append(int(toks[d, i]))
         for i in range(b):
             self.free_slot(i)
         out = np.zeros((b, s0 + int(max_new_tokens)), np.int32)
@@ -362,9 +671,9 @@ class DecodeEngine:
         return out
 
     def explain(self) -> List[dict]:
-        """Per-specialization cost rows (prefill buckets + the decode step)
-        captured at AOT compile — render with
-        ``observability.format_cost_table``."""
+        """Per-specialization cost rows (prefill buckets/chunks, prefix
+        insert/extract, and the decode programs) captured at AOT compile —
+        render with ``observability.format_cost_table``."""
         return list(self._specializations)
 
     def cache_bytes(self) -> int:

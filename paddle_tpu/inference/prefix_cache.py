@@ -1,0 +1,113 @@
+"""Host-side prompt-prefix KV cache: radix-style chunk reuse with LRU
+eviction under a byte budget.
+
+Many serving streams share prompt prefixes (system prompts, few-shot
+headers). The cache stores the KV segments of CHUNK-aligned prompt
+prefixes — one entry per ``[L, 1, H, C, dh]`` chunk, keyed on the token ids
+of the WHOLE prefix up to that chunk's end (KV at a position depends on
+every earlier token, so the chain key is exact; byte-keys mean no hash
+collisions). A new request walks its longest cached chain and the engine
+copies each matched chunk into its slot with one compiled
+``dynamic_update_slice`` program — no prefill compute, no prefill compile,
+no dispatch of the trunk for the shared portion (the vLLM/SGLang
+prefix-caching discipline on the static-cache engine).
+
+Entries are device arrays; eviction is LRU over whole chunks so the budget
+(``prefix_cache_mb``) bounds device memory exactly. A chunk is only ever
+stored once per distinct prefix chain; re-matching refreshes recency.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["PrefixCache"]
+
+
+class PrefixCache:
+    """LRU cache of chunk-aligned prompt-prefix KV segments.
+
+    ``chunk`` is the token granularity (the engine's ``prefill_chunk``);
+    ``budget_bytes`` caps the summed device bytes of the stored segments;
+    ``entry_bytes`` is the (fixed) size of one chunk's K+V segment.
+    """
+
+    def __init__(self, chunk: int, budget_bytes: int, entry_bytes: int):
+        if chunk < 1:
+            raise ValueError(f"chunk must be >= 1, got {chunk}")
+        self.chunk = int(chunk)
+        self.budget_bytes = int(budget_bytes)
+        self.entry_bytes = int(entry_bytes)
+        self._entries: "OrderedDict[bytes, Tuple]" = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    # ------------------------------------------------------------------ keys
+    def key(self, prompt: np.ndarray, i: int) -> bytes:
+        """Chain key of chunk ``i``: the token ids of the whole prefix up to
+        and including that chunk (positions [0, (i+1)*chunk))."""
+        return np.ascontiguousarray(prompt[: (i + 1) * self.chunk], np.int32).tobytes()
+
+    # ----------------------------------------------------------------- match
+    def match(self, prompt: np.ndarray, max_tokens: int) -> List[Tuple]:
+        """Longest chain of cached chunks covering at most ``max_tokens``
+        prompt tokens (callers cap at n-1 so the last prompt token always
+        runs through the model — logits are not cached). Returns the chunk
+        entries ``[(seg_k, seg_v), ...]`` in position order and refreshes
+        their LRU recency."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        k = 0
+        while (k + 1) * self.chunk <= max_tokens and self.key(prompt, k) in self._entries:
+            k += 1
+        out = []
+        for i in range(k):
+            key = self.key(prompt, i)
+            self._entries.move_to_end(key)
+            out.append(self._entries[key])
+        if k:
+            self.hits += 1
+        else:
+            self.misses += 1
+        return out
+
+    def has(self, key: bytes) -> bool:
+        return key in self._entries
+
+    # ------------------------------------------------------------------- put
+    def put(self, key: bytes, seg_k, seg_v) -> bool:
+        """Insert one chunk segment under its chain key; evicts LRU entries
+        until the byte budget holds. A segment that alone exceeds the budget
+        is not stored (the cache never over-commits device memory)."""
+        if self.entry_bytes > self.budget_bytes:
+            return False
+        if key in self._entries:
+            self._entries.move_to_end(key)
+            return True
+        self._entries[key] = (seg_k, seg_v)
+        while self.bytes_used() > self.budget_bytes:
+            self._entries.popitem(last=False)
+            self.evictions += 1
+        return key in self._entries
+
+    # ------------------------------------------------------------- accounting
+    def bytes_used(self) -> int:
+        return len(self._entries) * self.entry_bytes
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def clear(self) -> None:
+        self._entries.clear()
+
+    def stats(self) -> dict:
+        return {
+            "entries": len(self._entries),
+            "bytes_used": self.bytes_used(),
+            "budget_bytes": self.budget_bytes,
+            "hits": self.hits,
+            "misses": self.misses,
+            "evictions": self.evictions,
+        }

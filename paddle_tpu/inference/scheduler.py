@@ -1,18 +1,34 @@
 """Continuous (in-flight) batching scheduler over a :class:`DecodeEngine`.
 
 Requests arrive at any time and are admitted into free batch slots
-mid-stream: a new request's bucketed prefill runs while other slots keep
-decoding, and every decode dispatch advances ALL occupied slots one token
-(per-slot position indices, slot-masked sampling). No request waits for a
-batch to drain — the vLLM/Orca serving discipline on top of the two
-compiled programs.
+mid-stream: a new request's prefill runs while other slots keep decoding,
+and every decode dispatch advances ALL occupied slots (per-slot position
+indices, slot-masked sampling). No request waits for a batch to drain —
+the vLLM/Orca serving discipline on top of a fixed compiled-program family.
+
+Round 2 of the serving hot path rides the engine's three throughput knobs:
+
+- **chunked prefill** (``prefill_chunk``): an admission is a sequence of
+  fixed-size chunk dispatches driven one per tick, INTERLEAVED with decode
+  — a 2k-token prompt no longer stalls every in-flight request for its
+  whole prefill. The time prefill dispatches spend while other slots hold
+  active decodes is the *stall*: tracked per request, observed in the
+  ``serving.prefill_stall_seconds`` histogram, and reported as p50/p99 by
+  ``observability report``.
+- **fused decode** (``fuse=D``): one decode dispatch returns a ``[D, B]``
+  token stack; the scheduler drains it in order, appending only tokens
+  whose slot really emitted (finished slots self-deactivate in-graph).
+- **prefix reuse** (``prefix_cache_mb``): admissions that hit the
+  prompt-prefix KV cache skip the matched chunks entirely —
+  ``admitted`` events carry ``prefix_tokens`` for hit-rate reporting.
 
 Telemetry rides the PR-4 spine: every request emits ``request`` run-log
 events (``submitted`` → ``admitted`` → ``finished``) with queue/prefill/
-decode timings, the ``serving.*`` counters/gauges/histograms feed the
-metrics registry, and ``python -m paddle_tpu.observability report`` renders
-a serving section (request rate, queue depth, prefill/decode split,
-p50/p99 latency) from the event stream.
+decode/stall timings, the ``serving.*`` counters/gauges/histograms feed
+the metrics registry, and ``python -m paddle_tpu.observability report``
+renders a serving section (request rate, queue depth, latency/TTFT
+percentiles, prefix-hit rate, fused depth, stall percentiles) from the
+event stream.
 """
 from __future__ import annotations
 
@@ -38,6 +54,9 @@ class Request:
         self.tokens: List[int] = []
         self.slot: Optional[int] = None
         self.bucket: Optional[int] = None
+        self.prefix_tokens = 0        # prompt rows supplied by the prefix cache
+        self.prefill_chunks = 0       # model dispatches its prefill took
+        self.stall_seconds = 0.0      # prefill time spent while decode waited
         self.submitted_ts = time.perf_counter()
         self.admitted_ts: Optional[float] = None
         self.first_token_ts: Optional[float] = None
@@ -75,14 +94,17 @@ class Request:
 
 class ContinuousBatchingScheduler:
     """Admit-into-free-slots scheduler: FIFO queue in front of the engine's
-    batch slots. Drive it with :meth:`step` (one admission sweep + one
-    decode dispatch) or :meth:`run` (until drained)."""
+    batch slots. Drive it with :meth:`step` (one admission sweep + at most
+    one prefill dispatch per in-flight admission + one decode dispatch) or
+    :meth:`run` (until drained)."""
 
     def __init__(self, engine):
         self.engine = engine
         self.queue: deque = deque()
-        self.running: Dict[int, Request] = {}  # slot -> request
-        self.finished: Dict[int, Request] = {}  # rid -> request
+        self.prefilling: Dict[int, Request] = {}  # slot -> mid-prefill request
+        self._jobs: Dict[int, object] = {}        # slot -> engine _PrefillJob
+        self.running: Dict[int, Request] = {}     # slot -> decoding request
+        self.finished: Dict[int, Request] = {}    # rid -> request
         self._next_rid = 0
 
     # ----------------------------------------------------------- lifecycle
@@ -99,7 +121,7 @@ class ContinuousBatchingScheduler:
         if n + int(max_new_tokens) > self.engine.max_seq_len:
             raise ValueError(f"prompt {n} + max_new_tokens {max_new_tokens} exceeds "
                              f"engine max_seq_len {self.engine.max_seq_len}")
-        self.engine.bucket_for(n)  # raises if no bucket fits
+        self.engine.bucket_for(n)  # raises if no bucket/chunk tiling fits
         r = Request(self._next_rid, prompt, max_new_tokens, eos_token_id, seed)
         self._next_rid += 1
         self.queue.append(r)
@@ -111,8 +133,10 @@ class ContinuousBatchingScheduler:
         return r.rid
 
     def _admit(self) -> None:
-        from ..observability import runlog as _runlog
-        from ..observability.metrics import counter_inc, gauge_set, observe
+        """Claim free slots for queued requests (prefix-cache inserts happen
+        here — cheap copy dispatches, no model compute). The model prefill
+        dispatches are driven chunk-at-a-time by :meth:`_prefill_tick`."""
+        from ..observability.metrics import gauge_set
 
         free = self.engine.free_slots()
         while self.queue and free:
@@ -121,20 +145,49 @@ class ContinuousBatchingScheduler:
             r.slot = slot
             r.bucket = self.engine.bucket_for(len(r.prompt))
             r.admitted_ts = time.perf_counter()
-            tok, more = self.engine.prefill(
+            job = self.engine.begin_prefill(
                 r.prompt, slot, max_new_tokens=r.max_new_tokens,
                 eos_token_id=r.eos_token_id, seed=r.seed)
+            r.prefix_tokens = job.reused_tokens
+            self.prefilling[slot] = r
+            self._jobs[slot] = job
+            gauge_set("serving.queue_depth", len(self.queue))
+
+    def _prefill_tick(self) -> None:
+        """ONE prefill dispatch per mid-prefill admission: in chunked mode a
+        C-token chunk, in bucketed mode the whole padded prompt. Decode runs
+        between ticks, so a long admission interleaves instead of stalling
+        the stream; prefill time spent while decodes were waiting counts as
+        stall."""
+        from ..observability import runlog as _runlog
+        from ..observability.metrics import counter_inc, gauge_set, observe
+
+        for slot in list(self.prefilling):
+            r = self.prefilling[slot]
+            job = self._jobs[slot]
+            decode_waiting = bool(self.running)
+            t0 = time.perf_counter()
+            done = self.engine.prefill_step(job)
+            dt = time.perf_counter() - t0
+            r.prefill_chunks += 1
+            if decode_waiting:
+                r.stall_seconds += dt
+                observe("serving.prefill_stall_seconds", dt)
+            if not done:
+                continue
             r.first_token_ts = time.perf_counter()
-            r.tokens.append(tok)
+            r.tokens.append(job.first)
+            del self.prefilling[slot], self._jobs[slot]
             counter_inc("serving.requests_admitted")
             observe("serving.ttft_seconds", r.ttft_seconds)
             observe("serving.queue_seconds", r.queue_seconds)
-            gauge_set("serving.queue_depth", len(self.queue))
             gauge_set("serving.active_slots", len(self.running) + 1)
             _runlog.emit("request", id=r.rid, status="admitted", component="serving",
                          slot=slot, bucket=r.bucket, queue_depth=len(self.queue),
-                         queue_seconds=r.queue_seconds, seconds=r.prefill_seconds)
-            if more:
+                         queue_seconds=r.queue_seconds, seconds=r.prefill_seconds,
+                         prefix_tokens=r.prefix_tokens, chunks=r.prefill_chunks,
+                         stall_seconds=r.stall_seconds)
+            if job.more:
                 self.running[slot] = r
             else:
                 self._finish(r)
@@ -155,19 +208,27 @@ class ContinuousBatchingScheduler:
                      prompt_tokens=len(r.prompt), new_tokens=len(r.tokens),
                      queue_seconds=r.queue_seconds, prefill_seconds=r.prefill_seconds,
                      decode_seconds=r.decode_seconds, total_seconds=r.total_seconds,
-                     ttft_seconds=r.ttft_seconds)
+                     ttft_seconds=r.ttft_seconds, fuse=self.engine.fuse,
+                     prefix_tokens=r.prefix_tokens, stall_seconds=r.stall_seconds)
 
     def step(self) -> List[Request]:
-        """One scheduler tick: admit queued requests into free slots
-        (bucketed prefill each), then advance every occupied slot one token
-        in a single decode dispatch. Returns requests finished this tick."""
+        """One scheduler tick: admit queued requests into free slots, run
+        one prefill dispatch per mid-prefill admission, then advance every
+        decoding slot in a single decode dispatch (a ``[D, B]`` token stack
+        at fuse depth D, drained in order). Returns requests finished this
+        tick."""
         before = set(self.finished)
         self._admit()
+        self._prefill_tick()
         if self.running:
             toks, emitted, active = self.engine.decode_step()
+            toks = np.atleast_2d(toks)
+            emitted = np.atleast_2d(emitted)
+            for d in range(toks.shape[0]):
+                for slot, r in self.running.items():
+                    if emitted[d, slot]:
+                        r.tokens.append(int(toks[d, slot]))
             for slot, r in list(self.running.items()):
-                if emitted[slot]:
-                    r.tokens.append(int(toks[slot]))
                 if not active[slot]:
                     self._finish(r)
         return [self.finished[rid] for rid in self.finished if rid not in before]
@@ -176,7 +237,7 @@ class ContinuousBatchingScheduler:
         """Drive :meth:`step` until queue and slots drain (or ``max_steps``
         ticks); returns ``{rid: Request}`` for everything finished."""
         steps = 0
-        while self.queue or self.running:
+        while self.queue or self.prefilling or self.running:
             self.step()
             steps += 1
             if max_steps is not None and steps >= max_steps:

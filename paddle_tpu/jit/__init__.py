@@ -48,6 +48,29 @@ def _pure_model_call(model, arrays, args, kwargs, training, rng):
     return unwrap_tree(out), new_buffers
 
 
+def scan_steps(step, *, length=None, with_consts=False, donate_argnums=0, **jit_kwargs):
+    """ONE-dispatch multi-step runner: jit(lax.scan(step)) with donated carry.
+
+    The PR-3 ``TrainStep.run_steps`` idiom as a shared helper: ``step`` is a
+    scan body ``(carry, x) -> (carry, y)`` and the returned jitted function
+    ``run(carry, xs=None)`` chains every iteration inside ONE compiled
+    program — one host dispatch (and one host sync, when the caller reads)
+    per K steps instead of per step. With ``with_consts=True`` the body is
+    ``(consts, carry, x) -> (carry, y)`` and ``run(consts, carry, xs=None)``
+    threads ``consts`` (e.g. model params) through untouched — keep them out
+    of the carry so donation never consumes them. ``length`` pins the trip
+    count when ``xs`` is None (the serving engine's fused decode);
+    ``jit_kwargs`` pass through to ``jax.jit`` (shardings etc.).
+    """
+    if with_consts:
+        def run(consts, carry, xs=None):
+            return jax.lax.scan(functools.partial(step, consts), carry, xs, length=length)
+    else:
+        def run(carry, xs=None):
+            return jax.lax.scan(step, carry, xs, length=length)
+    return jax.jit(run, donate_argnums=donate_argnums, **jit_kwargs)
+
+
 class TrainStep:
     """One compiled training step: forward + backward + optimizer update.
 
@@ -150,10 +173,10 @@ class TrainStep:
     def _make_jits(self):
         if self.mesh is not None and self._state_shardings is not None:
             self._jit = jax.jit(self._step, donate_argnums=0, in_shardings=(self._state_shardings, self._batch_shardings), out_shardings=(self._state_shardings, None))
-            self._jit_multi = jax.jit(self._multi_step, donate_argnums=0, in_shardings=(self._state_shardings, None), out_shardings=(self._state_shardings, None))
+            self._jit_multi = scan_steps(self._step, donate_argnums=0, in_shardings=(self._state_shardings, None), out_shardings=(self._state_shardings, None))
         else:
             self._jit = jax.jit(self._step, donate_argnums=0)
-            self._jit_multi = jax.jit(self._multi_step, donate_argnums=0)
+            self._jit_multi = scan_steps(self._step, donate_argnums=0)
 
     def rebuild(self):
         """Re-trace and re-jit the step programs against the CURRENT
@@ -304,16 +327,12 @@ class TrainStep:
                 metrics["outputs"] = out
             return new_state, metrics
 
+        # K steps in one XLA dispatch: _step is the scan body for the shared
+        # scan_steps() runner built in _make_jits — the compiled program
+        # chains K forward+backward+update iterations on-device, the
+        # InterpreterCore's per-op scheduling amortized to one host
+        # round-trip per K steps
         self._step = _step
-
-        def _multi_step(state, stacked):
-            # K steps in one XLA dispatch: the per-step fn is the scan body,
-            # so the compiled program chains K forward+backward+update
-            # iterations on-device — the InterpreterCore's per-op scheduling
-            # amortized to one host round-trip per K steps
-            return jax.lax.scan(_step, state, stacked)
-
-        self._multi_step = _multi_step
 
     @staticmethod
     def _as_arrays(x):

@@ -506,7 +506,7 @@ def _cache_forward(stacked, wte, wpe, fnw, fnb, ids, cache_k, cache_v, start_pos
     return logits, jnp.stack(new_k), jnp.stack(new_v)
 
 
-def _slot_cache_block(lp, h, ck, cv, pos, *, num_heads, epsilon=1e-5):
+def _slot_cache_block(lp, h, ck, cv, pos, *, num_heads, epsilon=1e-5, active=None):
     """One decoder block over PER-SLOT cache positions (continuous-batching
     decode). ``h`` [b, 1, d] holds one token per batch slot; ``ck``/``cv``
     [b, H, S, dh]; ``pos`` [b] int32 is each slot's write index. K/V are
@@ -514,7 +514,10 @@ def _slot_cache_block(lp, h, ck, cv, pos, *, num_heads, epsilon=1e-5):
     BEFORE attend, so a stale cache entry is always overwritten before it
     can become visible) and attention masks keys beyond each slot's own
     position — slots at different sequence depths share one compiled
-    program. Same math as :func:`_cache_block` at s=1.
+    program. ``active`` [b] bool gates the write per slot: an inactive
+    slot's cache stays bitwise untouched, so decode dispatches interleaved
+    with another slot's chunked prefill cannot clobber its freshly written
+    K/V at a stale ``pos``. Same math as :func:`_cache_block` at s=1.
     """
     (n1w, n1b, qkvw, qkvb, ow, ob, n2w, n2b, f1w, f1b, f2w, f2b), _ = lp
 
@@ -531,9 +534,17 @@ def _slot_cache_block(lp, h, ck, cv, pos, *, num_heads, epsilon=1e-5):
     q = jnp.swapaxes(qkv[:, :, 0], 1, 2)  # [b, H, 1, dh]
     k = jnp.swapaxes(qkv[:, :, 1], 1, 2)
     v = jnp.swapaxes(qkv[:, :, 2], 1, 2)
-    upd = jax.vmap(lambda c, u, p: jax.lax.dynamic_update_slice(c, u, (0, p, 0)))
-    ck = upd(ck, k, pos)
-    cv = upd(cv, v, pos)
+    if active is None:
+        upd = jax.vmap(lambda c, u, p: jax.lax.dynamic_update_slice(c, u, (0, p, 0)))
+        ck = upd(ck, k, pos)
+        cv = upd(cv, v, pos)
+    else:
+        def upd(c, u, p, a):
+            cur = jax.lax.dynamic_slice(c, (0, p, 0), u.shape)
+            return jax.lax.dynamic_update_slice(c, jnp.where(a, u, cur), (0, p, 0))
+
+        ck = jax.vmap(upd)(ck, k, pos, active)
+        cv = jax.vmap(upd)(cv, v, pos, active)
     scale = jnp.asarray(1.0 / (hd ** 0.5), q.dtype)
     scores = jnp.einsum("bhqd,bhkd->bhqk", q * scale, ck,
                         preferred_element_type=jnp.float32)
@@ -550,10 +561,11 @@ def _slot_cache_block(lp, h, ck, cv, pos, *, num_heads, epsilon=1e-5):
     return h, ck, cv
 
 
-def _slot_decode_forward(stacked, wte, wpe, fnw, fnb, tok, cache_k, cache_v, pos, *, num_heads):
+def _slot_decode_forward(stacked, wte, wpe, fnw, fnb, tok, cache_k, cache_v, pos, *, num_heads, active=None):
     """One-token trunk forward with per-slot positions: the decode-step
     program of the serving engine. ``tok`` [b] int32 (last token per slot),
-    ``cache_k``/``cache_v`` [L, b, H, S, dh], ``pos`` [b] int32. Returns
+    ``cache_k``/``cache_v`` [L, b, H, S, dh], ``pos`` [b] int32, ``active``
+    [b] bool (optional) gates cache writes per slot. Returns
     (logits [b, V], cache_k, cache_v) — exactly one compiled program serves
     every step of every request regardless of each slot's depth.
     """
@@ -564,7 +576,7 @@ def _slot_decode_forward(stacked, wte, wpe, fnw, fnb, tok, cache_k, cache_v, pos
     new_k, new_v = [], []
     for i in range(num_layers):
         lp = (tuple(p[i] for p in params), idx[i])
-        h, ck, cv = _slot_cache_block(lp, h, cache_k[i], cache_v[i], pos, num_heads=num_heads)
+        h, ck, cv = _slot_cache_block(lp, h, cache_k[i], cache_v[i], pos, num_heads=num_heads, active=active)
         new_k.append(ck)
         new_v.append(cv)
     mean = jnp.mean(h, axis=-1, keepdims=True)
@@ -572,6 +584,89 @@ def _slot_decode_forward(stacked, wte, wpe, fnw, fnb, tok, cache_k, cache_v, pos
     h = (h - mean) / jnp.sqrt(var + 1e-5) * fnw + fnb
     logits = jnp.einsum("bsd,vd->bsv", h, wte)[:, 0]
     return logits, jnp.stack(new_k), jnp.stack(new_v)
+
+
+def _chunk_prefill_block(lp, h, ck, cv, slot, start, *, num_heads, epsilon=1e-5):
+    """One decoder block over a CHUNK of one slot's prompt (chunked prefill).
+
+    ``h`` [1, C, d] holds C consecutive prompt tokens for batch slot
+    ``slot``; ``ck``/``cv`` [B, H, S, dh] are one layer of the engine's big
+    cache. K/V for the chunk are written in place at ``(slot, start)`` and
+    attention reads the slot's WHOLE cache row, masked to each row's own
+    prefix — so the chunk attends to everything earlier chunks (or a
+    prefix-cache insert) already wrote. One compiled program serves every
+    chunk of every prompt at every depth; same per-row math as
+    :func:`_cache_block`, so tokens stay bitwise equal to the bucketed
+    prefill path (masked lanes contribute exact zeros).
+    """
+    (n1w, n1b, qkvw, qkvb, ow, ob, n2w, n2b, f1w, f1b, f2w, f2b), _ = lp
+
+    def ln(v, w, bb):
+        mean = jnp.mean(v, axis=-1, keepdims=True)
+        var = jnp.var(v, axis=-1, keepdims=True)
+        return (v - mean) / jnp.sqrt(var + epsilon) * w + bb
+
+    _, s, d = h.shape
+    H = ck.shape[1]
+    S = ck.shape[2]
+    hd = d // num_heads
+    x1 = ln(h, n1w, n1b)
+    qkv = (x1 @ qkvw + qkvb).reshape(1, s, 3, num_heads, hd)
+    q = jnp.swapaxes(qkv[:, :, 0], 1, 2)  # [1, H, C, dh]
+    k = jnp.swapaxes(qkv[:, :, 1], 1, 2)
+    v = jnp.swapaxes(qkv[:, :, 2], 1, 2)
+    ck = jax.lax.dynamic_update_slice(ck, k, (slot, 0, start, 0))
+    cv = jax.lax.dynamic_update_slice(cv, v, (slot, 0, start, 0))
+    rk = jax.lax.dynamic_slice(ck, (slot, 0, 0, 0), (1, H, S, hd))
+    rv = jax.lax.dynamic_slice(cv, (slot, 0, 0, 0), (1, H, S, hd))
+    scale = jnp.asarray(1.0 / (hd ** 0.5), q.dtype)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q * scale, rk,
+                        preferred_element_type=jnp.float32)
+    q_pos = start + jax.lax.broadcasted_iota(jnp.int32, (s, S), 0)
+    k_pos = jax.lax.broadcasted_iota(jnp.int32, (s, S), 1)
+    scores = jnp.where((k_pos <= q_pos)[None, None], scores, -jnp.inf)
+    p = jax.nn.softmax(scores, axis=-1).astype(rv.dtype)
+    att = jnp.einsum("bhqk,bhkd->bhqd", p, rv, preferred_element_type=jnp.float32)
+    att = jnp.swapaxes(att.astype(h.dtype), 1, 2).reshape(1, s, d)
+    h = h + att @ ow + ob
+    x2 = ln(h, n2w, n2b)
+    y = jax.nn.gelu(x2 @ f1w + f1b, approximate=True)
+    h = h + y @ f2w + f2b
+    return h, ck, cv
+
+
+def _chunk_prefill_forward(stacked, wte, wpe, fnw, fnb, ids, cache_k, cache_v,
+                           slot, start, *, num_heads, last_row=None):
+    """Trunk forward over one prompt chunk of one slot, directly against the
+    engine's big [L, B, H, S, dh] cache. ``ids`` [1, C] (C fixed — long
+    prompts run as a sequence of these dispatches, interleaved with decode);
+    ``start`` is the chunk's first absolute position. With ``last_row`` a
+    traced row index, also returns the final-norm logits of that row (the
+    sampling row of the prompt's last chunk); intermediate chunks skip the
+    logits work entirely. Returns (logits|None, cache_k, cache_v).
+    """
+    params, idx = stacked
+    num_layers = params[0].shape[0]
+    s = ids.shape[1]
+    pos = start + jnp.arange(s, dtype=jnp.int32)
+    h = jnp.take(wte, ids, axis=0) + jnp.take(wpe, pos, axis=0)[None]
+    h = h.astype(wte.dtype)
+    new_k, new_v = [], []
+    for i in range(num_layers):
+        lp = (tuple(p[i] for p in params), idx[i])
+        h, ck, cv = _chunk_prefill_block(lp, h, cache_k[i], cache_v[i], slot, start, num_heads=num_heads)
+        new_k.append(ck)
+        new_v.append(cv)
+    cache_k = jnp.stack(new_k)
+    cache_v = jnp.stack(new_v)
+    if last_row is None:
+        return None, cache_k, cache_v
+    hl = jax.lax.dynamic_slice(h, (0, last_row, 0), (1, 1, h.shape[2]))
+    mean = jnp.mean(hl, axis=-1, keepdims=True)
+    var = jnp.var(hl, axis=-1, keepdims=True)
+    hl = (hl - mean) / jnp.sqrt(var + 1e-5) * fnw + fnb
+    logits = jnp.einsum("bsd,vd->bsv", hl, wte)[:, 0]  # [1, V]
+    return logits, cache_k, cache_v
 
 
 def _select_token(logits, key, do_sample, temperature, top_k, top_p):

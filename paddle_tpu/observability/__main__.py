@@ -10,7 +10,8 @@ Prints, from one structured run log (see :mod:`.runlog`):
   final loss scale) when the run produced any ``bad_step``/``loss_spike``/
   ``rollback``/``loss_scale`` events,
 - a serving section (request rate, queue depth, prefill/decode time split,
-  latency p50/p99 and time-to-first-token) when the run produced
+  latency p50/p99 and time-to-first-token, prefix-cache hit rate, fused
+  decode depth, chunked-prefill stall percentiles) when the run produced
   ``request`` events (the continuous-batching scheduler's stream).
 
 ``--json`` emits the same analysis as one JSON object for tooling.
@@ -150,6 +151,31 @@ def _analyze_serving(reqs: List[dict]) -> dict:
                       if isinstance(ev.get(field), (int, float)))
             split[field.replace("_seconds", "")] = tot
         out["phase_split_seconds"] = split
+    # serving hot-path round 2: prefix reuse / fused depth / prefill stall
+    admitted = by_status.get("admitted", [])
+    prefixed = [ev for ev in admitted if isinstance(ev.get("prefix_tokens"), int)]
+    if prefixed:
+        hits = sum(1 for ev in prefixed if ev["prefix_tokens"] > 0)
+        reused = sum(ev["prefix_tokens"] for ev in prefixed)
+        prompted = sum(int(ev.get("prompt_tokens", 0)) for ev in finished) or None
+        out["prefix_cache"] = {
+            "hit_rate": hits / len(prefixed),
+            "tokens_reused": reused,
+            "token_reuse_rate": (reused / prompted) if prompted else None,
+        }
+    depths = sorted({int(ev["fuse"]) for ev in finished
+                     if isinstance(ev.get("fuse"), int)})
+    if depths:
+        out["fuse_depths"] = depths
+    stalls = sorted(ev["stall_seconds"] for ev in admitted
+                    if isinstance(ev.get("stall_seconds"), (int, float)))
+    if stalls:
+        out["prefill_stall"] = {
+            "p50_seconds": _percentile(stalls, 50),
+            "p99_seconds": _percentile(stalls, 99),
+            "max_seconds": stalls[-1],
+            "total_seconds": sum(stalls),
+        }
     return out
 
 
@@ -213,6 +239,20 @@ def print_report(path: str, a: dict) -> None:
             print(f"    phase split: {parts}")
         if sv.get("tokens_generated") is not None:
             print(f"    tokens generated: {sv['tokens_generated']}")
+        pc = sv.get("prefix_cache")
+        if pc:
+            rr = pc.get("token_reuse_rate")
+            print(f"    prefix cache: {pc['hit_rate'] * 100:.0f}% of admissions hit, "
+                  f"{pc['tokens_reused']} prompt tokens reused"
+                  + (f" ({rr * 100:.0f}% of prompt tokens)" if rr is not None else ""))
+        if sv.get("fuse_depths"):
+            print(f"    fused decode depth: "
+                  f"{'/'.join(str(d) for d in sv['fuse_depths'])} tokens/dispatch")
+        stall = sv.get("prefill_stall")
+        if stall:
+            print(f"    prefill stall: p50 {stall['p50_seconds'] * 1e3:.2f} ms   "
+                  f"p99 {stall['p99_seconds'] * 1e3:.2f} ms   "
+                  f"total {stall['total_seconds']:.4f}s")
 
 
 def main(argv=None) -> int:

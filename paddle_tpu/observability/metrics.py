@@ -151,8 +151,13 @@ _DECLARED_COUNTERS: set = set()
 SERVING_COUNTERS: Tuple[str, ...] = (
     "infer.compiles", "infer.runs",
     "infer.prefill_dispatches", "infer.decode_dispatches", "infer.tokens",
+    "infer.prefill_chunk_dispatches",
+    "infer.prefix_insert_dispatches", "infer.prefix_extract_dispatches",
+    "infer.aot_cache_hits", "infer.aot_cache_stores",
     "serving.requests_submitted", "serving.requests_admitted",
     "serving.requests_completed", "serving.tokens_generated",
+    "serving.prefix_hits", "serving.prefix_misses",
+    "serving.prefix_tokens_reused",
 )
 
 

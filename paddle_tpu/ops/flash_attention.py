@@ -23,6 +23,17 @@ import jax.numpy as jnp
 _BLOCK_Q = 512
 _BLOCK_K = 512
 _MAX_SEQ_VMEM = 4096  # whole-K/V-in-VMEM streaming bound
+_INTERPRET = False  # run pallas_calls in interpreter mode (CPU parity tests)
+
+
+def set_interpret(on: bool) -> bool:
+    """Route every ``pl.pallas_call`` here through the Pallas interpreter —
+    the CPU path tier-1 uses to test the kernel math against
+    :func:`_reference_attention` without a TPU. Returns the prior setting."""
+    global _INTERPRET
+    prior = _INTERPRET
+    _INTERPRET = bool(on)
+    return prior
 
 
 def flash_attention_available(q_shape, k_shape=None) -> bool:
@@ -143,6 +154,7 @@ def _flash_fwd(q, k, v, causal):
             jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
             jax.ShapeDtypeStruct((b, h, s, 1), jnp.float32),
         ],
+        interpret=_INTERPRET,
     )(qt, kt, vt)
     return jnp.swapaxes(out, 1, 2), lse
 
@@ -249,6 +261,7 @@ def _flash_bwd(q, k, v, o, lse, do, causal):
         in_specs=row_specs,
         out_specs=pl.BlockSpec((None, None, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
+        interpret=_INTERPRET,
     )(qt, kt, vt, dot, lse, di)
 
     col_specs = [
@@ -271,6 +284,7 @@ def _flash_bwd(q, k, v, o, lse, do, causal):
             jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
             jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
         ],
+        interpret=_INTERPRET,
     )(qt, kt, vt, dot, lse, di)
 
     back = lambda x: jnp.swapaxes(x, 1, 2)

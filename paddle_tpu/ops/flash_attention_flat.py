@@ -54,6 +54,16 @@ def set_blocks(block_q=None, block_k_fwd=None, block_k_bwd=None):
         _BLOCK_K_BWD = int(block_k_bwd)
     return prior
 _MAX_SEQ = 2048
+_INTERPRET = False  # run pallas_calls in interpreter mode (CPU parity tests)
+
+
+def set_interpret(on: bool) -> bool:
+    """Route the flat-kernel ``pl.pallas_call``s through the Pallas
+    interpreter (CPU parity tests). Returns the prior setting."""
+    global _INTERPRET
+    prior = _INTERPRET
+    _INTERPRET = bool(on)
+    return prior
 # Mosaic compile time blows up with the fused-bwd dq accumulator block
 # (full-sequence [s, hg*d] f32, read-modify-write across k-steps): 1M elements
 # did not compile in 20 min on-chip (2026-07-30); 512K compiles in seconds.
@@ -275,6 +285,7 @@ def _fwd_call(operands, b, s, h, d, dtype, causal, packed):
             jax.ShapeDtypeStruct((b, s, h * d), dtype),
             jax.ShapeDtypeStruct((b, G, s, hg), jnp.float32),
         ],
+        interpret=_INTERPRET,
     )(*operands, *( [bias] if bias is not None else [] ))
     return out, lse
 
@@ -346,6 +357,7 @@ def _bwd_call(operands, b, s, h, d, dtype, o, lse, do, causal, packed):
             jax.ShapeDtypeStruct((b, s, h * d), dtype),
             jax.ShapeDtypeStruct((b, s, h * d), dtype),
         ],
+        interpret=_INTERPRET,
     )(*operands, *extra_ops)
     return dq.astype(dtype), dk, dv
 

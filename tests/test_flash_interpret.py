@@ -1,0 +1,120 @@
+"""CPU interpreter-mode parity for the Pallas flash-attention kernels.
+
+Runs the fwd and bwd ``pl.pallas_call``s of ops/flash_attention.py and
+ops/flash_attention_flat.py through the Pallas interpreter (no TPU) against
+``_reference_attention`` — values AND grads, causal and non-causal — so
+tier-1 covers the kernel math itself, not just the autotune block-cache
+(tests/test_autotune.py). Block sizes are shrunk below the sequence length
+so the online-softmax streaming loops and the causal tile logic actually
+execute (at block == s every kernel degenerates to one tile).
+"""
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from paddle_tpu.ops import flash_attention as fa  # noqa: E402
+from paddle_tpu.ops import flash_attention_flat as faf  # noqa: E402
+
+B, S, H, D = 2, 128, 2, 64
+BLOCK = 64  # < S: the fori_loop streaming paths run >1 iteration
+
+
+@pytest.fixture(autouse=True)
+def _interpret_small_blocks():
+    prior = fa.set_interpret(True), faf.set_interpret(True)
+    saved = (fa._BLOCK_Q, fa._BLOCK_K)
+    fa._BLOCK_Q = fa._BLOCK_K = BLOCK
+    saved_flat = faf.set_blocks(BLOCK, BLOCK, BLOCK)
+    yield
+    fa.set_interpret(prior[0])
+    faf.set_interpret(prior[1])
+    fa._BLOCK_Q, fa._BLOCK_K = saved
+    faf.set_blocks(*saved_flat)
+
+
+@pytest.fixture(scope="module")
+def qkvg():
+    rng = np.random.default_rng(0)
+    return tuple(jnp.asarray(rng.standard_normal((B, S, H, D)), jnp.float32)
+                 for _ in range(4))
+
+
+def _ref_grads(q, k, v, g, causal):
+    loss = lambda q, k, v: jnp.sum(fa._reference_attention(q, k, v, causal) * g)
+    return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_classic_fwd_matches_reference(qkvg, causal):
+    q, k, v, _ = qkvg
+    out, lse = fa._flash_fwd(q, k, v, causal)
+    ref = fa._reference_attention(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=5e-6, rtol=1e-5)
+    assert lse.shape == (B, H, S, 1) and lse.dtype == jnp.float32
+    assert np.isfinite(np.asarray(lse)).all()
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_classic_bwd_matches_reference(qkvg, causal):
+    q, k, v, g = qkvg
+    loss = lambda q, k, v: jnp.sum(fa._flash(q, k, v, causal) * g)
+    grads = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    for got, ref in zip(grads, _ref_grads(q, k, v, g, causal)):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   atol=2e-5, rtol=1e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flat_fwd_and_bwd_match_reference(qkvg, causal):
+    q, k, v, g = qkvg
+    out = faf.flash_flat(q, k, v, causal)
+    ref = fa._reference_attention(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=5e-6, rtol=1e-5)
+    loss = lambda q, k, v: jnp.sum(faf.flash_flat(q, k, v, causal) * g)
+    grads = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    for got, ref_g in zip(grads, _ref_grads(q, k, v, g, causal)):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref_g),
+                                   atol=2e-5, rtol=1e-4)
+
+
+def test_flat_packed_fwd_and_bwd_match_reference(qkvg):
+    # the packed [b, s, 3H] layout: the qkv-projection output consumed with
+    # column-block views, grads concatenated back into one tensor
+    q, k, v, g = qkvg
+    qkv = jnp.stack([q, k, v], axis=2)  # [b, s, 3, h, d]
+    out = faf.flash_packed(qkv, causal=True)
+    ref = fa._reference_attention(q, k, v, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=5e-6, rtol=1e-5)
+    grad = jax.grad(lambda t: jnp.sum(faf.flash_packed(t, causal=True) * g))(qkv)
+    ref_grad = jnp.stack(_ref_grads(q, k, v, g, True), axis=2)
+    np.testing.assert_allclose(np.asarray(grad), np.asarray(ref_grad),
+                               atol=2e-5, rtol=1e-4)
+
+
+def test_flat_masked_matches_reference(qkvg):
+    # additive-bias path (the fused_softmax_mask.cu.h parity surface):
+    # banded mask, finite entries, grads flow to q/k/v only
+    q, k, v, g = qkvg
+    keep = np.triu(np.ones((S, S), bool), -32)  # band: key >= query-32
+    bias = jnp.asarray(np.where(keep, 0.0, -1e30)[None, None], jnp.float32)
+    out = faf.flash_flat_masked(q, k, v, bias, causal=True)
+
+    def ref_fn(q, k, v):
+        qh, kh, vh = (jnp.swapaxes(t, 1, 2).astype(jnp.float32) for t in (q, k, v))
+        logits = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) / (D ** 0.5) + bias
+        mask = jnp.tril(jnp.ones((S, S), bool))
+        logits = jnp.where(mask, logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1)
+        return jnp.swapaxes(jnp.einsum("bhqk,bhkd->bhqd", probs, vh), 1, 2)
+
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref_fn(q, k, v)),
+                               atol=5e-6, rtol=1e-5)
+    grads = jax.grad(lambda q, k, v: jnp.sum(
+        faf.flash_flat_masked(q, k, v, bias, causal=True) * g), argnums=(0, 1, 2))(q, k, v)
+    ref_grads = jax.grad(lambda q, k, v: jnp.sum(ref_fn(q, k, v) * g),
+                         argnums=(0, 1, 2))(q, k, v)
+    for got, ref in zip(grads, ref_grads):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   atol=2e-5, rtol=1e-4)

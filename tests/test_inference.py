@@ -362,6 +362,218 @@ def test_scheduler_eos_and_early_finish():
     assert done2[rid2].tokens == [first]  # stopped at eos immediately
 
 
+# ------------------------------------------------- serving hot path round 2
+def test_engine_fused_decode_bitwise_and_dispatch_pin():
+    """decode_step(fuse=D) runs D iterations in ONE donated scan dispatch:
+    tokens BITWISE equal to the per-token path at every depth, and the
+    CI-pinned dispatch counter shows <= ceil(N/D)+1 decode dispatches for N
+    generated tokens (the per-step host sync + dispatch amortized by D)."""
+    from paddle_tpu import profiler
+
+    paddle.seed(41)
+    m = GPTForPretraining(GPTConfig.tiny())
+    m.eval()
+    ids = np.random.default_rng(11).integers(0, 512, (3, 9)).astype("int32")
+    base = DecodeEngine(m, max_batch_slots=3, max_seq_len=64, prefill_buckets=(16,))
+    want = base.generate(ids, max_new_tokens=16)
+    for depth in (2, 4, 7):
+        profiler.reset_counters("infer.")
+        eng = DecodeEngine(m, max_batch_slots=3, max_seq_len=64,
+                           prefill_buckets=(16,), fuse=depth)
+        got = eng.generate(ids, max_new_tokens=16)
+        np.testing.assert_array_equal(got, want)
+        counts = profiler.counters("infer.")
+        assert counts["infer.decode_dispatches"] <= -(-16 // depth) + 1, (depth, counts)
+        # one prefill + ONE fused decode program, regardless of depth
+        assert counts["infer.compiles"] == 2, (depth, counts)
+
+
+def test_engine_chunked_prefill_bitwise_and_compile_family():
+    """Chunked prefill collapses the per-bucket compile family into chunk +
+    final-chunk programs (plus the decode program) for ALL prompt lengths,
+    with tokens bitwise equal to the bucketed path."""
+    from paddle_tpu import profiler
+
+    paddle.seed(42)
+    m = GPTForPretraining(GPTConfig.tiny())
+    m.eval()
+    rng = np.random.default_rng(12)
+    prompts = [rng.integers(0, 512, (n,)).astype("int32") for n in (5, 8, 13, 20, 31)]
+    base = DecodeEngine(m, max_batch_slots=1, max_seq_len=64,
+                        prefill_buckets=(8, 16, 32))
+    want = [base.generate(p[None], max_new_tokens=6)[0] for p in prompts]
+    profiler.reset_counters("infer.")
+    eng = DecodeEngine(m, max_batch_slots=1, max_seq_len=64, prefill_chunk=8)
+    got = [eng.generate(p[None], max_new_tokens=6)[0] for p in prompts]
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(g, w)
+    counts = profiler.counters("infer.")
+    # chunk + final-chunk + decode: 3 programs serve every prompt length
+    # (the bucketed family above took one prefill compile PER bucket)
+    assert counts["infer.compiles"] == 3, counts
+    assert counts["infer.prefill_chunk_dispatches"] > len(prompts)  # multi-chunk prompts
+
+
+def test_engine_prefix_cache_reuse_bitwise_and_eviction():
+    """A request whose prompt prefix matches cached chunks skips their
+    prefill entirely (insert dispatches only), produces BITWISE identical
+    tokens, and the LRU byte budget bounds device memory."""
+    from paddle_tpu import profiler
+    from paddle_tpu.inference.prefix_cache import PrefixCache
+
+    paddle.seed(43)
+    m = GPTForPretraining(GPTConfig.tiny())
+    m.eval()
+    rng = np.random.default_rng(13)
+    shared = rng.integers(0, 512, (16,)).astype("int32")
+    tails = [rng.integers(0, 512, (5,)).astype("int32") for _ in range(2)]
+    prompts = [np.concatenate([shared, t]) for t in tails]
+
+    cold = DecodeEngine(m, max_batch_slots=1, max_seq_len=64, prefill_chunk=8)
+    want = [cold.generate(p[None], max_new_tokens=5)[0] for p in prompts]
+
+    profiler.reset_counters("infer.")
+    profiler.reset_counters("serving.")
+    eng = DecodeEngine(m, max_batch_slots=1, max_seq_len=64, prefill_chunk=8,
+                       prefix_cache_mb=4.0)
+    got0 = eng.generate(prompts[0][None], max_new_tokens=5)[0]
+    chunks_cold = profiler.counters("infer.")["infer.prefill_chunk_dispatches"]
+    got1 = eng.generate(prompts[1][None], max_new_tokens=5)[0]
+    chunks_warm = (profiler.counters("infer.")["infer.prefill_chunk_dispatches"]
+                   - chunks_cold)
+    np.testing.assert_array_equal(got0, want[0])
+    np.testing.assert_array_equal(got1, want[1])
+    assert chunks_warm < chunks_cold  # shared 16-token prefix not re-prefilled
+    counts = profiler.counters("serving.")
+    assert counts["serving.prefix_hits"] >= 1
+    assert counts["serving.prefix_tokens_reused"] >= 16
+    assert profiler.counters("infer.")["infer.prefix_insert_dispatches"] >= 2
+    assert eng.prefix_cache.bytes_used() <= eng.prefix_cache.budget_bytes
+
+    # LRU eviction: a 3-entry budget holds max 3 chunks, oldest evicted
+    pc = PrefixCache(chunk=4, budget_bytes=3 * 100, entry_bytes=100)
+    toks = np.arange(32, dtype=np.int32)
+    for i in range(5):
+        pc.put(pc.key(toks, i), f"k{i}", f"v{i}")
+    assert len(pc) == 3 and pc.evictions == 2
+    assert not pc.has(pc.key(toks, 0))  # oldest chain dropped
+    assert pc.match(toks, max_tokens=32) == []  # chain broken at chunk 0
+    assert pc.stats()["bytes_used"] == 300
+
+
+def test_scheduler_chunked_prefill_interleaves_with_decode():
+    """A long admission in chunked mode runs one chunk per tick, and the
+    already-decoding request keeps emitting tokens BETWEEN those chunk
+    dispatches — prefill no longer stalls the stream. Tokens stay bitwise
+    equal to isolated runs; stall accounting lands on the request."""
+    paddle.seed(44)
+    m = GPTForPretraining(GPTConfig.tiny())
+    m.eval()
+    rng = np.random.default_rng(14)
+    short = rng.integers(0, 512, (6,)).astype("int32")
+    long = rng.integers(0, 512, (40,)).astype("int32")  # 5 chunks of 8
+
+    def mk():
+        return DecodeEngine(m, max_batch_slots=2, max_seq_len=64, prefill_chunk=8)
+
+    iso_short = mk().generate(short[None], max_new_tokens=10)[0, 6:].tolist()
+    iso_long = mk().generate(long[None], max_new_tokens=6)[0, 40:].tolist()
+
+    sched = ContinuousBatchingScheduler(mk())
+    r_short = sched.submit(short, max_new_tokens=10)
+    sched.step()  # short admitted (single final chunk) + first decode
+    r_long = sched.submit(long, max_new_tokens=6)
+    progress = []
+    while sched.prefilling or sched.queue:
+        sched.step()
+        req = sched.running.get(0) or next(iter(sched.running.values()), None)
+        if req is not None and req.rid == r_short:
+            progress.append(len(req.tokens))
+    done = sched.run()
+    assert done[r_short].tokens == iso_short
+    assert done[r_long].tokens == iso_long
+    # the short request gained tokens across >=2 ticks of the long prefill
+    assert len(progress) >= 2 and progress[-1] > progress[0]
+    assert done[r_long].prefill_chunks >= 5
+    assert done[r_long].stall_seconds > 0  # its chunks ran while decode waited
+
+
+def test_scheduler_fused_decode_drains_token_stacks():
+    """The scheduler drains [D, B] fused token stacks in order: outputs
+    bitwise equal to the unfused scheduler, fewer decode dispatches, and
+    the report surfaces fuse depth + prefill stall + prefix-hit rate."""
+    from paddle_tpu import profiler
+    from paddle_tpu.observability import monitor
+    from paddle_tpu.observability.__main__ import analyze
+
+    paddle.seed(45)
+    m = GPTForPretraining(GPTConfig.tiny())
+    m.eval()
+    rng = np.random.default_rng(15)
+    prompts = [rng.integers(0, 512, (n,)).astype("int32") for n in (5, 9, 14)]
+
+    def serve(**kw):
+        eng = DecodeEngine(m, max_batch_slots=2, max_seq_len=64, **kw)
+        sched = ContinuousBatchingScheduler(eng)
+        rids = [sched.submit(p, max_new_tokens=7) for p in prompts]
+        done = sched.run()
+        return [done[r].tokens for r in rids]
+
+    want = serve(prefill_buckets=(16,))
+    profiler.reset_counters("infer.")
+    monitor().clear()
+    got = serve(prefill_chunk=8, prefix_cache_mb=2.0, fuse=3)
+    assert got == want
+    counts = profiler.counters("infer.")
+    # 3 requests x 7 tokens at depth 3 across 2 slots: far fewer dispatches
+    # than the 18 per-token steps the unfused path would take
+    assert counts["infer.decode_dispatches"] <= 10, counts
+    sv = analyze(monitor().events())["serving"]
+    assert sv["fuse_depths"] == [3]
+    assert "prefill_stall" in sv
+    assert sv["prefix_cache"]["hit_rate"] >= 0.0
+
+
+def test_engine_aot_disk_cache_restart(tmp_path):
+    """With FLAGS_compile_cache_dir set, serving executables serialize to
+    disk and a RESTARTED engine (same specialization) loads them instead of
+    compiling — 0 compiles, bitwise tokens. A different specialization
+    misses and compiles normally."""
+    from paddle_tpu import profiler
+
+    paddle.seed(46)
+    m = GPTForPretraining(GPTConfig.tiny())
+    m.eval()
+    ids = np.random.default_rng(16).integers(0, 512, (2, 9)).astype("int32")
+    paddle.set_flags({"FLAGS_compile_cache_dir": str(tmp_path)})
+    try:
+        spec = dict(max_batch_slots=2, max_seq_len=64, prefill_chunk=8, fuse=2)
+        profiler.reset_counters("infer.")
+        warm = DecodeEngine(m, **spec)
+        want = warm.generate(ids, max_new_tokens=8)
+        c = profiler.counters("infer.")
+        assert c["infer.compiles"] >= 3 and c["infer.aot_cache_stores"] >= 3
+        assert any((tmp_path / "serving").glob("*.aotc"))
+
+        profiler.reset_counters("infer.")
+        restarted = DecodeEngine(m, **spec)  # fresh engine == restarted process
+        got = restarted.generate(ids, max_new_tokens=8)
+        np.testing.assert_array_equal(got, want)
+        c = profiler.counters("infer.")
+        assert c["infer.compiles"] == 0, c
+        assert c["infer.aot_cache_hits"] >= 3
+        assert [s["from_disk_cache"] for s in restarted.explain()]
+
+        # a different fuse depth is a different specialization: cache miss
+        profiler.reset_counters("infer.")
+        other = DecodeEngine(m, max_batch_slots=2, max_seq_len=64,
+                             prefill_chunk=8, fuse=4)
+        other.generate(ids, max_new_tokens=8)
+        assert profiler.counters("infer.")["infer.compiles"] >= 1
+    finally:
+        paddle.set_flags({"FLAGS_compile_cache_dir": ""})
+
+
 def test_default_buckets_and_bucket_for():
     assert default_buckets(128, start=16) == (16, 32, 64, 128)
     paddle.seed(35)
