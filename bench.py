@@ -171,6 +171,147 @@ def _measure(flash_flat: bool):
     return tokens_per_sec, config_key, on_tpu, extras
 
 
+def _measure_moe(_flat_unused=False):
+    """GPT-MoE training throughput on BOTH ``moe`` kernel paths: the fused
+    sort-based Pallas dispatch/combine (interpret mode on CPU) vs the dense
+    one-hot/einsum composite, forced per run via FLAGS_kernel_overrides and
+    exercised inside the donated ``run_steps`` scan. Reports
+    ``moe_tokens_per_sec`` (fused) / ``moe_tokens_per_sec_dense`` and the
+    registry-selection pin (``kernels.moe.picked`` == compile count)."""
+    import jax
+
+    if os.environ.get("BENCH_FORCE_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+
+    import paddle_tpu as paddle
+    from paddle_tpu.framework.flags import _REGISTRY
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.models.gpt import GPTConfig, GPTForPretraining, GPTPretrainingCriterion
+    from paddle_tpu.observability import metrics as _metrics
+    from paddle_tpu.ops import moe_pallas
+
+    d0 = jax.devices()[0]
+    on_tpu = d0.platform in ("tpu", "axon") or "TPU" in getattr(d0, "device_kind", "")
+    # capacity factor 2.0 = GShard's canonical top-2 train setting (each
+    # token may dispatch to both experts without forced drops)
+    if on_tpu:
+        cfg = dict(vocab_size=50304, hidden_size=1024, num_layers=8, num_heads=16,
+                   max_seq_len=1024, moe=8, moe_every=2, moe_capacity_factor=2.0)
+        batch, seq, K, reps = 8, 1024, 4, 4
+    else:
+        moe_pallas.set_interpret(True)  # CPU: interpret-mode kernel lowering
+        cfg = dict(vocab_size=512, hidden_size=128, num_layers=2, num_heads=4,
+                   max_seq_len=256, moe=64, moe_every=1, ffn_hidden_size=1024,
+                   moe_capacity_factor=2.0)
+        batch, seq, K, reps = 8, 256, 2, 8
+
+    ids = np.random.default_rng(0).integers(0, cfg["vocab_size"], (batch, seq)).astype("int32")
+    stacked = (np.stack([ids] * K), np.stack([ids] * K))
+    crit = GPTPretrainingCriterion()
+
+    steps = {}
+    for path in ("dense", "pallas_sorted"):
+        _REGISTRY["FLAGS_kernel_overrides"] = f"moe={path}"
+        paddle.seed(0)
+        model = GPTForPretraining(GPTConfig(**cfg))
+        opt = paddle.optimizer.AdamW(learning_rate=1e-4, parameters=model.parameters())
+        step = TrainStep(model, opt, crit)
+        out = step.run_steps(stacked, k=K)  # warmup: compile with the override live
+        float(np.asarray(out["loss"]._value)[-1])
+        steps[path] = step
+
+    def _time_fused(s):
+        t0 = time.perf_counter()
+        o = s.run_steps(stacked, k=K)
+        float(np.asarray(o["loss"]._value)[-1])
+        return time.perf_counter() - t0
+
+    best = {"dense": math_inf, "pallas_sorted": math_inf}
+    order = list(steps)
+    for i in range(reps):  # interleave (alternating order) so drift and
+        for path in (order if i % 2 == 0 else order[::-1]):  # cache effects
+            best[path] = min(best[path], _time_fused(steps[path]))  # hit both
+
+    tok = batch * seq * K
+    counters = _metrics.counters("kernels.moe.")
+    compiles = _metrics.counters("train_step.").get("train_step.compiles", 0)
+    extras = {
+        "moe_tokens_per_sec": round(tok / best["pallas_sorted"], 2),
+        "moe_tokens_per_sec_dense": round(tok / best["dense"], 2),
+        "moe_kernel": {
+            "picked": counters.get("kernels.moe.picked", 0),
+            "fallback": counters.get("kernels.moe.fallback", 0),
+            "train_step_compiles": compiles,
+            "interpret": not on_tpu,
+        },
+    }
+    config_key = f"{d0.device_kind or d0.platform}/moe{cfg['moe']}h{cfg['hidden_size']}L{cfg['num_layers']}b{batch}s{seq}"
+    return extras["moe_tokens_per_sec"], config_key, on_tpu, extras
+
+
+math_inf = float("inf")
+
+
+def _measure_flash_micro(_flat_unused=False):
+    """Flat-lane vs classic flash kernel microbench (the FLAGS_flash_flat
+    verdict): interleaved best-of fwd+bwd timings of the same packed-qkv
+    causal attention on both kernel families — Pallas interpreter on CPU,
+    the real kernels on TPU."""
+    import jax
+    import jax.numpy as jnp
+
+    if os.environ.get("BENCH_FORCE_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+
+    from paddle_tpu.framework.flags import _REGISTRY
+    from paddle_tpu.ops import flash_attention as fa
+    from paddle_tpu.ops import flash_attention_flat as flat
+
+    d0 = jax.devices()[0]
+    on_tpu = d0.platform in ("tpu", "axon") or "TPU" in getattr(d0, "device_kind", "")
+    _REGISTRY["FLAGS_use_flash_attention"] = True
+    if on_tpu:
+        b, s, h, d = 8, 1024, 16, 64
+        dtype, reps = jnp.bfloat16, 8
+    else:
+        fa.set_interpret(True)
+        flat.set_interpret(True)
+        b, s, h, d = 1, 256, 2, 64
+        dtype, reps = jnp.float32, 3
+
+    qkv = jax.random.normal(jax.random.key(0), (b, s, 3, h, d), dtype)
+
+    def classic(x):
+        return jnp.sum(fa._flash(x[:, :, 0], x[:, :, 1], x[:, :, 2], True))
+
+    def flat_packed(x):
+        return jnp.sum(flat.flash_packed(x, causal=True))
+
+    fns = {"classic": jax.jit(jax.value_and_grad(classic)),
+           "flat": jax.jit(jax.value_and_grad(flat_packed))}
+    for fn in fns.values():  # compile + numeric sanity
+        val, g = fn(qkv)
+        jax.block_until_ready((val, g))
+
+    best = {name: math_inf for name in fns}
+    for _ in range(reps):  # interleaved best-of: drift hits both sides
+        for name, fn in fns.items():
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(qkv))
+            best[name] = min(best[name], time.perf_counter() - t0)
+
+    micro = {
+        "classic_ms": round(best["classic"] * 1e3, 3),
+        "flat_ms": round(best["flat"] * 1e3, 3),
+        "flat_speedup": round(best["classic"] / best["flat"], 3),
+        "mode": "tpu" if on_tpu else "cpu_interpret",
+        "shape": [b, s, h, d],
+        "what": "fwd+bwd packed-qkv causal attention, interleaved best-of",
+    }
+    config_key = f"{d0.device_kind or d0.platform}/flash_micro b{b}s{s}h{h}d{d}"
+    return micro["flat_speedup"], config_key, on_tpu, {"flash_flat_micro": micro}
+
+
 def _measure_in_subprocess(which: str, timeout: float, force_cpu: bool = False):
     """One measurement per process: TPU runtimes hold per-process device
     locks, so the parent must not initialize a backend before its children.
@@ -198,12 +339,19 @@ PHASE_BUDGETS = {
     "classic": float(os.environ.get("BENCH_BUDGET_CLASSIC", 480)),
     "flat": float(os.environ.get("BENCH_BUDGET_FLAT", 200)),
     "cpu_fallback": float(os.environ.get("BENCH_BUDGET_CPU", 240)),
+    "moe": float(os.environ.get("BENCH_BUDGET_MOE", 300)),
+    "flash_micro": float(os.environ.get("BENCH_BUDGET_FLASH_MICRO", 180)),
 }
 
 
 def main():
     if os.environ.get("BENCH_ONE"):
-        tps, config_key, on_tpu, extras = _measure(os.environ["BENCH_ONE"] == "flat")
+        which = os.environ["BENCH_ONE"]
+        measure = {"moe": _measure_moe, "flash_micro": _measure_flash_micro}.get(which)
+        if measure is not None:
+            tps, config_key, on_tpu, extras = measure()
+        else:
+            tps, config_key, on_tpu, extras = _measure(which == "flat")
         print(json.dumps({"value": tps, "config": config_key, "on_tpu": on_tpu,
                           "extras": extras}))
         return
@@ -285,6 +433,21 @@ def main():
             if flat_cfg == config_key and flat_tps > tokens_per_sec:
                 tokens_per_sec, chosen, extras = flat_tps, "flash_flat", flat_extras
 
+    # kernel-tier phases (own subprocesses, own budgets): GPT-MoE throughput
+    # on the fused Pallas path vs the dense composite, and the
+    # FLAGS_flash_flat flat-vs-classic microbench verdict. Skipped only
+    # when subprocess machinery is unavailable (verdict is None).
+    moe_extras, micro_extras = {}, {}
+    if verdict is not None:
+        ok, out = _phase("moe", _measure_in_subprocess, "moe",
+                         timeout=PHASE_BUDGETS["moe"], force_cpu=not on_tpu)
+        if ok:
+            moe_extras = out[3]
+        ok, out = _phase("flash_micro", _measure_in_subprocess, "flash_micro",
+                         timeout=PHASE_BUDGETS["flash_micro"], force_cpu=not on_tpu)
+        if ok:
+            micro_extras = out[3]
+
     base_path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "bench_baseline.json")
     vs = 1.0
     if os.path.exists(base_path):
@@ -320,6 +483,16 @@ def main():
         "guard_overhead_pct": extras.get("guard_overhead_pct"),
         "skipped_steps": extras.get("skipped_steps"),
         "rollbacks": extras.get("rollbacks"),
+        # MoE kernel tier: GPT-MoE tokens/sec through the fused sort-based
+        # Pallas dispatch/combine vs the dense one-hot/einsum composite
+        # (interpret mode on CPU), plus the registry-selection pin
+        # (kernels.moe.picked == compile count)
+        "moe_tokens_per_sec": moe_extras.get("moe_tokens_per_sec"),
+        "moe_tokens_per_sec_dense": moe_extras.get("moe_tokens_per_sec_dense"),
+        "moe_kernel": moe_extras.get("moe_kernel"),
+        # FLAGS_flash_flat verdict: flat-lane vs classic kernel pair,
+        # fwd+bwd interleaved best-of (cpu_interpret or tpu mode)
+        "flash_flat_micro": micro_extras.get("flash_flat_micro"),
         # observability snapshot (counters + span-histogram summaries) and
         # the compiled-specialization cost captured at TrainStep compile
         "metrics": extras.get("metrics"),

@@ -3,11 +3,15 @@ python/paddle/incubate/distributed/models/moe/moe_layer.py + gates
 moe/gate/{naive,gshard,switch}_gate.py; dispatch via global_scatter/
 global_gather ops, operators/collective/global_scatter_op.cu.cc).
 
-TPU-first: GShard-style dense dispatch/combine einsums with expert weights
-stacked on a leading axis sharded over the expert mesh axis. Under pjit the
-dispatch einsum against the sharded expert dim compiles to the all-to-all
-the reference implements as count-aware NCCL alltoall; capacity-dropping
-keeps shapes static (the XLA contract).
+TPU-first: routing (gate scores, top-k, GShard random second-expert
+jitter, capacity dropping, aux loss) happens here; the dispatch/expert-FFN/
+combine core goes through the :mod:`paddle_tpu.ops.registry` ``moe``
+kernel — the fused sort-based Pallas implementation
+(:mod:`paddle_tpu.ops.moe_pallas`) when available, else the ``dense``
+GShard-style composite below (one-hot + cumsum dispatch einsums, whose
+sharded-expert einsum compiles to the all-to-all the reference implements
+as count-aware NCCL alltoall). Capacity-dropping keeps shapes static (the
+XLA contract).
 """
 from __future__ import annotations
 
@@ -18,14 +22,19 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ..framework import random as _random
-from ..nn import functional as Fnn
 from ..nn import initializer as I
 from ..nn.layer.base import Layer
+from ..ops import moe_pallas as _moe_pallas  # noqa: F401 — registers 'pallas_sorted'
+from ..ops import registry as _registry
 from ..tensor._helpers import ensure_tensor, op
 
 
 class NaiveGate(Layer):
-    """moe/gate/naive_gate.py: linear scores + top-k."""
+    """moe/gate/naive_gate.py: linear scores + top-k. No jitter, no aux
+    loss, no capacity opinion (``capacity = None`` defers to the layer)."""
+
+    capacity = None
+    random_routing = False
 
     def __init__(self, d_model, num_expert, world_size=1, topk=2):
         super().__init__()
@@ -36,22 +45,90 @@ class NaiveGate(Layer):
     def score(self, x_val):
         return x_val @ self.weight._value
 
+    @staticmethod
+    def aux_loss(probs, gate_idx, num_expert):
+        return jnp.zeros((), probs.dtype)
+
 
 class GShardGate(NaiveGate):
     """moe/gate/gshard_gate.py: top-2 with random second-expert jitter +
-    aux load-balance loss."""
+    the GShard load-balance aux loss.
 
-    def __init__(self, d_model, num_expert, world_size=1, topk=2, capacity=(1.2, 2.4)):
+    ``capacity`` is the (train, eval) capacity-factor pair the layer's
+    capacity computation uses when no explicit factor is given. Train-time
+    ``random_routing`` keeps each token's second expert with probability
+    ``min(1, 2·p2)`` (the reference's ``2*topk_val > rand`` test); a
+    dropped pair is simply not dispatched and consumes no capacity. Off in
+    eval, rng via :mod:`paddle_tpu.framework.random`.
+    """
+
+    def __init__(self, d_model, num_expert, world_size=1, topk=2, capacity=(1.2, 2.4), random_routing=True):
         super().__init__(d_model, num_expert, world_size, topk)
-        self.capacity = capacity
+        self.capacity = tuple(capacity)
+        self.random_routing = bool(random_routing)
+
+    @staticmethod
+    def aux_loss(probs, gate_idx, num_expert):
+        # GShard eq.4: mean gate prob * top-1 dispatch fraction, scaled by E
+        me = jnp.mean(probs, axis=0)
+        ce = jnp.mean(jax.nn.one_hot(gate_idx[:, 0], num_expert, dtype=probs.dtype), axis=0)
+        return num_expert * jnp.sum(me * ce)
 
 
 class SwitchGate(NaiveGate):
-    """moe/gate/switch_gate.py: top-1 routing."""
+    """moe/gate/switch_gate.py: top-1 routing; Switch-Transformer aux loss
+    (same E·Σ me·ce form over the top-1 assignment)."""
 
     def __init__(self, d_model, num_expert, world_size=1, topk=1, capacity=(1.2, 2.4)):
         super().__init__(d_model, num_expert, world_size, topk)
-        self.capacity = capacity
+        self.capacity = tuple(capacity)
+
+    aux_loss = staticmethod(GShardGate.aux_loss)
+
+
+def dense_dispatch_combine(tokens, gate_vals, gate_idx, drop_mask, w1, b1, w2, b2, *,
+                           capacity, activation):
+    """GShard/Switch-lineage dense composite: one-hot + cumsum queue
+    positions, padded [E, capacity, D] dispatch einsums, gather combine.
+    The registry's ``moe`` fallback — always available, and the numerical
+    reference the Pallas path is pinned against."""
+    T, D = tokens.shape
+    E = w1.shape[0]
+    K = gate_idx.shape[1]
+
+    flat_idx = gate_idx.reshape(-1)  # [T*K] expert ids (k-major per token)
+    onehot = jax.nn.one_hot(flat_idx, E, dtype=jnp.int32)  # [T*K, E]
+    if drop_mask is not None:
+        # jitter-dropped pairs are not dispatched and consume no capacity
+        onehot = onehot * (1 - drop_mask.reshape(-1).astype(jnp.int32))[:, None]
+    pos_in_expert = jnp.cumsum(onehot, axis=0) - 1  # rank within expert
+    pos = jnp.sum(pos_in_expert * onehot, axis=-1)  # [T*K]
+    keep = pos < capacity
+    if drop_mask is not None:
+        keep = keep & ~drop_mask.reshape(-1)
+    gv = gate_vals.reshape(-1) * keep.astype(gate_vals.dtype)
+
+    # dispatch: [E, capacity, D]
+    disp = jnp.zeros((E, capacity, D), tokens.dtype)
+    tok_rep = jnp.repeat(jnp.arange(T), K)
+    e_ids = jnp.where(keep, flat_idx, 0)
+    p_ids = jnp.where(keep, pos, 0)
+    contrib = tokens[tok_rep] * keep[:, None].astype(tokens.dtype)
+    disp = disp.at[e_ids, p_ids].add(contrib)
+
+    # expert FFN, batched over E — one big MXU matmul per projection
+    h = activation(jnp.einsum("ecd,edh->ech", disp, w1) + b1)
+    y = jnp.einsum("ech,ehd->ecd", h, w2) + b2
+
+    # combine back: weighted gather
+    gathered = y[e_ids, p_ids]  # [T*K, D]
+    combined = jnp.zeros((T, D), y.dtype)
+    return combined.at[tok_rep].add(gathered * gv[:, None])
+
+
+_registry.register(
+    "moe", "dense", dense_dispatch_combine, fallback=True,
+    doc="one-hot/cumsum dispatch + padded [E,capacity,D] einsums (XLA composite)")
 
 
 class MoELayer(Layer):
@@ -59,9 +136,12 @@ class MoELayer(Layer):
 
     experts: stacked FFN weights [E, ...] with dist_spec over the expert axis.
     gate: 'naive' | 'gshard' | 'switch' (reference moe_layer.py gate arg).
+    capacity_factor: explicit per-expert capacity factor; ``None`` (default)
+    routes the gate's ``capacity`` (train, eval) pair into the capacity
+    computation — GShard/Switch default to (1.2, 2.4).
     """
 
-    def __init__(self, d_model, d_hidden, num_experts, top_k=2, capacity_factor=1.25, gate="gshard", expert_axis="dp", activation="gelu", name=None):
+    def __init__(self, d_model, d_hidden, num_experts, top_k=2, capacity_factor=None, gate="gshard", expert_axis="dp", activation="gelu", name=None):
         super().__init__()
         self.num_experts = num_experts
         self.top_k = 1 if gate == "switch" else top_k
@@ -78,14 +158,22 @@ class MoELayer(Layer):
             p.is_distributed = True
         self.aux_loss = None
 
+    def _capacity_factor(self):
+        if self.capacity_factor is not None:
+            return float(self.capacity_factor)
+        cap = getattr(self.gate, "capacity", None) or (1.25, 2.0)
+        return float(cap[0] if self.training else cap[1])
+
     def forward(self, x):
         """x: [batch, seq, d_model] (or [tokens, d_model])."""
         x = ensure_tensor(x)
-        squeeze_back = x.ndim == 2
         act = {"gelu": jax.nn.gelu, "relu": jax.nn.relu, "silu": jax.nn.silu}[self.activation]
-        E, K, cf = self.num_experts, self.top_k, self.capacity_factor
+        E, K, cf = self.num_experts, self.top_k, self._capacity_factor()
+        jitter = bool(self.training and getattr(self.gate, "random_routing", False) and K >= 2)
+        gate_aux = type(self.gate).aux_loss
+        aux_in = [_random.key_tensor()] if jitter else []
 
-        def fn(xv, gate_w, w1, b1, w2, b2):
+        def fn(xv, gate_w, w1, b1, w2, b2, *extra):
             xs = xv if xv.ndim == 3 else xv[None]
             B, S, D = xs.shape
             tokens = xs.reshape(B * S, D)
@@ -96,39 +184,21 @@ class MoELayer(Layer):
             probs = jax.nn.softmax(logits, axis=-1)
             gate_vals, gate_idx = jax.lax.top_k(probs, K)  # [T, K]
 
-            # aux load-balance loss (GShard eq.4): mean prob * token fraction
-            me = jnp.mean(probs, axis=0)
-            one_hot_top1 = jax.nn.one_hot(gate_idx[:, 0], E)
-            ce = jnp.mean(one_hot_top1, axis=0)
-            aux = E * jnp.sum(me * ce)
+            drop_mask = None
+            if jitter:
+                # GShard random routing: keep the 2nd expert with
+                # probability min(1, 2·p2); other ranks always dispatch
+                r = jax.random.uniform(jax.random.fold_in(extra[0], 0), (n_tok,), gate_vals.dtype)
+                drop2 = 2.0 * gate_vals[:, 1] <= r
+                drop_mask = jnp.zeros((n_tok, K), bool).at[:, 1].set(drop2)
 
-            # position of each (token, k) within its expert queue
-            flat_idx = gate_idx.reshape(-1)  # [T*K] expert ids (k-major per token)
-            onehot = jax.nn.one_hot(flat_idx, E, dtype=jnp.int32)  # [T*K, E]
-            pos_in_expert = jnp.cumsum(onehot, axis=0) - 1  # rank within expert
-            pos = jnp.sum(pos_in_expert * onehot, axis=-1)  # [T*K]
-            keep = pos < capacity
-            gv = gate_vals.reshape(-1) * keep.astype(gate_vals.dtype)
-
-            # dispatch: [E, capacity, D]
-            disp = jnp.zeros((E, capacity, D), tokens.dtype)
-            tok_rep = jnp.repeat(jnp.arange(n_tok), K)
-            e_ids = jnp.where(keep, flat_idx, 0)
-            p_ids = jnp.where(keep, pos, 0)
-            contrib = tokens[tok_rep] * keep[:, None].astype(tokens.dtype)
-            disp = disp.at[e_ids, p_ids].add(contrib)
-
-            # expert FFN, batched over E — one big MXU matmul per projection
-            h = act(jnp.einsum("ecd,edh->ech", disp, w1) + b1)
-            y = jnp.einsum("ech,ehd->ecd", h, w2) + b2
-
-            # combine back: weighted gather
-            gathered = y[e_ids, p_ids]  # [T*K, D]
-            combined = jnp.zeros((n_tok, D), y.dtype)
-            combined = combined.at[tok_rep].add(gathered * gv[:, None])
-            out = combined.reshape(B, S, D)
+            aux = gate_aux(probs, gate_idx, E)
+            out = _registry.dispatch(
+                "moe", tokens, gate_vals, gate_idx, drop_mask, w1, b1, w2, b2,
+                capacity=capacity, activation=act)
+            out = out.reshape(B, S, D)
             return (out[0] if xv.ndim == 2 else out), aux
 
-        out, aux = op(fn, x, self.gate.weight, self.w1, self.b1, self.w2, self.b2, _name="moe")
+        out, aux = op(fn, x, self.gate.weight, self.w1, self.b1, self.w2, self.b2, *aux_in, _name="moe")
         self.aux_loss = aux
         return out

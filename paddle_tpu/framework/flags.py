@@ -62,7 +62,8 @@ def flag(name: str):
 define_flag("FLAGS_check_nan_inf", False, "check outputs for nan/inf after each eager op")
 define_flag("FLAGS_benchmark", False, "synchronize after each op for timing")
 define_flag("FLAGS_use_flash_attention", True, "use the Pallas flash-attention kernel when on TPU")
-define_flag("FLAGS_flash_flat", False, "use the flat-lane (zero-relayout) flash kernels for packed qkv attention (opt-in until benchmarked)")
+define_flag("FLAGS_flash_flat", False, "use the flat-lane (zero-relayout) flash kernels for packed qkv attention. Microbench verdict (bench.py flash_micro phase, CPU interpret, fwd+bwd [1,256,2,64]): flat ~1.7x classic under the interpreter (one fused packed pallas_call vs the classic pair's separate fwd/bwd launches); interpreter timings don't transfer to TPU, so stays opt-in pending the on-chip A/B (BASELINE.md: fwd verified correct+compiling in the r4 tunnel window, step A/B never ran)")
+define_flag("FLAGS_kernel_overrides", "", "force kernel-registry implementations per kernel, e.g. 'moe=dense,sdpa=xla' (see paddle_tpu.ops.registry); forced impls bypass availability predicates; unknown impl names raise at dispatch")
 define_flag("FLAGS_eager_delete_tensor_gb", 0.0, "compat no-op: XLA/PJRT manages buffers")
 define_flag("FLAGS_allocator_strategy", "auto_growth", "compat no-op: PJRT BFC allocator is used")
 define_flag("FLAGS_remat_policy", "none", "default rematerialization policy for jit steps")

@@ -63,11 +63,18 @@ class GPTConfig:
         stacked=True,
         recompute=False,
         recompute_granularity="full",
+        moe=0,
         moe_num_experts=0,
         moe_every=2,
         moe_top_k=2,
         moe_capacity_factor=1.25,
     ):
+        # ``moe=E`` is the one-knob spelling: swap every moe_every-th
+        # block's dense FFN for an E-expert MoELayer and pick the per-layer
+        # trunk it needs (a stacked trunk assumes homogeneous layers)
+        if moe:
+            moe_num_experts = moe_num_experts or int(moe)
+            stacked = False
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -214,31 +221,24 @@ class GPTBlock(nn.Layer):
 
 
 def _attn_core(q, k, v, attn_dropout=0.0, key=None):
-    """Pure-array causal self-attention: Pallas flash kernel on TPU when
-    shapes allow, jnp reference otherwise (same dispatch the eager
-    F.scaled_dot_product_attention does)."""
-    from ..framework.flags import flag
-    from ..nn.functional.attention import _sdpa_reference
-    from ..ops.flash_attention import flash_attention, flash_attention_available
+    """Pure-array causal self-attention via the ``sdpa`` kernel-registry
+    entry: Pallas flash kernel on TPU when shapes allow, jnp reference
+    otherwise (same selection the eager F.scaled_dot_product_attention
+    makes)."""
+    from ..ops import registry
 
-    if attn_dropout == 0.0 and flag("FLAGS_use_flash_attention") and flash_attention_available(tuple(q.shape), tuple(k.shape)):
-        return flash_attention(q, k, v, causal=True)
-    return _sdpa_reference(q, k, v, None, True, attn_dropout, key)
+    return registry.dispatch("sdpa", q, k, v, None, True, attn_dropout, key, None)
 
 
 def _attn_core_packed(qkv, attn_dropout=0.0, key=None):
-    """Same dispatch over the packed [b, s, 3, h, d] qkv-projection output:
-    the flash kernels read q/k/v via index maps and return the packed d(qkv)
-    in backward — avoids the slice/relayout copies of the split form."""
-    from ..framework.flags import flag
-    from ..nn.functional.attention import _sdpa_reference
-    from ..ops.flash_attention import flash_attention_available, flash_attention_qkv
+    """Same over the packed [b, s, 3, h, d] qkv-projection output, via the
+    ``attention_core`` registry entry: the flat-lane kernels read q/k/v via
+    index maps and return the packed d(qkv) in backward — avoiding the
+    slice/relayout copies of the split form — with the classic pair and the
+    jnp reference as ordered fallbacks."""
+    from ..ops import registry
 
-    b, s, _, h, d = qkv.shape
-    if attn_dropout == 0.0 and flag("FLAGS_use_flash_attention") and flash_attention_available((b, s, h, d)):
-        return flash_attention_qkv(qkv, causal=True)
-    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-    return _sdpa_reference(q, k, v, None, True, attn_dropout, key)
+    return registry.dispatch("attention_core", qkv, attn_dropout, key)
 
 
 def _block_apply(lp, h, key, *, num_heads, dropout=0.0, attn_dropout=0.0, epsilon=1e-5):

@@ -5,6 +5,20 @@ Parity: the reference's fused attention CUDA ops
 fused_softmax_mask.cu.h) — on TPU the hot path is the Pallas
 flash-attention kernel (paddle_tpu/ops/flash_attention.py); the jnp
 path below is the reference implementation XLA fuses on its own.
+
+Kernel selection goes through :mod:`paddle_tpu.ops.registry`: two kernels
+are registered here —
+
+- ``sdpa``: the eager/staged scaled-dot-product entry point. Impls:
+  ``flash`` (classic Pallas pair: no mask, no dropout), ``flash_flat_gqa``
+  (flat-lane kernels: additive/bool masks + grouped KV), ``xla`` fallback.
+- ``attention_core``: GPT's pure-array packed-qkv causal core. Impls:
+  ``flash_packed`` (flat-lane packed, zero-relayout), ``flash`` (classic
+  pair over slices), ``xla`` fallback.
+
+The hand-rolled ``flag(...) and available(...)`` dance each call site used
+to carry lives in the impls' availability predicates; selection is cached
+per call signature with ``kernels.{sdpa,attention_core}.*`` counters.
 """
 from __future__ import annotations
 
@@ -13,44 +27,22 @@ import jax.numpy as jnp
 
 from ...framework import random as _random
 from ...framework.flags import flag
+from ...ops import registry as _registry
 from ...tensor._helpers import ensure_tensor, op, unwrap
 
 
 def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.0, is_causal=False, training=True, name=None):
     """q/k/v: [batch, seq, heads, head_dim] (paddle layout).
 
-    Dispatches to the Pallas flash kernel on TPU when
-    FLAGS_use_flash_attention is set and shapes are tile-friendly.
+    Dispatches through the ``sdpa`` kernel registry entry: the Pallas
+    flash kernel on TPU when FLAGS_use_flash_attention is set and shapes
+    are tile-friendly, the flat-lane masked/GQA kernels for supported
+    masks, the jnp reference otherwise.
     """
     q, k, v = ensure_tensor(query), ensure_tensor(key), ensure_tensor(value)
 
-    use_flash = flag("FLAGS_use_flash_attention") and dropout_p == 0.0 and attn_mask is None
-    if use_flash:
-        from ...ops.flash_attention import flash_attention_available, flash_attention
-
-        if flash_attention_available(tuple(q.shape), tuple(k.shape)):
-            return op(lambda qq, kk, vv: flash_attention(qq, kk, vv, causal=is_causal), q, k, v, _name="flash_attention")
-
-    # masked / GQA envelope: additive [b|1, 1, s, s] masks (bool masks become
-    # 0/-1e30) and h_kv | h grouped KV run through the flat-lane kernels when
-    # FLAGS_flash_flat is on (reference fused_attention_op.cu attn_mask path)
-    if flag("FLAGS_use_flash_attention") and dropout_p == 0.0 and attn_mask is not None:
-        from ...ops import flash_attention_flat as _flat
-
-        b, s, h, d = q.shape
-        m = ensure_tensor(attn_mask)
-        kv_ok = tuple(k.shape) == tuple(q.shape) or (
-            k.shape[0] == b and k.shape[1] == s and h % k.shape[2] == 0 and k.shape[3] == d)
-        if (_flat.enabled((b, s, 3, h, d), packed=False) and kv_ok
-                and _flat.mask_supported(b, s, h, d, tuple(m.shape))):
-            def fn(qq, kk, vv, mm):
-                if mm.dtype == jnp.bool_:
-                    mm = jnp.where(mm, 0.0, -1e30).astype(jnp.float32)
-                return _flat.flash_flat_gqa(qq, kk, vv, causal=is_causal, mask=mm)
-
-            return op(fn, q, k, v, m, _name="flash_attention")
-
     dropping = dropout_p > 0.0 and training
+    p = dropout_p if training else 0.0
     aux = [ensure_tensor(attn_mask)] if attn_mask is not None else []
     if dropping:
         aux.append(_random.key_tensor())
@@ -61,8 +53,7 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
         mask = extra[0] if has_mask else None
         drop_key = extra[-2] if dropping else None
         train = extra[-1] if dropping else None
-        return _sdpa_reference(qq, kk, vv, mask, is_causal,
-                               dropout_p if training else 0.0, drop_key, train)
+        return _registry.dispatch("sdpa", qq, kk, vv, mask, is_causal, p, drop_key, train)
 
     return op(fn, q, k, v, *aux, _name="sdpa")
 
@@ -93,3 +84,111 @@ def _sdpa_reference(q, k, v, mask=None, causal=False, dropout_p=0.0, drop_key=No
         probs = jnp.where(keep, probs * scale, 0.0).astype(probs.dtype)
     out = jnp.einsum("bhqk,bhkd->bhqd", probs, vh)
     return jnp.swapaxes(out, 1, 2)
+
+
+# -- kernel registrations ----------------------------------------------------
+
+
+def _interpret_state():
+    # interpret-mode toggles live outside the flag registry; fold them into
+    # the selection-cache key so set_interpret() re-runs the predicates
+    from ...ops import flash_attention as _fa
+    from ...ops import flash_attention_flat as _flat
+
+    return (_fa._INTERPRET, _flat._INTERPRET)
+
+
+def _sdpa_flash_available(q, k, v, mask, causal, dropout_p, drop_key, train):
+    from ...ops.flash_attention import flash_attention_available
+
+    return (mask is None and dropout_p == 0.0 and flag("FLAGS_use_flash_attention")
+            and flash_attention_available(tuple(q.shape), tuple(k.shape)))
+
+
+def _sdpa_flash(q, k, v, mask, causal, dropout_p, drop_key, train):
+    from ...ops.flash_attention import flash_attention
+
+    return flash_attention(q, k, v, causal=causal)
+
+
+def _sdpa_flat_available(q, k, v, mask, causal, dropout_p, drop_key, train):
+    # masked / GQA envelope: additive [b|1, 1, s, s] masks (bool masks become
+    # 0/-1e30) and h_kv | h grouped KV run through the flat-lane kernels when
+    # FLAGS_flash_flat is on (reference fused_attention_op.cu attn_mask path)
+    from ...ops import flash_attention_flat as _flat
+
+    if mask is None or dropout_p != 0.0 or not flag("FLAGS_use_flash_attention"):
+        return False
+    b, s, h, d = q.shape
+    kv_ok = tuple(k.shape) == tuple(q.shape) or (
+        k.shape[0] == b and k.shape[1] == s and h % k.shape[2] == 0 and k.shape[3] == d)
+    return (_flat.enabled((b, s, 3, h, d), packed=False) and kv_ok
+            and _flat.mask_supported(b, s, h, d, tuple(mask.shape)))
+
+
+def _sdpa_flat(q, k, v, mask, causal, dropout_p, drop_key, train):
+    from ...ops import flash_attention_flat as _flat
+
+    if mask.dtype == jnp.bool_:
+        mask = jnp.where(mask, 0.0, -1e30).astype(jnp.float32)
+    return _flat.flash_flat_gqa(q, k, v, causal=causal, mask=mask)
+
+
+_registry.define_kernel(
+    "sdpa", flags=("FLAGS_use_flash_attention", "FLAGS_flash_flat"),
+    cache_key=_interpret_state)
+_registry.register(
+    "sdpa", "flash", _sdpa_flash, available=_sdpa_flash_available,
+    doc="classic Pallas flash pair (self-attn, no mask/dropout, tile-friendly seq)")
+_registry.register(
+    "sdpa", "flash_flat_gqa", _sdpa_flat, available=_sdpa_flat_available,
+    doc="flat-lane masked/GQA flash kernels (additive or bool [b|1,1,s,s] mask)")
+_registry.register(
+    "sdpa", "xla", _sdpa_reference, fallback=True,
+    doc="jnp reference composite (any mask/dropout/shape)")
+
+
+def _core_flat_available(qkv, dropout_p, drop_key):
+    from ...ops import flash_attention_flat as _flat
+
+    return (dropout_p == 0.0 and flag("FLAGS_use_flash_attention")
+            and _flat.enabled(tuple(qkv.shape)))
+
+
+def _core_flat(qkv, dropout_p, drop_key):
+    from ...ops import flash_attention_flat as _flat
+
+    return _flat.flash_packed(qkv, causal=True)
+
+
+def _core_flash_available(qkv, dropout_p, drop_key):
+    from ...ops.flash_attention import flash_attention_available
+
+    b, s, _, h, d = qkv.shape
+    return (dropout_p == 0.0 and flag("FLAGS_use_flash_attention")
+            and flash_attention_available((b, s, h, d)))
+
+
+def _core_flash(qkv, dropout_p, drop_key):
+    from ...ops.flash_attention import _flash
+
+    return _flash(qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2], True)
+
+
+def _core_xla(qkv, dropout_p, drop_key):
+    return _sdpa_reference(qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2], None, True,
+                           dropout_p, drop_key)
+
+
+_registry.define_kernel(
+    "attention_core", flags=("FLAGS_use_flash_attention", "FLAGS_flash_flat"),
+    cache_key=_interpret_state)
+_registry.register(
+    "attention_core", "flash_packed", _core_flat, available=_core_flat_available,
+    doc="flat-lane packed-qkv kernels (zero-relayout reads via index maps)")
+_registry.register(
+    "attention_core", "flash", _core_flash, available=_core_flash_available,
+    doc="classic Pallas flash pair over packed-qkv slices")
+_registry.register(
+    "attention_core", "xla", _core_xla, fallback=True,
+    doc="jnp reference over packed-qkv slices (handles attention dropout)")

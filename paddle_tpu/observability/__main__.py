@@ -12,7 +12,10 @@ Prints, from one structured run log (see :mod:`.runlog`):
 - a serving section (request rate, queue depth, prefill/decode time split,
   latency p50/p99 and time-to-first-token, prefix-cache hit rate, fused
   decode depth, chunked-prefill stall percentiles) when the run produced
-  ``request`` events (the continuous-batching scheduler's stream).
+  ``request`` events (the continuous-batching scheduler's stream),
+- a kernel-selection section (picked vs fallback per registry kernel, with
+  the per-implementation breakdown) when the run produced
+  ``kernel_select`` events (the ops kernel registry's stream).
 
 ``--json`` emits the same analysis as one JSON object for tooling.
 """
@@ -111,6 +114,19 @@ def analyze(events: List[dict]) -> dict:
     reqs = [ev for ev in events if ev.get("event") == "request"]
     if reqs:
         out["serving"] = _analyze_serving(reqs)
+    # kernel-selection section from the ops registry's kernel_select events
+    # (one per distinct call signature: picked = a real kernel won,
+    # fallback = the XLA composite served)
+    sels = [ev for ev in events if ev.get("event") == "kernel_select"]
+    if sels:
+        kernels: dict = {}
+        for ev in sels:
+            row = kernels.setdefault(ev.get("kernel", "?"),
+                                     {"picked": 0, "fallback": 0, "impls": {}})
+            row["fallback" if ev.get("fallback") else "picked"] += 1
+            impl = ev.get("impl", "?")
+            row["impls"][impl] = row["impls"].get(impl, 0) + 1
+        out["kernels"] = kernels
     return out
 
 
@@ -253,6 +269,13 @@ def print_report(path: str, a: dict) -> None:
             print(f"    prefill stall: p50 {stall['p50_seconds'] * 1e3:.2f} ms   "
                   f"p99 {stall['p99_seconds'] * 1e3:.2f} ms   "
                   f"total {stall['total_seconds']:.4f}s")
+    ks = a.get("kernels")
+    if ks:
+        print("  kernel selection (ops registry, one row per kernel):")
+        for kernel, row in sorted(ks.items()):
+            impls = "  ".join(f"{name} x{n}" for name, n in sorted(row["impls"].items()))
+            print(f"    {kernel:<16} picked {row['picked']}  fallback "
+                  f"{row['fallback']}   [{impls}]")
 
 
 def main(argv=None) -> int:

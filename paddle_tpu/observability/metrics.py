@@ -160,6 +160,18 @@ SERVING_COUNTERS: Tuple[str, ...] = (
     "serving.prefix_tokens_reused",
 )
 
+# Kernel-registry selection series (paddle_tpu.ops.registry): one
+# ``picked`` (a real kernel won) or ``fallback`` (the XLA composite served)
+# increment per distinct call signature — so ``kernels.<k>.picked`` equals
+# the compile count, the invariant bench.py and the tests pin. The registry
+# also declares these at define_kernel time; listing the built-in kernels
+# here keeps idle-process scrapes complete.
+KERNEL_COUNTERS: Tuple[str, ...] = (
+    "kernels.sdpa.picked", "kernels.sdpa.fallback",
+    "kernels.attention_core.picked", "kernels.attention_core.fallback",
+    "kernels.moe.picked", "kernels.moe.fallback",
+)
+
 
 # -------------------------------------------------------------------- gauges
 def gauge_set(name: str, value: float) -> None:

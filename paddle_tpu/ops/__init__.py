@@ -1,1 +1,36 @@
-from . import flash_attention  # noqa: F401
+"""paddle_tpu.ops — the Pallas kernel tier and its registry.
+
+Public surface: the flash-attention kernel families (classic pair +
+flat-lane/packed), the fused layer norm, the fused sort-based MoE
+dispatch/combine, and the kernel registry every ``nn`` layer dispatches
+through (``registry.dispatch(<kernel>, ...)`` with per-signature selection
+caching and an XLA-composite fallback).
+
+Note: the ``flash_attention`` *function* is reached as
+``ops.flash_attention.flash_attention`` — rebinding it here would shadow
+the submodule name existing imports rely on.
+"""
+from . import flash_attention, flash_attention_flat, layer_norm, moe_pallas, registry  # noqa: F401
+from .flash_attention import flash_attention_available, flash_attention_qkv  # noqa: F401
+from .flash_attention_flat import flash_flat, flash_flat_gqa, flash_packed  # noqa: F401
+from .layer_norm import layer_norm_fused  # noqa: F401
+from .moe_pallas import moe_available, moe_dispatch_combine  # noqa: F401
+from .registry import (  # noqa: F401
+    define_kernel,
+    dispatch,
+    implementations,
+    kernel_table,
+    kernels,
+    register,
+)
+
+__all__ = [
+    "flash_attention", "flash_attention_flat", "layer_norm", "moe_pallas",
+    "registry",
+    "flash_attention_available", "flash_attention_qkv",
+    "flash_flat", "flash_flat_gqa", "flash_packed",
+    "layer_norm_fused",
+    "moe_available", "moe_dispatch_combine",
+    "define_kernel", "register", "dispatch", "implementations",
+    "kernels", "kernel_table",
+]

@@ -37,10 +37,11 @@ def set_interpret(on: bool) -> bool:
 
 
 def flash_attention_available(q_shape, k_shape=None) -> bool:
-    """Kernel path needs TPU + tile-friendly shapes (seq multiple of the
-    block size) + self-attention-like q/k lengths (the kernel derives K/V
-    tiling from q's seq_len)."""
-    if jax.default_backend() not in ("tpu", "axon"):
+    """Kernel path needs TPU (or interpreter mode, for CPU parity runs) +
+    tile-friendly shapes (seq multiple of the block size) +
+    self-attention-like q/k lengths (the kernel derives K/V tiling from
+    q's seq_len)."""
+    if jax.default_backend() not in ("tpu", "axon") and not _INTERPRET:
         return False
     if len(q_shape) != 4:
         return False

@@ -92,7 +92,7 @@ def enabled(qkv_shape=None, packed=True) -> bool:
     the wrapper being dispatched to (flash_packed vs flash_flat*)."""
     from ..framework.flags import flag
 
-    if jax.default_backend() not in ("tpu", "axon"):
+    if jax.default_backend() not in ("tpu", "axon") and not _INTERPRET:
         return False
     if not flag("FLAGS_flash_flat"):
         return False
