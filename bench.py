@@ -160,11 +160,19 @@ def _measure(flash_flat: bool):
         "counters": {k: v for k, v in snap["counters"].items() if v},
         "histograms": snap["histograms"],
     }
-    cost_rows = step.explain()
+    cost_rows = step.explain(analyze=True)
     if cost_rows:
         extras["cost"] = {k: cost_rows[0].get(k) for k in
                           ("flops", "bytes_accessed", "peak_bytes",
                            "compile_seconds")}
+        # SPMD analyzer verdict for the first training specialization
+        # (collective counts by kind, est. reshard bytes per dispatch, peak
+        # per-device memory estimate) — the planner-facing summary
+        spmd = cost_rows[0].get("spmd")
+        if spmd:
+            extras["spmd"] = {k: spmd.get(k) for k in
+                              ("collectives", "reshard_bytes", "peak_bytes",
+                               "codes")}
         # stdout carries only the JSON result line; the table is operator aid
         print(observability.format_cost_table(cost_rows), file=sys.stderr)
     config_key = f"{d0.device_kind or d0.platform}/h{cfg.hidden_size}L{cfg.num_layers}b{batch}s{seq}/amp={amp_level}"
@@ -497,6 +505,10 @@ def main():
         # the compiled-specialization cost captured at TrainStep compile
         "metrics": extras.get("metrics"),
         "cost": extras.get("cost"),
+        # SPMD sharding-analyzer summary for the first training
+        # specialization (collective counts, est. reshard bytes/dispatch,
+        # peak per-device memory estimate)
+        "spmd": extras.get("spmd"),
         # graceful-degradation record: which phases ran, which fell back
         "fallback": fallback_reason,
         "phases": phases,

@@ -12,13 +12,21 @@ The optimizing passes are XLA's job in this design; this package keeps the
   (:mod:`analysis.passes`) that emit stable ``PTA0xx`` diagnostics, and
 - the Python AST dy2static transpiles — a pre-flight linter
   (:mod:`analysis.ast_lint`, ``PTA1xx``) that points at unsupported
-  constructs with file:line before any tracer error can occur.
+  constructs with file:line before any tracer error can occur, and
+- the lowered SPMD program — the post-GSPMD HLO of a compiled-but-not-yet-
+  dispatched executable (:mod:`analysis.spmd` + :mod:`analysis.hlo`,
+  ``PTA2xx``): implicit all-gathers, spec-mismatch reshards, decode-loop
+  collectives, HBM-budget overruns, cross-rank schedule divergence.
 
 Entry points:
   ``Program.analyze(fetch_list)``          — run the IR passes
   ``Executor.run`` under ``FLAGS_static_check`` — auto-check per new program
+  ``Executor.run``/``TrainStep``/``DecodeEngine``/``Engine.prepare`` under
+  ``FLAGS_shard_check``                    — SPMD pre-flight per specialization
+  ``TrainStep.explain(analyze=True)``      — lazy PTA2xx verdict per row
   ``paddle.jit.to_static(fn, lint=True)``  — pre-flight AST lint
   ``python -m paddle_tpu.analysis <target>`` — CLI over files/modules/dirs
+  ``python -m paddle_tpu.analysis --hlo dump.txt`` — CLI over HLO text
 """
 from __future__ import annotations
 
@@ -43,6 +51,15 @@ from .passes import (
     register_pass,
     registered_passes,
 )
+from .spmd import (
+    ShardCheckOptions,
+    SpmdReport,
+    analyze_compiled,
+    analyze_hlo_text,
+    analyze_jit,
+    shard_check,
+    verify_collective_schedule,
+)
 
 __all__ = [
     "AnalysisContext",
@@ -51,6 +68,11 @@ __all__ = [
     "ProgramAnalysisError",
     "RESERVED_FEEDS",
     "SEVERITIES",
+    "ShardCheckOptions",
+    "SpmdReport",
+    "analyze_compiled",
+    "analyze_hlo_text",
+    "analyze_jit",
     "analyze_program",
     "format_report",
     "lint_file",
@@ -61,4 +83,6 @@ __all__ = [
     "max_severity",
     "register_pass",
     "registered_passes",
+    "shard_check",
+    "verify_collective_schedule",
 ]

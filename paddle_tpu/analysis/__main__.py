@@ -1,7 +1,16 @@
 """CLI: ``python -m paddle_tpu.analysis <module-or-script-or-dir> ...``
 
-Runs the dy2static pre-flight linter over the targets' Python source
-(no target code is imported or executed — modules resolve via find_spec).
+Two modes:
+
+- default — the dy2static pre-flight linter over the targets' Python source
+  (no target code is imported or executed — modules resolve via find_spec);
+- ``--hlo`` — the SPMD sharding analyzer (PTA2xx) over lowered-program HLO
+  text files (``Compiled.as_text()`` dumps, ``XLA_FLAGS=--xla_dump_to``
+  output): implicit all-gathers and spec-mismatch reshards with bytes-moved
+  estimates, collective counts and the schedule fingerprint; ``--decode``
+  applies the serving rule (PTA203: any collective fires per token) and
+  ``--hbm-budget`` checks the text-derived per-device memory floor (PTA204).
+
 Exit status: 0 clean / warnings only, 1 when error-severity diagnostics are
 found (or any finding under ``--strict``), 2 on usage errors.
 """
@@ -16,26 +25,82 @@ from .ast_lint import lint_path
 from .diagnostics import SEVERITIES, Diagnostic
 
 
+def _analyze_hlo_file(path: str, args) -> tuple:
+    """(diagnostics, report dict) for one HLO text file."""
+    from . import hlo as _hlo
+    from . import spmd as _spmd
+
+    with open(path, "r", encoding="utf-8") as f:
+        text = f.read()
+    opts = _spmd.ShardCheckOptions(decode=args.decode,
+                                   hbm_budget_mb=args.hbm_budget or None)
+    diags, collectives = _spmd.analyze_hlo_text(text, opts, label=path)
+    floor = _hlo.entry_memory_lower_bound(text)
+    if args.hbm_budget and floor > args.hbm_budget * (1 << 20):
+        diags.append(Diagnostic(
+            "PTA204", "error",
+            f"per-device memory floor for {path} is ~{floor / (1 << 20):.1f} "
+            f"MiB (entry parameters + largest result), over the --hbm-budget "
+            f"of {args.hbm_budget:g} MiB",
+            hint="this is a lower bound from text alone; the runtime check "
+                 "(FLAGS_shard_check + FLAGS_hbm_budget_mb) uses XLA's full "
+                 "memory_analysis"))
+    report = {
+        "file": path,
+        "collectives": _hlo.collective_counts(collectives),
+        "collective_count": len(collectives),
+        "reshard_bytes": _hlo.total_moved_bytes(collectives),
+        "memory_floor_bytes": floor,
+        "fingerprint": _hlo.schedule_fingerprint(collectives),
+        "schedule": [c.signature() for c in collectives],
+    }
+    return diags, report
+
+
 def main(argv: List[str] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m paddle_tpu.analysis",
-        description="dy2static pre-flight lint over scripts, packages or "
-                    "dotted module names (source-only; nothing is executed)")
+        description="static analysis CLI: dy2static pre-flight lint over "
+                    "scripts/packages/modules (default), or the SPMD "
+                    "sharding analyzer over lowered HLO text (--hlo)")
     parser.add_argument("targets", nargs="+",
-                        help=".py file, directory, or dotted module name "
-                             "(e.g. examples/train_gpt.py, paddle_tpu.models.gpt)")
+                        help=".py file, directory, or dotted module name; "
+                             "with --hlo: HLO text file(s)")
+    parser.add_argument("--hlo", action="store_true",
+                        help="treat targets as lowered-program HLO text and "
+                             "run the PTA2xx sharding passes")
+    parser.add_argument("--decode", action="store_true",
+                        help="with --hlo: apply the serving decode rule "
+                             "(PTA203 — a compiled-in collective fires on "
+                             "every generated token)")
+    parser.add_argument("--hbm-budget", type=float, default=0.0, metavar="MB",
+                        help="with --hlo: per-device memory budget in MiB "
+                             "(PTA204 on the text-derived floor)")
     parser.add_argument("--strict", action="store_true",
                         help="exit 1 on any diagnostic, not just errors")
     parser.add_argument("--min-severity", choices=SEVERITIES, default="info",
                         help="hide diagnostics below this level")
     parser.add_argument("--json", action="store_true", dest="as_json",
-                        help="emit diagnostics as a JSON array")
+                        help="emit diagnostics as a JSON array (with --hlo: "
+                             "one report object per file)")
     args = parser.parse_args(argv)
 
+    def _as_dict(d: Diagnostic) -> dict:
+        return {"code": d.code, "severity": d.severity, "message": d.message,
+                "hint": d.hint, "file": d.file, "line": d.line, "col": d.col,
+                "op": d.op, "var": d.var}
+
     diags: List[Diagnostic] = []
+    reports: List[dict] = []
     for target in args.targets:
         try:
-            diags.extend(lint_path(target))
+            if args.hlo:
+                d, rep = _analyze_hlo_file(target, args)
+                diags.extend(d)
+                rep["findings"] = [_as_dict(x) for x in d]
+                reports.append(rep)
+            else:
+                diags.extend(lint_path(target))
         except (OSError, ValueError) as e:
             print(f"error: {target}: {e}", file=sys.stderr)
             return 2
@@ -43,13 +108,22 @@ def main(argv: List[str] = None) -> int:
     floor = SEVERITIES.index(args.min_severity)
     shown = [d for d in diags if SEVERITIES.index(d.severity) >= floor]
     if args.as_json:
-        print(json.dumps([{
-            "code": d.code, "severity": d.severity, "message": d.message,
-            "hint": d.hint, "file": d.file, "line": d.line, "col": d.col,
-        } for d in shown], indent=2))
+        if args.hlo:
+            print(json.dumps(reports if len(reports) != 1 else reports[0],
+                             indent=2))
+        else:
+            print(json.dumps([_as_dict(d) for d in shown], indent=2))
     else:
         for d in shown:
             print(d)
+        if args.hlo:
+            for rep in reports:
+                sched = ", ".join(f"{k} x{n}" for k, n in
+                                  sorted(rep["collectives"].items())) or "none"
+                print(f"{rep['file']}: {rep['collective_count']} collective(s) "
+                      f"[{sched}], ~{rep['reshard_bytes']:,} bytes moved/device"
+                      f"/dispatch, memory floor {rep['memory_floor_bytes']:,} "
+                      f"bytes, schedule {rep['fingerprint'][:16]}")
         counts = {s: sum(1 for d in diags if d.severity == s) for s in SEVERITIES}
         summary = ", ".join(f"{n} {s}" for s, n in counts.items() if n) or "clean"
         print(f"checked {len(args.targets)} target(s): {summary}")

@@ -185,9 +185,37 @@ class _FunctionLinter:
 
 
 # ------------------------------------------------------------------ frontends
+_NOQA_RE = None  # compiled lazily (module import stays regex-free)
+
+
+def _noqa_lines(src: str):
+    """line number -> suppressed codes (None = all) from ``# noqa`` /
+    ``# noqa: PTA104,PTA102`` comments — the standard opt-out for host-side
+    code the linter cannot prove is never traced (e.g. a checkpoint-loading
+    loop inside a model file)."""
+    import re
+
+    global _NOQA_RE
+    if _NOQA_RE is None:
+        _NOQA_RE = re.compile(r"#\s*noqa(?::\s*([A-Z0-9, ]+))?", re.IGNORECASE)
+    out = {}
+    for lineno, line in enumerate(src.splitlines(), 1):
+        if "#" not in line:
+            continue
+        m = _NOQA_RE.search(line)
+        if not m:
+            continue
+        codes = m.group(1)
+        out[lineno] = (None if not codes
+                       else {c.strip().upper() for c in codes.split(",") if c.strip()})
+    return out
+
+
 def lint_source(src: str, filename: str = "<source>", offset: int = 0) -> List[Diagnostic]:
     """Lint every function defined in ``src``; module-level code is skipped
-    (it runs on the host exactly once and is never traced)."""
+    (it runs on the host exactly once and is never traced). A ``# noqa``
+    comment on the flagged line suppresses its findings (``# noqa: PTA104``
+    for one code, bare ``# noqa`` for all)."""
     try:
         tree = ast.parse(src)
     except SyntaxError as e:
@@ -198,6 +226,17 @@ def lint_source(src: str, filename: str = "<source>", offset: int = 0) -> List[D
     for node in ast.walk(tree):
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             _FunctionLinter(diags, filename, offset).lint(node)
+    noqa = _noqa_lines(src)
+    if noqa:
+        def suppressed(d: Diagnostic) -> bool:
+            if d.line is None:
+                return False
+            codes = noqa.get(d.line - offset)
+            if codes is None and (d.line - offset) not in noqa:
+                return False
+            return codes is None or d.code in codes
+
+        diags = [d for d in diags if not suppressed(d)]
     diags.sort(key=lambda d: (d.line or 0, d.col or 0, d.code))
     return diags
 

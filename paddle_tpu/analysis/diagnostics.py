@@ -9,6 +9,8 @@ output. Here every finding is a structured :class:`Diagnostic` with a stable
 Code space:
   PTA0xx — Program IR passes (paddle_tpu.analysis.passes)
   PTA1xx — dy2static pre-flight AST lint (paddle_tpu.analysis.ast_lint)
+  PTA2xx — SPMD sharding analyzer over lowered programs
+           (paddle_tpu.analysis.spmd / analysis.hlo)
 """
 from __future__ import annotations
 

@@ -32,7 +32,13 @@ from .mp_layers import (  # noqa: F401
     VocabParallelEmbedding,
     get_rng_state_tracker,
 )
-from .auto_parallel import Engine, ProcessMesh, shard_op, shard_tensor  # noqa: F401
+from .auto_parallel import (  # noqa: F401
+    Engine,
+    ProcessMesh,
+    ShardingSpecError,
+    shard_op,
+    shard_tensor,
+)
 from .parallel import DataParallel, spawn  # noqa: F401
 from .pipeline import LayerDesc, PipelineLayer, SegmentLayers, SharedLayerDesc, spmd_pipeline  # noqa: F401
 from .recompute import recompute, remat  # noqa: F401

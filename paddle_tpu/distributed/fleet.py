@@ -144,6 +144,9 @@ class Fleet:
         step.state = jax.device_put(step.state, shardings)
         step._jit = jax.jit(step._step, donate_argnums=0, in_shardings=(shardings, batch_sharding), out_shardings=(shardings, None))
         step.state_shardings = shardings
+        # keep the TrainStep-internal copy in sync so the SPMD analyzer
+        # (FLAGS_shard_check / explain(analyze=True)) sees the param specs
+        step._state_shardings = shardings
         return step
 
     def shard_batch(self, *arrays):

@@ -69,6 +69,8 @@ define_flag("FLAGS_allocator_strategy", "auto_growth", "compat no-op: PJRT BFC a
 define_flag("FLAGS_remat_policy", "none", "default rematerialization policy for jit steps")
 define_flag("FLAGS_static_check", False, "run the paddle_tpu.analysis passes over each Program before its first compile in Executor.run; warnings are reported via the warnings module, error-severity diagnostics raise ProgramAnalysisError")
 define_flag("FLAGS_executor_donate", False, "Executor.run donates parameter and optimizer-state buffers to the compiled program on training runs (flat param memory; stale outside handles raise StaleHandleError)")
+define_flag("FLAGS_shard_check", False, "run the paddle_tpu.analysis.spmd PTA2xx passes over every lowered program once per new specialization (Executor.run, jit.TrainStep, inference.DecodeEngine, auto_parallel.Engine.prepare): implicit all-gathers, spec-mismatch reshards and decode-loop collectives warn with bytes-moved estimates, an HBM-budget overrun (FLAGS_hbm_budget_mb) raises ProgramAnalysisError before dispatch")
+define_flag("FLAGS_hbm_budget_mb", 0.0, "per-device memory budget in MiB for the PTA204 pre-flight: a lowered program whose XLA memory_analysis estimate exceeds this raises under FLAGS_shard_check before the first dispatch (0 = unlimited)")
 define_flag("FLAGS_compile_cache_dir", "", "persistent XLA compilation cache directory (jax_compilation_cache_dir): repeated runs of the same program skip recompiles. Env spelling: FLAGS_compile_cache_dir=/path (JAX's own JAX_COMPILATION_CACHE_DIR works too, but only this flag is visible to get_flags/set_flags)")
 
 

@@ -389,6 +389,22 @@ class DecodeEngine:
                 with _span("infer.compile"):
                     compiled, info = _introspect.aot_compile(jitfn, args)
                 entry = compiled if compiled is not None else jitfn
+                if compiled is not None:
+                    from ..framework.flags import flag as _flag
+
+                    if _flag("FLAGS_shard_check"):
+                        # serving pre-flight (PTA2xx) before the executable
+                        # is cached: PTA203 flags any collective compiled
+                        # into a decode program — the hot loop pays it per
+                        # generated token — and PTA204 budget overruns
+                        # abort before the request stream starts
+                        from ..analysis import spmd as _spmd
+
+                        report = _spmd.shard_check(
+                            compiled, component="infer", label=label,
+                            kind=which, options=_spmd.ShardCheckOptions(
+                                decode=which.startswith("decode")))
+                        info["spmd"] = report.summary()
                 self._compiled[sig] = entry
                 counter_inc("infer.compiles")
                 if compiled is not None and aot_cache.store(key, compiled):
@@ -670,11 +686,28 @@ class DecodeEngine:
             out[i, s0:] = r[:int(max_new_tokens)]
         return out
 
-    def explain(self) -> List[dict]:
+    def explain(self, analyze: bool = False) -> List[dict]:
         """Per-specialization cost rows (prefill buckets/chunks, prefix
         insert/extract, and the decode programs) captured at AOT compile —
-        render with ``observability.format_cost_table``."""
-        return list(self._specializations)
+        render with ``observability.format_cost_table``.
+
+        ``analyze=True`` attaches the SPMD analyzer verdict (PTA2xx) per
+        retained executable under ``"spmd"`` — decode programs are checked
+        with the PTA203 serving rule (any compiled-in collective fires per
+        generated token)."""
+        rows = [dict(r) for r in self._specializations]
+        if analyze:
+            from ..analysis import spmd as _spmd
+
+            for row, entry in zip(rows, list(self._compiled.values())):
+                if "spmd" in row or not hasattr(entry, "as_text"):
+                    continue
+                kind = str(row.get("kind", ""))
+                row["spmd"] = _spmd.analyze_compiled(
+                    entry, label=row.get("label", ""), kind=kind,
+                    options=_spmd.ShardCheckOptions(
+                        decode=kind.startswith("decode"))).summary()
+        return rows
 
     def cache_bytes(self) -> int:
         """Device bytes held by the preallocated K/V cache."""

@@ -359,6 +359,22 @@ class TrainStep:
             with _span("train_step.compile"):
                 compiled, info = _introspect.aot_compile(jitfn, (self.state, batch))
             entry = compiled if compiled is not None else jitfn
+            if compiled is not None:
+                from ..framework.flags import flag as _flag
+
+                if _flag("FLAGS_shard_check"):
+                    # SPMD pre-flight (PTA2xx) once per new specialization,
+                    # BEFORE the executable is cached or dispatched: budget/
+                    # divergence errors abort here, reshard findings warn
+                    from ..analysis import spmd as _spmd
+
+                    shardings = self._state_shardings
+                    psh = shardings.get("params") if isinstance(shardings, dict) else None
+                    report = _spmd.shard_check(
+                        compiled, component="train_step", label=label,
+                        kind=which, params=self.state.get("params"),
+                        param_shardings=psh)
+                    info["spmd"] = report.summary()
             self._compiled[sig] = entry
             counter_inc("train_step.compiles")
             info["label"] = label
@@ -444,13 +460,36 @@ class TrainStep:
                      k=k, seconds=sp.seconds)
         return {name: _wrap_tree(v) for name, v in metrics.items()}
 
-    def explain(self) -> list:
+    def explain(self, analyze: bool = False) -> list:
         """Per-specialization cost table: one row per compiled (kind,
         batch-shape) signature with the XLA ``cost_analysis``/
         ``memory_analysis`` captured at compile time (flops, bytes accessed,
         peak device memory, compile seconds). Render with
-        ``paddle_tpu.observability.format_cost_table``; bench.py prints it."""
-        return list(self._specializations)
+        ``paddle_tpu.observability.format_cost_table``; bench.py prints it.
+
+        ``analyze=True`` additionally runs the SPMD sharding analyzer
+        (paddle_tpu.analysis.spmd, PTA2xx) over each retained executable and
+        attaches its verdict under the row's ``"spmd"`` key (collective
+        counts, estimated reshard bytes, schedule fingerprint, findings) —
+        works whether or not ``FLAGS_shard_check`` was on at compile time.
+        """
+        rows = [dict(r) for r in self._specializations]
+        if analyze:
+            from ..analysis import spmd as _spmd
+
+            shardings = self._state_shardings
+            psh = shardings.get("params") if isinstance(shardings, dict) else None
+            # _compiled inserts exactly one entry per _specializations row,
+            # in the same order (an aval-drift fallback swaps the value for
+            # the plain jitfn, which has no retained HLO — skipped)
+            for row, entry in zip(rows, list(self._compiled.values())):
+                if "spmd" in row or not hasattr(entry, "as_text"):
+                    continue
+                row["spmd"] = _spmd.analyze_compiled(
+                    entry, label=row.get("label", ""), kind=row.get("kind", ""),
+                    params=self.state.get("params"),
+                    param_shardings=psh).summary()
+        return rows
 
     # -- interop -----------------------------------------------------------
     def sync_to_model(self):

@@ -42,7 +42,7 @@ class ErnieEmbeddings(BertEmbeddings):
         super().__init__(cfg)
         self.task_type_embeddings = None
         if cfg.use_task_id:
-            self.task_type_embeddings = nn.Embedding(
+            self.task_type_embeddings = nn.Embedding(  # noqa: PTA104 (host-side, never traced)
                 cfg.task_type_vocab_size, cfg.hidden_size,
                 weight_attr=I.Normal(0.0, cfg.initializer_range))
 

@@ -114,6 +114,40 @@ def analyze(events: List[dict]) -> dict:
     reqs = [ev for ev in events if ev.get("event") == "request"]
     if reqs:
         out["serving"] = _analyze_serving(reqs)
+    # sharding-analysis section from the SPMD analyzer's shard_check events
+    # (FLAGS_shard_check: one per analyzed specialization)
+    checks = [ev for ev in events if ev.get("event") == "shard_check"]
+    if checks:
+        kinds: dict = defaultdict(int)
+        codes: dict = defaultdict(int)
+        for ev in checks:
+            for k, n in (ev.get("collectives") or {}).items():
+                kinds[k] += int(n)
+            for c in ev.get("codes") or []:
+                codes[c] += 1
+        sev = defaultdict(int)
+        for ev in checks:
+            for s, n in (ev.get("diagnostics") or {}).items():
+                sev[s] += int(n)
+        peak = [ev["peak_bytes"] for ev in checks
+                if isinstance(ev.get("peak_bytes"), (int, float))]
+        out["sharding"] = {
+            "programs_checked": len(checks),
+            "collectives": dict(sorted(kinds.items())),
+            "reshard_bytes_total": sum(int(ev.get("reshard_bytes") or 0)
+                                       for ev in checks),
+            "peak_bytes_max": max(peak) if peak else None,
+            "diagnostics": dict(sev),
+            "codes": dict(sorted(codes.items())),
+            "programs": [{
+                "label": ev.get("label"), "kind": ev.get("kind"),
+                "component": ev.get("component"),
+                "collectives": ev.get("collectives"),
+                "reshard_bytes": ev.get("reshard_bytes"),
+                "peak_bytes": ev.get("peak_bytes"),
+                "codes": ev.get("codes"),
+            } for ev in checks],
+        }
     # kernel-selection section from the ops registry's kernel_select events
     # (one per distinct call signature: picked = a real kernel won,
     # fallback = the XLA composite served)
@@ -269,6 +303,26 @@ def print_report(path: str, a: dict) -> None:
             print(f"    prefill stall: p50 {stall['p50_seconds'] * 1e3:.2f} ms   "
                   f"p99 {stall['p99_seconds'] * 1e3:.2f} ms   "
                   f"total {stall['total_seconds']:.4f}s")
+    sh = a.get("sharding")
+    if sh:
+        print("  sharding analysis (SPMD PTA2xx pre-flight, FLAGS_shard_check):")
+        kinds = "  ".join(f"{k} x{n}" for k, n in sh["collectives"].items()) or "none"
+        print(f"    programs checked: {sh['programs_checked']}   "
+              f"collectives: {kinds}")
+        line = (f"    est. reshard bytes/dispatch: "
+                f"{sh['reshard_bytes_total']:,}")
+        if sh.get("peak_bytes_max") is not None:
+            line += (f"   peak per-device memory: "
+                     f"{sh['peak_bytes_max'] / (1 << 20):.1f} MiB")
+        print(line)
+        dg = sh.get("diagnostics", {})
+        if any(dg.values()):
+            codes = "  ".join(f"{c} x{n}" for c, n in sh["codes"].items())
+            print(f"    findings: {dg.get('error', 0)} error(s), "
+                  f"{dg.get('warning', 0)} warning(s), "
+                  f"{dg.get('info', 0)} info   [{codes}]")
+        else:
+            print("    findings: clean")
     ks = a.get("kernels")
     if ks:
         print("  kernel selection (ops registry, one row per kernel):")

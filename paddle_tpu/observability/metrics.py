@@ -172,6 +172,14 @@ KERNEL_COUNTERS: Tuple[str, ...] = (
     "kernels.moe.picked", "kernels.moe.fallback",
 )
 
+# SPMD sharding analyzer (paddle_tpu.analysis.spmd, FLAGS_shard_check):
+# one shard_checks increment per analyzed specialization; diagnostics/
+# errors count findings, collectives counts the parsed schedule length.
+ANALYSIS_COUNTERS: Tuple[str, ...] = (
+    "analysis.shard_checks", "analysis.diagnostics",
+    "analysis.errors", "analysis.collectives",
+)
+
 
 # -------------------------------------------------------------------- gauges
 def gauge_set(name: str, value: float) -> None:

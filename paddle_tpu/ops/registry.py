@@ -37,6 +37,7 @@ from ..observability import runlog as _runlog
 __all__ = [
     "define_kernel", "register", "select", "dispatch", "kernels",
     "implementations", "kernel_table", "clear_cache", "KernelImpl",
+    "WATCHED_FLAGS",
 ]
 
 
@@ -72,6 +73,14 @@ class Kernel:
 
 _KERNELS: Dict[str, Kernel] = {}
 _CACHE: Dict[tuple, KernelImpl] = {}
+
+#: flags folded into EVERY kernel's selection-cache key (on top of the
+#: per-kernel ``flags`` watch list): the SPMD pre-flight runs once per
+#: compiled specialization, and kernel selection decides what gets compiled
+#: — a pick cached under the old FLAGS_shard_check/FLAGS_hbm_budget_mb
+#: values would skip the re-selection (and with it the fresh analyzer pass)
+#: after ``set_flags`` toggles them.
+WATCHED_FLAGS: Tuple[str, ...] = ("FLAGS_shard_check", "FLAGS_hbm_budget_mb")
 
 
 def define_kernel(name: str, flags: Tuple[str, ...] = (), cache_key: Optional[Callable] = None) -> Kernel:
@@ -143,6 +152,7 @@ def select(kernel: str, *args, **kwargs) -> KernelImpl:
         overrides,
         jax.default_backend(),
         tuple(flag(f) for f in k.flags),
+        tuple(flag(f) for f in WATCHED_FLAGS),
         k.cache_key() if k.cache_key is not None else None,
         tuple(_abstract(a) for a in args),
         tuple(sorted((kw, _abstract(v)) for kw, v in kwargs.items())),

@@ -209,7 +209,7 @@ class _RunPlan:
 
     __slots__ = ("fn", "params", "others", "train", "donate", "check",
                  "scope", "param_vars", "fetch_vars", "compiled", "cost",
-                 "label")
+                 "label", "spmd", "shard_error")
 
     def __init__(self, fn, params, others, train, donate, label="", check=False):
         self.fn = fn
@@ -224,6 +224,8 @@ class _RunPlan:
         self.compiled = None       # AOT XLA executable (set at first run)
         self.cost = None           # observability.cost_summary of `compiled`
         self.label = label         # human-readable specialization id
+        self.spmd = None           # FLAGS_shard_check verdict (SpmdReport summary)
+        self.shard_error = None    # sticky PTA2xx error: re-raised every run
 
     def bind_scope(self, gs, fetch_names):
         if self.scope is not gs:
@@ -399,6 +401,24 @@ class Executor:
                          flops=plan.cost.get("flops"),
                          bytes_accessed=plan.cost.get("bytes_accessed"),
                          peak_bytes=plan.cost.get("peak_bytes"))
+            if plan.compiled is not None and _flag("FLAGS_shard_check"):
+                # SPMD pre-flight (PTA2xx) over the lowered program, once
+                # per specialization like FLAGS_static_check: reshard/
+                # collective findings warn, an HBM-budget overrun raises
+                # before the first dispatch (and on every later run — the
+                # plan stays poisoned, not half-checked)
+                from ..analysis import ProgramAnalysisError as _PAErr
+                from ..analysis import spmd as _spmd
+
+                try:
+                    plan.spmd = _spmd.shard_check(
+                        plan.compiled, component="executor",
+                        label=plan.label, kind="executor").summary()
+                except _PAErr as e:
+                    plan.shard_error = e
+                    raise
+        if plan.shard_error is not None:
+            raise plan.shard_error
         with _span("executor.dispatch"):
             try:
                 fetched, buf_updates, new_params, new_state, finite = (
@@ -463,17 +483,32 @@ class Executor:
                     out.append(t)
         return out
 
-    def explain(self) -> List[dict]:
+    def explain(self, analyze: bool = False) -> List[dict]:
         """Per-specialization cost table for every cached compiled program:
         one row per :class:`_RunPlan` with the XLA ``cost_analysis``/
         ``memory_analysis`` captured at its compile (flops, bytes accessed,
         peak device memory, compile seconds). Render with
-        ``paddle_tpu.observability.format_cost_table``."""
+        ``paddle_tpu.observability.format_cost_table``.
+
+        ``analyze=True`` attaches the SPMD sharding analyzer's verdict
+        (PTA2xx: collective counts, reshard bytes, schedule fingerprint)
+        under each row's ``"spmd"`` key — reusing the ``FLAGS_shard_check``
+        result when the run already produced one, analyzing the retained
+        executable lazily otherwise."""
         rows = []
         for plan in self._cache.values():
             row = {"label": plan.label, "train": plan.train,
                    "donate": plan.donate}
             row.update(plan.cost or {})
+            if analyze:
+                if plan.spmd is not None:
+                    row["spmd"] = plan.spmd
+                elif plan.compiled is not None:
+                    from ..analysis import spmd as _spmd
+
+                    row["spmd"] = _spmd.analyze_compiled(
+                        plan.compiled, label=plan.label,
+                        kind="executor").summary()
             rows.append(row)
         return rows
 

@@ -24,6 +24,11 @@ from paddle_tpu.tensor._helpers import op as _op
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+# package-wide PTA10x finding ceiling for the whole-package self-check
+# (test_self_check_whole_package_ast_lint): the measured count when the
+# check landed. Raising it requires vetting the new findings first.
+PACKAGE_LINT_CEILING = 1100
+
 
 def _codes(diags):
     return [d.code for d in diags]
@@ -471,3 +476,50 @@ def test_self_check_examples_and_models_ast_lint():
         total.extend(lint_path(t))
     errors = [d for d in total if d.severity == "error"]
     assert not errors, format_report(errors)
+
+
+def test_lint_noqa_suppression():
+    """``# noqa`` on the flagged line suppresses findings: bare form all of
+    them, ``# noqa: CODE`` only that code."""
+    src = ("def f(x, lst):\n"
+           "    if x > 0:\n"
+           "        lst.append(x)  # noqa: PTA104\n"
+           "        lst[0] = 2  # noqa\n"
+           "        lst[1] = 3  # noqa: PTA101\n"
+           "    return lst\n")
+    diags = lint_source(src, "demo.py")
+    assert _codes(diags) == ["PTA104"] and diags[0].line == 5
+    # offset-aware: lint_function reports defining-file line numbers and the
+    # suppression must follow them
+    def g(x, lst):  # pragma: no cover - linted, not run
+        if x > 0:
+            lst.append(x)  # noqa: PTA104
+        return lst
+
+    assert "PTA104" not in _codes(lint_function(g))
+
+
+def test_self_check_whole_package_ast_lint():
+    """Tier-1 package self-check: AST-lint ALL of paddle_tpu/ (not just
+    examples+models).
+
+    The bar: zero error-severity findings anywhere; the traced model
+    surface (paddle_tpu/models/) completely clean (its former PTA10x hits
+    were fixed or ``# noqa``-annotated as host-side code); and a ratchet on
+    the total finding count — if this assertion fires on new code, fix the
+    construct or suppress it with ``# noqa: PTA1xx`` plus a short reason
+    (see README "Static analysis").
+    """
+    pkg = os.path.join(REPO, "paddle_tpu")
+    diags = lint_path(pkg)
+    errors = [d for d in diags if d.severity == "error"]
+    assert not errors, format_report(errors)
+    model_dir = os.path.join(pkg, "models") + os.sep
+    model_hits = [d for d in diags if (d.file or "").startswith(model_dir)]
+    assert not model_hits, format_report(model_hits)
+    # ratchet: the measured package-wide count at the time this check
+    # landed. New findings above the ceiling mean new unvetted constructs.
+    assert len(diags) <= PACKAGE_LINT_CEILING, (
+        f"{len(diags)} PTA10x findings (ceiling {PACKAGE_LINT_CEILING}): "
+        "new hits must be fixed or '# noqa: PTA1xx'-annotated\n"
+        + format_report(diags[-25:]))
