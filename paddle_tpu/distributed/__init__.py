@@ -56,3 +56,7 @@ from .resilience import (  # noqa: F401
     run_resilient,
     watchdog,
 )
+from . import converter  # noqa: F401
+from . import planner  # noqa: F401
+from .converter import CheckpointConversionError  # noqa: F401
+from .planner import Plan, PlannerError  # noqa: F401

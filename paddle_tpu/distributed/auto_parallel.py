@@ -190,7 +190,7 @@ class Engine:
         PTA2xx) BEFORE any batch is dispatched — the verdict lands on
         ``self.shard_report`` (reshard bytes, collective schedule,
         per-device memory), budget overruns raise here."""
-        from ..distributed.sharding import state_shardings
+        from ..distributed.sharding import place_state, state_shardings
         from ..framework.flags import flag as _flag
         from ..jit import TrainStep
 
@@ -199,7 +199,7 @@ class Engine:
         step = TrainStep(self.model, self.optimizer, self.loss)
         if mesh is not None:
             shardings = state_shardings(step.state, mesh, stage=0, mp_specs=mp_specs)
-            step.state = jax.device_put(step.state, shardings)
+            step.state = place_state(step.state, shardings)
             step._jit = jax.jit(step._step, donate_argnums=0, in_shardings=(shardings, None), out_shardings=(shardings, None))
             step.mesh = mesh
             step.state_shardings = shardings
@@ -217,23 +217,15 @@ class Engine:
         candidate mesh/spec assignment gets its machine-readable verdict
         from shapes alone."""
         from ..analysis import spmd as _spmd
+        from .planner import abstract_inputs
 
         mesh = self.process_mesh.jax_mesh if self.process_mesh else None
-
-        def structs(specs):
-            specs = specs if isinstance(specs, (list, tuple)) else [specs]
-            out = []
-            for s in specs:
-                # dynamic (None/-1) dims need a concrete probe extent; the
-                # mesh size divides every axis product by construction
-                fill = int(mesh.size) if mesh is not None else 1
-                shape = tuple(int(d) if (d is not None and int(d) > 0) else fill
-                              for d in s.shape)
-                out.append(jax.ShapeDtypeStruct(shape, np.dtype(getattr(s, "dtype", "float32"))))
-            return tuple(out)
-
-        batch = (structs(inputs_spec),
-                 structs(labels_spec if labels_spec is not None else inputs_spec))
+        # dynamic (None/-1) dims need a concrete probe extent; the mesh size
+        # divides every axis product by construction
+        fill = int(mesh.size) if mesh is not None else 1
+        batch = (abstract_inputs(inputs_spec, fill),
+                 abstract_inputs(labels_spec if labels_spec is not None
+                                 else inputs_spec, fill))
         step = self._step
         from ..observability.introspect import aot_compile
 
@@ -246,6 +238,21 @@ class Engine:
             compiled, component="auto_parallel", label="engine.prepare",
             kind="train", params=step.state.get("params"),
             param_shardings=psh)
+
+    def plan(self, n_devices=None, inputs_spec=None, labels_spec=None, **kw):
+        """Rank parallel plans for this engine's model (the auto-search the
+        reference Engine runs under ``strategy.auto_mode``): delegates to
+        :func:`paddle_tpu.distributed.planner.search` with the engine's
+        model/loss/optimizer. ``n_devices`` defaults to the engine's mesh
+        size (or every visible device)."""
+        from . import planner as _planner
+
+        if n_devices is None:
+            n_devices = (int(self.process_mesh.jax_mesh.size)
+                         if self.process_mesh else len(jax.devices()))
+        return _planner.search(self.model, n_devices, inputs_spec=inputs_spec,
+                               labels_spec=labels_spec, loss=self.loss,
+                               optimizer=self.optimizer, **kw)
 
     def fit(self, train_data, epochs=1, batch_size=None, steps_per_epoch=None, log_freq=10, verbose=0):
         if self._step is None:

@@ -141,7 +141,12 @@ class Fleet:
             # default: every batch leaf sharded on dim0 over the data axes
             batch_sharding = NamedSharding(mesh, P(("dp", "sdp")))
         step.mesh = mesh
-        step.state = jax.device_put(step.state, shardings)
+        # place_state (not bare device_put): placement must own fresh
+        # buffers, or the donated step deletes the model's own arrays
+        # through an aliased replicated shard
+        from .sharding import place_state
+
+        step.state = place_state(step.state, shardings)
         step._jit = jax.jit(step._step, donate_argnums=0, in_shardings=(shardings, batch_sharding), out_shardings=(shardings, None))
         step.state_shardings = shardings
         # keep the TrainStep-internal copy in sync so the SPMD analyzer

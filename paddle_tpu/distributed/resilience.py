@@ -144,13 +144,28 @@ class CheckpointManager:
                        ) -> Optional[Tuple[Any, int]]:
         """(state, step) from the newest checkpoint that passes
         verification, walking backwards past corrupt/truncated ones;
-        None when no valid checkpoint exists."""
+        None when no valid checkpoint exists.
+
+        ``target``/``shardings`` need NOT match the sharding the checkpoint
+        was saved under: after CRC verification the state is routed through
+        :mod:`~paddle_tpu.distributed.converter` — host gather, then one
+        ``device_put`` per leaf under the new ``NamedSharding`` — so a
+        checkpoint written on mesh A restores onto mesh B (elastic
+        scale-up/down, the reference converter.py capability). A target the
+        checkpoint *cannot* convert to (shape/dtype/structure drift) raises
+        :class:`~.converter.CheckpointConversionError` naming the first
+        mismatched leaf — that is a caller bug, not corruption, so it
+        propagates instead of falling back to an older checkpoint."""
+        from .converter import CheckpointConversionError
+
         with _span("checkpoint.restore") as sp:
             result = None
             for step in reversed(self.steps()):
                 try:
                     result = self._load_verified(step, target, shardings), step
                     break
+                except CheckpointConversionError:
+                    raise
                 except Exception as exc:
                     print(f"[resilience] checkpoint step {step} invalid "
                           f"({type(exc).__name__}: {exc}); falling back",
@@ -168,8 +183,17 @@ class CheckpointManager:
             raise CheckpointCorruption(f"{d}: no manifest (interrupted save)")
         with open(mpath) as f:
             manifest = json.load(f)
-        state = ckpt_mod.load_state(os.path.join(d, "state"),
-                                    target=target, shardings=shardings)
+        # raw restore first: the CRC is computed over the same bytes the
+        # manifest recorded at save time (placement-independent), THEN the
+        # verified state converts onto the requested target/shardings
+        import warnings as _warnings
+
+        with _warnings.catch_warnings():
+            # orbax warns that a raw restore re-reads the saved sharding
+            # file; the converter re-places every leaf right after, so the
+            # saved placement is irrelevant here
+            _warnings.filterwarnings("ignore", message=".*sharding info.*")
+            state = ckpt_mod.load_state(os.path.join(d, "state"))
         got = ckpt_mod.checksum_pytree(state)
         want = manifest["leaves"]
         bad = sorted(k for k in set(want) | set(got)
@@ -177,6 +201,12 @@ class CheckpointManager:
         if bad:
             raise CheckpointCorruption(
                 f"{d}: checksum mismatch for {bad} (on-disk corruption)")
+        if target is not None or shardings is not None:
+            from . import converter as _converter
+
+            state = _converter.convert(state, target=target,
+                                       shardings=shardings,
+                                       label=f"step_{step:08d}")
         return state
 
     # ----------------------------------------------------------- rotation
@@ -324,6 +354,8 @@ def run_resilient(train_step_fn: Callable[[Any, int, List[int]], Any], *,
                   settle: float = 0.5, deadline: float = 60.0,
                   membership_check_every: int = 1,
                   on_event: Optional[Callable[[str, dict], None]] = None,
+                  shardings: Optional[Any] = None,
+                  on_rescale: Optional[Callable[[List[int], Any], Any]] = None,
                   ) -> Tuple[Any, int]:
     """Supervised elastic training loop: detect, checkpoint, rescale, resume.
 
@@ -336,8 +368,20 @@ def run_resilient(train_step_fn: Callable[[Any, int, List[int]], Any], *,
       HOLD      stop stepping; checkpoint in-progress state at once
       SETTLE    ``node.wait_for(min_nodes, max_nodes, settle)`` until the
                 alive set is stable inside the allowed range
-      RESUME    restore the newest valid checkpoint and continue from its
-                step with the rescaled membership
+      RESCALE   when the settled membership differs and ``on_rescale`` is
+                given: ``on_rescale(members, state)`` re-plans for the new
+                topology (e.g. ``planner.elastic_replan`` — searches the
+                plan cache, builds the new sharded TrainStep and compiles
+                it NOW, inside the HOLD window) and returns the new
+                ``(restore_target, restore_shardings)`` pair
+      RESUME    restore the newest valid checkpoint — resharded through
+                the converter onto the (possibly new) target/shardings —
+                and continue from its step with the rescaled membership
+
+    ``shardings`` places the initial restore (same semantics as
+    :meth:`CheckpointManager.restore_latest`). A topology change without
+    ``on_rescale`` keeps the old target — same-topology behavior is
+    unchanged.
 
     A :class:`paddle_tpu.stability.DivergenceFault` (raised by a
     ``HealthMonitor`` inside ``train_step_fn``) follows the same protocol
@@ -351,7 +395,9 @@ def run_resilient(train_step_fn: Callable[[Any, int, List[int]], Any], *,
     members = node.wait_for(min_nodes, max_nodes, settle=settle,
                             deadline=deadline)
     state, step = init_state, 0
-    restored = manager.restore_latest(target=init_state)
+    restore_target, restore_shardings = init_state, shardings
+    restored = manager.restore_latest(target=restore_target,
+                                      shardings=restore_shardings)
     if restored is not None:
         state, step = restored
     restarts = 0
@@ -399,7 +445,20 @@ def run_resilient(train_step_fn: Callable[[Any, int, List[int]], Any], *,
             members = node.wait_for(min_nodes, max_nodes, settle=settle,
                                     deadline=deadline)
             _membership_events(prev_members, members, step)
-            restored = manager.restore_latest(target=state)
+            if on_rescale is not None and members != prev_members:
+                # elastic re-plan during the HOLD window: the hook searches/
+                # builds for the new topology (compiling the new mesh's
+                # program now, while nothing else runs) and hands back the
+                # target+shardings the checkpoint should reshard onto
+                rescaled = on_rescale(members, state)
+                if rescaled is not None:
+                    if isinstance(rescaled, tuple):
+                        restore_target, restore_shardings = rescaled
+                    else:
+                        restore_target = rescaled
+                    state = restore_target
+            restored = manager.restore_latest(target=restore_target,
+                                              shardings=restore_shardings)
             if restored is not None:
                 state, step = restored
             _emit("resume", step=step, members=members, restart=restarts)

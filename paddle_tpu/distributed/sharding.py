@@ -135,6 +135,31 @@ def state_shardings(state, mesh: Mesh, stage: int = 1, mp_specs=None, offload=Fa
     }
 
 
+def place_state(state, shardings):
+    """``jax.device_put(state, shardings)`` without buffer aliasing.
+
+    A plain ``device_put`` may *reuse* the source buffer as one shard of
+    the placed array (replicated leaves on the source device). A TrainStep
+    then donates that buffer on its first dispatch — deleting the model's
+    own parameter array out from under any later rebuild
+    (``planner.build_step`` during an elastic re-plan reads
+    ``model.param_arrays()`` again). Round-tripping through host bytes
+    guarantees the placed state owns fresh buffers. Typed PRNG keys (no
+    numpy spelling) go through a plain ``device_put`` — they are created
+    fresh per TrainStep, so nothing else holds their buffer.
+    """
+    import jax
+
+    def fresh(leaf, sh):
+        try:
+            host = np.asarray(jax.device_get(leaf))
+        except TypeError:  # extended dtype: typed PRNG key
+            return jax.device_put(leaf, sh)
+        return jax.device_put(host, sh)
+
+    return jax.tree_util.tree_map(fresh, state, shardings)
+
+
 def group_sharded_parallel(model, optimizer, level="os_g", scaler=None, group=None, offload=False, sync_buffers=False, buffer_max_size=2**23, segment_size=2**20, sync_comm=False):
     """API parity (python/paddle/distributed/sharding/group_sharded.py).
     level: 'os' (stage1) | 'os_g' (stage2) | 'p_g_os' (stage3). Returns the

@@ -1,4 +1,4 @@
-"""Persist AOT-compiled serving executables across process restarts.
+"""Persist AOT-compiled executables across process restarts.
 
 A restarted engine pays the full prefill/decode compile family again before
 it can serve its first token — the ROADMAP restart-latency leftover. When
@@ -10,6 +10,13 @@ fingerprint, jax version, backend) specialization. A fresh engine with the
 same specialization loads the executable instead of recompiling: restart
 ``time_to_first_token`` drops to deserialize+dispatch cost
 (bench_serve.py reports it as ``restart_ttft``).
+
+The same store serves *training*: ``TrainStep`` and the static ``Executor``
+round-trip their compiled step programs through ``<dir>/train_step/`` and
+``<dir>/executor/`` (see ``observability.introspect.aot_compile``'s
+``cache_scope``), keyed on the lowered program text — a warm restart (or an
+elastic resume onto a mesh the planner already evaluated) skips straight to
+dispatch, which is what cuts ``time_to_first_step``.
 
 Everything here is best-effort: backends without executable serialization,
 version drift, or a corrupt file all degrade to the normal compile path —
@@ -29,15 +36,15 @@ __all__ = ["cache_dir", "make_key", "load", "store"]
 _FORMAT = "aotc-v1"
 
 
-def cache_dir() -> Optional[Path]:
-    """The serving executable cache directory, or None when the
-    ``FLAGS_compile_cache_dir`` flag is unset."""
+def cache_dir(scope: str = "serving") -> Optional[Path]:
+    """The executable cache directory for ``scope`` (serving / train_step /
+    executor / ...), or None when ``FLAGS_compile_cache_dir`` is unset."""
     from ..framework.flags import flag
 
     d = flag("FLAGS_compile_cache_dir")
     if not d:
         return None
-    return Path(str(d)) / "serving"
+    return Path(str(d)) / scope
 
 
 def make_key(kind: str, sig: Any, fingerprint: str) -> str:
@@ -53,10 +60,10 @@ def make_key(kind: str, sig: Any, fingerprint: str) -> str:
     return hashlib.sha256(payload.encode()).hexdigest()[:32]
 
 
-def load(key: str):
+def load(key: str, scope: str = "serving"):
     """Deserialize + load the executable stored under ``key``; None on any
     miss or failure (caller compiles normally)."""
-    d = cache_dir()
+    d = cache_dir(scope)
     if d is None:
         return None
     path = d / f"{key}.aotc"
@@ -71,11 +78,11 @@ def load(key: str):
         return None
 
 
-def store(key: str, compiled) -> bool:
+def store(key: str, compiled, scope: str = "serving") -> bool:
     """Serialize ``compiled`` (an XLA ``Compiled`` from ``lower().compile()``)
     under ``key``. False (and no file) when the backend can't serialize
     executables or the directory is unwritable."""
-    d = cache_dir()
+    d = cache_dir(scope)
     if d is None:
         return False
     tmp = None

@@ -357,7 +357,13 @@ class TrainStep:
             label = which + "/" + ",".join(
                 f"{d}{list(s)}" for s, d in sig[1:5])  # first few batch leaves
             with _span("train_step.compile"):
-                compiled, info = _introspect.aot_compile(jitfn, (self.state, batch))
+                # FLAGS_compile_cache_dir: the compiled step round-trips
+                # through the on-disk AOT store keyed on the lowered program
+                # text — a warm restart (or an elastic resume onto a mesh
+                # the planner already evaluated during HOLD) loads the
+                # executable instead of recompiling
+                compiled, info = _introspect.aot_compile(
+                    jitfn, (self.state, batch), cache_scope="train_step")
             entry = compiled if compiled is not None else jitfn
             if compiled is not None:
                 from ..framework.flags import flag as _flag
@@ -376,12 +382,18 @@ class TrainStep:
                         param_shardings=psh)
                     info["spmd"] = report.summary()
             self._compiled[sig] = entry
-            counter_inc("train_step.compiles")
+            if info.get("from_disk_cache"):
+                counter_inc("train_step.aot_cache_hits")
+            else:
+                counter_inc("train_step.compiles")
+                if info.get("aot_cache_stored"):
+                    counter_inc("train_step.aot_cache_stores")
             info["label"] = label
             info["kind"] = which
             self._specializations.append(info)
             _runlog.emit("compile", component="train_step", label=label,
                          seconds=info.get("compile_seconds"),
+                         cached=bool(info.get("from_disk_cache")),
                          flops=info.get("flops"),
                          bytes_accessed=info.get("bytes_accessed"),
                          peak_bytes=info.get("peak_bytes"))

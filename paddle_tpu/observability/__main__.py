@@ -15,7 +15,11 @@ Prints, from one structured run log (see :mod:`.runlog`):
   ``request`` events (the continuous-batching scheduler's stream),
 - a kernel-selection section (picked vs fallback per registry kernel, with
   the per-implementation breakdown) when the run produced
-  ``kernel_select`` events (the ops kernel registry's stream).
+  ``kernel_select`` events (the ops kernel registry's stream),
+- an auto-parallel planner section (searches, plan-cache hits, candidate/
+  pruned counts, search time, the last chosen plan, and cross-mesh
+  checkpoint-reshard totals) when the run produced ``plan`` or ``reshard``
+  events (distributed/planner.py + converter.py).
 
 ``--json`` emits the same analysis as one JSON object for tooling.
 """
@@ -148,6 +152,32 @@ def analyze(events: List[dict]) -> dict:
                 "codes": ev.get("codes"),
             } for ev in checks],
         }
+    # auto-parallel planner section from plan (search) + reshard
+    # (cross-mesh checkpoint conversion) events
+    plan_evs = [ev for ev in events if ev.get("event") == "plan"]
+    reshard_evs = [ev for ev in events if ev.get("event") == "reshard"]
+    if plan_evs or reshard_evs:
+        planner = {
+            "searches": len(plan_evs),
+            "cache_hits": sum(1 for ev in plan_evs if ev.get("cached")),
+            "candidates": sum(int(ev.get("candidates") or 0) for ev in plan_evs),
+            "pruned": sum(int(ev.get("pruned") or 0) for ev in plan_evs),
+            "search_ms_total": sum(float(ev.get("search_ms") or 0.0)
+                                   for ev in plan_evs),
+        }
+        chosen = [ev.get("chosen") for ev in plan_evs if ev.get("chosen")]
+        if chosen:
+            planner["last_chosen"] = {  # noqa: PTA104 (host-side, never traced)
+                k: chosen[-1].get(k) for k in
+                ("label", "predicted_step_ms", "comm_bytes", "peak_bytes",
+                 "feasible")}
+        if reshard_evs:
+            planner["reshards"] = len(reshard_evs)  # noqa: PTA104 (host-side, never traced)
+            planner["reshard_bytes"] = sum(int(ev.get("bytes") or 0)  # noqa: PTA104 (host-side, never traced)
+                                           for ev in reshard_evs)
+            planner["reshard_seconds"] = sum(float(ev.get("seconds") or 0.0)  # noqa: PTA104 (host-side, never traced)
+                                             for ev in reshard_evs)
+        out["planner"] = planner  # noqa: PTA104 (host-side, never traced)
     # kernel-selection section from the ops registry's kernel_select events
     # (one per distinct call signature: picked = a real kernel won,
     # fallback = the XLA composite served)
@@ -323,6 +353,22 @@ def print_report(path: str, a: dict) -> None:
                   f"{dg.get('info', 0)} info   [{codes}]")
         else:
             print("    findings: clean")
+    pl = a.get("planner")
+    if pl:
+        print("  auto-parallel planner (plan search + elastic reshard):")  # noqa: PTA105 (host-side report printer)
+        print(f"    searches: {pl['searches']} ({pl['cache_hits']} from the "  # noqa: PTA105 (host-side report printer)
+              f"plan cache)   candidates: {pl['candidates']}   pruned: "
+              f"{pl['pruned']}   search time: {pl['search_ms_total']:.1f} ms")
+        ch = pl.get("last_chosen")
+        if ch:
+            pred = ch.get("predicted_step_ms")
+            print(f"    chosen: {ch.get('label')}"  # noqa: PTA105 (host-side report printer)
+                  + (f"   predicted {pred:.3f} ms/step" if pred else "")
+                  + f"   comm {int(ch.get('comm_bytes') or 0):,} B/step")
+        if pl.get("reshards"):
+            print(f"    checkpoint reshards: {pl['reshards']}   "  # noqa: PTA105 (host-side report printer)
+                  f"{pl['reshard_bytes']:,} bytes in "
+                  f"{pl['reshard_seconds']:.4f}s")
     ks = a.get("kernels")
     if ks:
         print("  kernel selection (ops registry, one row per kernel):")

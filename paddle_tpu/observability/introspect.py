@@ -23,6 +23,56 @@ from ..framework import jax_compat
 
 __all__ = ["cost_summary", "aot_compile", "format_cost_table"]
 
+_PARTITION_RE = None
+
+# in-process executable memo behind FLAGS_compile_cache_dir: (scope, text
+# hash) -> (Compiled, info). The cross-mesh warm-start store — the planner
+# compiles candidate programs during the elastic HOLD window and the
+# resumed TrainStep (same process) dispatches the memoized executable with
+# zero recompile. Bounded; single-device programs ALSO persist to disk.
+_EXEC_MEMO: dict = {}
+_EXEC_MEMO_CAP = 8
+
+
+def _no_persistent_compile_cache():
+    """Context: jax's persistent compilation cache off for one compile.
+    Serializing multi-device CPU executables corrupts the heap on this jax
+    build — the cache must only see single-device programs."""
+    import contextlib
+
+    import jax
+
+    @contextlib.contextmanager
+    def cm():
+        current = jax.config.jax_compilation_cache_dir
+        if not current:
+            yield
+            return
+        jax.config.update("jax_compilation_cache_dir", None)
+        try:
+            yield
+        finally:
+            jax.config.update("jax_compilation_cache_dir", current)
+
+    return cm()
+
+
+def _is_single_device(lowered_text: str) -> bool:
+    """True when the lowered StableHLO module targets one device
+    (``mhlo.num_partitions * mhlo.num_replicas == 1``); unknown counts as
+    multi-device (conservative — skips executable serialization)."""
+    global _PARTITION_RE  # noqa: PTA105 (host-side, never traced)
+    if _PARTITION_RE is None:
+        import re
+
+        _PARTITION_RE = re.compile(
+            r"mhlo\.num_(partitions|replicas)\s*=\s*(\d+)")
+    found = {m.group(1): int(m.group(2))
+             for m in _PARTITION_RE.finditer(lowered_text[:4096])}
+    if not found:
+        return False
+    return found.get("partitions", 1) * found.get("replicas", 1) == 1
+
 
 def cost_summary(compiled) -> Dict[str, Any]:
     """Normalized cost/memory analysis of one XLA ``Compiled`` executable.
@@ -50,7 +100,8 @@ def cost_summary(compiled) -> Dict[str, Any]:
     }
 
 
-def aot_compile(jitfn, args: Tuple) -> Tuple[Optional[Any], Dict[str, Any]]:
+def aot_compile(jitfn, args: Tuple,
+                cache_scope: Optional[str] = None) -> Tuple[Optional[Any], Dict[str, Any]]:
     """Lower + compile ``jitfn`` on ``args`` through the AOT path.
 
     Returns ``(compiled, info)`` where ``compiled`` is the callable XLA
@@ -58,15 +109,87 @@ def aot_compile(jitfn, args: Tuple) -> Tuple[Optional[Any], Dict[str, Any]]:
     ``info`` is :func:`cost_summary` plus ``compile_seconds``. On any
     failure returns ``(None, {...})`` so callers fall back to the plain
     jitted call — introspection must never break dispatch.
+
+    With ``cache_scope`` (and ``FLAGS_compile_cache_dir`` set), the
+    executable round-trips through the on-disk AOT store
+    (``inference.aot_cache``) under ``<dir>/<cache_scope>/``, keyed on the
+    *lowered program text* — identical trace, identical executable, no
+    fingerprint guessing. A hit skips the XLA compile entirely
+    (``info["from_disk_cache"] = True``); a fresh compile is serialized
+    back (``info["aot_cache_stored"] = True``) so the next process restart
+    — or an elastic resume onto a mesh the planner already evaluated —
+    starts warm. Best-effort like everything here: serialization failures
+    degrade to the normal compile.
     """
+    import jax
+
     t0 = time.perf_counter()
     try:
-        compiled = jitfn.lower(*args).compile()
+        lowered = jitfn.lower(*args)
     except Exception as exc:  # AOT unsupported for this fn/args shape
+        return None, {"compile_seconds": time.perf_counter() - t0,
+                      "aot_error": f"{type(exc).__name__}: {exc}"}
+    # Executable serialization is only trusted for SINGLE-device programs:
+    # serializing a multi-device CPU executable (ours via
+    # serialize_executable, jax's via the persistent compilation cache)
+    # corrupts the process heap on this jax build. Multi-device warm starts
+    # come from the in-process memo instead — the planner compiles the
+    # winning program during the elastic HOLD window, same process.
+    text = None
+    single_device = None
+    persistent_cache_on = bool(jax.config.jax_compilation_cache_dir)
+    if persistent_cache_on or cache_scope is not None:
+        try:
+            text = lowered.as_text()
+            single_device = _is_single_device(text)
+        except Exception:
+            text = None
+    key = None
+    if cache_scope is not None and text is not None:
+        from ..inference import aot_cache
+
+        if aot_cache.cache_dir(cache_scope) is not None:
+            memo = _EXEC_MEMO.get((cache_scope, text))
+            if memo is not None:
+                compiled, info = memo
+                info = dict(info)
+                info["compile_seconds"] = time.perf_counter() - t0  # noqa: PTA104 (host-side, never traced)
+                info["from_memory_cache"] = True  # noqa: PTA104 (host-side, never traced)
+                info["from_disk_cache"] = True  # same counter semantics  # noqa: PTA104 (host-side, never traced)
+                return compiled, info
+            if single_device:
+                key = aot_cache.make_key(cache_scope, text, "")
+                loaded = aot_cache.load(key, scope=cache_scope)
+                if loaded is not None:
+                    try:
+                        info = cost_summary(loaded)
+                    except Exception:
+                        info = {}
+                    info["compile_seconds"] = time.perf_counter() - t0  # noqa: PTA104 (host-side, never traced)
+                    info["from_disk_cache"] = True  # noqa: PTA104 (host-side, never traced)
+                    return loaded, info
+        else:
+            cache_scope = None  # no cache dir: skip memo insertion too
+    try:
+        if single_device is False and persistent_cache_on:
+            with _no_persistent_compile_cache():
+                compiled = lowered.compile()
+        else:
+            compiled = lowered.compile()
+    except Exception as exc:
         return None, {"compile_seconds": time.perf_counter() - t0,
                       "aot_error": f"{type(exc).__name__}: {exc}"}
     info = cost_summary(compiled)
     info["compile_seconds"] = time.perf_counter() - t0
+    if key is not None:
+        from ..inference import aot_cache
+
+        if aot_cache.store(key, compiled, scope=cache_scope):
+            info["aot_cache_stored"] = True  # noqa: PTA104 (host-side, never traced)
+    if cache_scope is not None and text is not None:
+        _EXEC_MEMO[(cache_scope, text)] = (compiled, dict(info))  # noqa: PTA104 (host-side, never traced)
+        while len(_EXEC_MEMO) > _EXEC_MEMO_CAP:
+            _EXEC_MEMO.pop(next(iter(_EXEC_MEMO)))  # noqa: PTA104 (host-side, never traced)
     return compiled, info
 
 
@@ -86,10 +209,10 @@ def format_cost_table(rows: List[dict], title: str = "specialization") -> str:
     body = []
     for row in rows:
         cells = [str(row.get("label", row.get("key", "?")))]
-        for field, _, scale in _COLUMNS:
+        for field, _, scale in _COLUMNS:  # noqa: PTA102 (host-side, never traced)
             v = row.get(field)
-            cells.append("-" if v is None else f"{v / scale:.3f}")
-        body.append(cells)
+            cells.append("-" if v is None else f"{v / scale:.3f}")  # noqa: PTA104 (host-side, never traced)
+        body.append(cells)  # noqa: PTA104 (host-side, never traced)
     widths = [max(len(r[i]) for r in [header] + body) for i in range(len(header))]
     fmt = "  ".join(f"{{:<{w}}}" for w in widths)
     lines = [fmt.format(*header), fmt.format(*["-" * w for w in widths])]

@@ -180,6 +180,21 @@ ANALYSIS_COUNTERS: Tuple[str, ...] = (
     "analysis.errors", "analysis.collectives",
 )
 
+# Auto-parallel planner + checkpoint converter + AOT training-executable
+# cache (distributed/planner.py, distributed/converter.py,
+# introspect.aot_compile cache_scope): evaluations counts candidate
+# lowerings (0 on a plan-cache hit — the zero-search restart pin),
+# converter.reshards counts cross-mesh checkpoint conversions, and the
+# *.aot_cache_* series pin the warm-restart path (compiles == 0 when every
+# specialization loads from disk).
+PLANNER_COUNTERS: Tuple[str, ...] = (
+    "planner.searches", "planner.candidates", "planner.evaluations",
+    "planner.pruned", "planner.cache_hits", "planner.cache_stores",
+    "converter.reshards", "converter.bytes",
+    "train_step.aot_cache_hits", "train_step.aot_cache_stores",
+    "executor.aot_cache_hits", "executor.aot_cache_stores",
+)
+
 
 # -------------------------------------------------------------------- gauges
 def gauge_set(name: str, value: float) -> None:

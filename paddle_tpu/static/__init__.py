@@ -335,7 +335,6 @@ class Executor:
             plan = self._cache.get(key)
             if plan is None:
                 counter_inc("executor.cache_misses")
-                counter_inc("executor.compiles")
                 if _flag("FLAGS_static_check"):
                     # pre-flight the program once per compiled specialization:
                     # warnings surface through the warnings module, error-severity
@@ -395,9 +394,21 @@ class Executor:
             from ..observability import runlog as _runlog
 
             with _span("executor.compile"):
-                plan.compiled, plan.cost = _introspect.aot_compile(plan.fn, run_args)
+                # FLAGS_compile_cache_dir: executables round-trip through
+                # the on-disk AOT store (keyed on lowered program text) so a
+                # restarted Executor with the same program loads instead of
+                # compiling — the warm-restart time_to_first_step lever
+                plan.compiled, plan.cost = _introspect.aot_compile(
+                    plan.fn, run_args, cache_scope="executor")
+            if plan.cost.get("from_disk_cache"):
+                counter_inc("executor.aot_cache_hits")
+            else:
+                counter_inc("executor.compiles")
+                if plan.cost.get("aot_cache_stored"):
+                    counter_inc("executor.aot_cache_stores")
             _runlog.emit("compile", component="executor", label=plan.label,
                          seconds=plan.cost.get("compile_seconds"),
+                         cached=bool(plan.cost.get("from_disk_cache")),
                          flops=plan.cost.get("flops"),
                          bytes_accessed=plan.cost.get("bytes_accessed"),
                          peak_bytes=plan.cost.get("peak_bytes"))

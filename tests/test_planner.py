@@ -1,0 +1,411 @@
+"""Cost-model-driven auto-parallel planner + checkpoint resharding
+(distributed/planner.py, distributed/converter.py, the run_resilient
+elastic re-plan hook, and the AOT training-executable cache).
+
+Covers: mesh-shape enumeration; the planner ranking the known-good GPT-MP
+spec strictly above a deliberately mis-sharded twin on the dryrun mesh
+families (score gap driven by nonzero PTA201/PTA202 reshard bytes),
+computed from shapes alone — nothing dispatched; PTA204 pre-compile
+pruning against FLAGS_hbm_budget_mb; the FLAGS_compile_cache_dir plan
+cache (a re-search pays zero evaluations); the converter round-trip
+dp2×mp2 -> dp4 -> dp2×mp2 bitwise with CRC verification, and the
+structured CheckpointConversionError naming the first mismatched leaf;
+run_resilient resuming on a SHRUNK device count through
+planner.elastic_replan (re-plan + converter reshard + warm-started
+compilation: zero training compiles in the whole run); the TrainStep AOT
+warm restart (compiles == 0 on the second identical build); the planner
+CLI; and the plan/reshard observability wiring.
+"""
+import json
+import tempfile
+import time
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, profiler
+from paddle_tpu.distributed import converter as converter_mod
+from paddle_tpu.distributed import planner as planner_mod
+from paddle_tpu.distributed.converter import CheckpointConversionError
+from paddle_tpu.distributed.resilience import CheckpointManager, run_resilient
+from paddle_tpu.models.gpt import (
+    GPTConfig,
+    GPTForPretraining,
+    GPTPretrainingCriterion,
+)
+from paddle_tpu.observability import metrics
+from paddle_tpu.stability import state_from_savable, state_to_savable
+
+
+@pytest.fixture
+def cache_dir(tmp_path):
+    paddle.set_flags({"FLAGS_compile_cache_dir": str(tmp_path)})
+    yield tmp_path
+    paddle.set_flags({"FLAGS_compile_cache_dir": ""})
+
+
+def _tiny_gpt(seed=0, **kw):
+    paddle.seed(seed)
+    cfg = dict(vocab_size=128, hidden_size=32, num_layers=1, num_heads=2,
+               max_seq_len=32)
+    cfg.update(kw)
+    model = GPTForPretraining(GPTConfig(**cfg))
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters())
+    return model, opt, GPTPretrainingCriterion()
+
+
+_SPEC = jax.ShapeDtypeStruct((4, 16), np.int32)
+
+
+# ------------------------------------------------------------- enumeration
+def test_mesh_shapes_enumerates_factorizations():
+    shapes = planner_mod.mesh_shapes(8, axes=("dp", "mp"))
+    got = {tuple(sorted(m.items())) for m in shapes}
+    assert got == {(("dp", 8),), (("dp", 4), ("mp", 2)),
+                   (("dp", 2), ("mp", 4)), (("mp", 8),)}
+    for m in planner_mod.mesh_shapes(8, axes=("dp", "sdp", "mp")):
+        assert int(np.prod(list(m.values()) or [1])) == 8
+    # 1 device -> exactly the trivial plan
+    assert planner_mod.mesh_shapes(1) == [{}]
+
+
+def _flip_row_parallel(specs):
+    """The deliberately mis-sharded twin: every row-parallel/vocab-parallel
+    weight (spec leading with 'mp') flipped to column-parallel, so the
+    contraction operand arrives sharded the wrong way — XLA must insert
+    gathers (PTA201/PTA202)."""
+    out = {}
+    for name, spec in specs.items():
+        e = tuple(spec)
+        if e and e[0] == "mp":
+            out[name] = P(*([None] * (len(e) - 1) + ["mp"]))
+        else:
+            out[name] = spec
+    return out
+
+
+# ---------------------------------------------------- ranking (the tentpole)
+@pytest.mark.parametrize("mesh", [{"dp": 2, "mp": 2}, {"dp": 2, "sdp": 2, "mp": 2}],
+                         ids=["dp2xmp2", "dp2xsdp2xmp2"])
+def test_planner_ranks_good_spec_above_mis_sharded_twin(mesh):
+    """On the MULTICHIP dryrun mesh families, the known-good GPT-MP spec
+    must rank strictly above its mis-sharded twin, with the score gap
+    driven by nonzero PTA202 reshard bytes — all from shapes alone
+    (dispatch counter pinned)."""
+    model, opt, crit = _tiny_gpt()
+    good = planner_mod.annotated_specs(model)
+    assert good  # the GPT layers are mp-annotated
+    bad = _flip_row_parallel(good)
+    before = profiler.counters().get("train_step.dispatches", 0)
+    plans = planner_mod.search(
+        model, int(np.prod(list(mesh.values()))), inputs_spec=_SPEC,
+        loss=crit, optimizer=opt, templates={"good": good, "bad": bad},
+        meshes=[mesh], cache=False)
+    assert profiler.counters().get("train_step.dispatches", 0) == before
+    by = {p.template: p for p in plans}
+    assert plans[0].template == "good"
+    assert by["good"].feasible
+    # acceptance: the top plan analyzes error-free with zero PTA202
+    assert "PTA202" not in by["good"].codes
+    # the twin scores strictly worse, and the gap comes from reshard bytes
+    assert by["bad"].score > by["good"].score
+    assert by["bad"].comm_bytes > by["good"].comm_bytes > 0
+    assert "PTA202" in by["bad"].codes
+    # machine-readable summaries round-trip through JSON
+    js = json.dumps([p.summary() for p in plans])
+    rebuilt = planner_mod.Plan.from_summary(json.loads(js)[0])
+    assert rebuilt.label == plans[0].label
+    assert rebuilt.resolved_specs().keys() == good.keys()
+
+
+def test_planner_prunes_over_budget_plans_before_compile():
+    """PTA204 applied pre-flight: a budget below the static state floor
+    marks the plan infeasible without paying a compile."""
+    from paddle_tpu.analysis.spmd import ShardCheckOptions
+
+    model, opt, crit = _tiny_gpt()
+    ev0 = metrics.counters("planner.").get("planner.evaluations", 0)
+    plans = planner_mod.search(
+        model, 2, inputs_spec=_SPEC, loss=crit, optimizer=opt,
+        templates={"annotated": planner_mod.annotated_specs(model)},
+        meshes=[{"mp": 2}], cache=False,
+        options=ShardCheckOptions(hbm_budget_mb=1e-4))
+    assert len(plans) == 1 and not plans[0].feasible
+    assert "PTA204" in plans[0].pruned
+    assert plans[0].compile_seconds is None  # pruned BEFORE any compile
+    assert metrics.counters("planner.")["planner.pruned"] > 0
+    assert metrics.counters("planner.")["planner.evaluations"] == ev0 + 1
+
+
+def test_plan_cache_restart_pays_zero_search(cache_dir):
+    """Ranked plans persist under FLAGS_compile_cache_dir/planner keyed on
+    (model fingerprint, device count, shapes): the second search is a pure
+    cache hit — zero candidate evaluations."""
+    model, opt, crit = _tiny_gpt()
+    tpl = {"annotated": planner_mod.annotated_specs(model)}
+    p1 = planner_mod.search(model, 2, inputs_spec=_SPEC, loss=crit,
+                            optimizer=opt, templates=tpl, meshes=[{"mp": 2}])
+    ev = metrics.counters("planner.")["planner.evaluations"]
+    hits = metrics.counters("planner.")["planner.cache_hits"]
+    p2 = planner_mod.search(model, 2, inputs_spec=_SPEC, loss=crit,
+                            optimizer=opt, templates=tpl, meshes=[{"mp": 2}])
+    assert metrics.counters("planner.")["planner.evaluations"] == ev
+    assert metrics.counters("planner.")["planner.cache_hits"] == hits + 1
+    assert p2[0].from_cache and p2[0].label == p1[0].label
+    assert p2[0].fingerprint == p1[0].fingerprint
+    # a different device count is a different key -> live search again
+    planner_mod.search(model, 4, inputs_spec=_SPEC, loss=crit,
+                       optimizer=opt, templates=tpl, meshes=[{"dp": 2, "mp": 2}])
+    assert metrics.counters("planner.")["planner.evaluations"] > ev
+
+
+# ------------------------------------------------------------- converter
+def _mesh(shape, axes):
+    return Mesh(np.asarray(jax.devices()[:int(np.prod(shape))]).reshape(shape),
+                axes)
+
+
+def test_converter_round_trip_is_bitwise(tmp_path):
+    """dp2×mp2 -> dp4 -> dp2×mp2 through CheckpointManager: every leaf
+    bitwise equal to the original after two cross-mesh conversions (CRC
+    verified on host bytes at each restore)."""
+    mesh_a = _mesh((2, 2), ("dp", "mp"))
+    mesh_b = _mesh((4,), ("dp",))
+    rng = np.random.default_rng(0)
+    host = {"w": rng.normal(size=(8, 16)).astype("float32"),
+            "b": rng.normal(size=(16,)).astype("float32"),
+            "step": np.int32(7)}
+    sh_a = {"w": NamedSharding(mesh_a, P("dp", "mp")),
+            "b": NamedSharding(mesh_a, P("mp")),
+            "step": NamedSharding(mesh_a, P())}
+    sh_b = {"w": NamedSharding(mesh_b, P("dp", None)),
+            "b": NamedSharding(mesh_b, P()),
+            "step": NamedSharding(mesh_b, P())}
+    state_a = {k: jax.device_put(v, sh_a[k]) for k, v in host.items()}
+    mgr = CheckpointManager(str(tmp_path), keep_last_k=3)
+    mgr.save(state_a, 1)
+    target_b = {k: jax.device_put(np.zeros_like(v), sh_b[k])
+                for k, v in host.items()}
+    state_b, step = mgr.restore_latest(target=target_b, shardings=sh_b)
+    assert step == 1
+    assert state_b["w"].sharding.mesh.shape == {"dp": 4}
+    mgr.save(state_b, 2)
+    target_a = {k: jax.device_put(np.zeros_like(v), sh_a[k])
+                for k, v in host.items()}
+    state_a2, step = mgr.restore_latest(target=target_a, shardings=sh_a)
+    assert step == 2
+    for k, v in host.items():
+        np.testing.assert_array_equal(np.asarray(state_a2[k]), v)
+    assert state_a2["w"].sharding.mesh.shape == {"dp": 2, "mp": 2}
+    assert metrics.counters("converter.")["converter.reshards"] >= 2
+
+
+def test_restore_latest_conversion_error_names_first_leaf(tmp_path):
+    """A target the checkpoint cannot convert to raises the structured
+    error (naming the first mismatched leaf) instead of falling back past
+    the checkpoint or dying inside device_put."""
+    mgr = CheckpointManager(str(tmp_path), keep_last_k=2)
+    mgr.save({"w": np.ones((4, 4), "float32"),
+              "b": np.ones((2,), "float32")}, 1)
+    # shape drift
+    with pytest.raises(CheckpointConversionError) as ei:
+        mgr.restore_latest(target={"w": np.zeros((8, 8), "float32"),
+                                   "b": np.zeros((2,), "float32")})
+    assert ei.value.leaf == "['w']" and "float32[8, 8]" in str(ei.value)
+    # missing leaf in the checkpoint
+    with pytest.raises(CheckpointConversionError, match="does not contain"):
+        mgr.restore_latest(target={"w": np.zeros((4, 4), "float32"),
+                                   "b": np.zeros((2,), "float32"),
+                                   "extra": np.zeros((1,), "float32")})
+    # extra leaf in the checkpoint
+    with pytest.raises(CheckpointConversionError, match="does not expect"):
+        mgr.restore_latest(target={"w": np.zeros((4, 4), "float32")})
+    # dtype drift
+    with pytest.raises(CheckpointConversionError, match="float64"):
+        converter_mod.convert({"w": np.ones((4, 4), "float32")},
+                              target={"w": np.ones((4, 4), "float64")})
+    # a matching target still restores fine
+    state, step = mgr.restore_latest(target={"w": np.zeros((4, 4), "float32"),
+                                             "b": np.zeros((2,), "float32")})
+    assert step == 1 and float(np.asarray(state["w"])[0, 0]) == 1.0
+
+
+# ------------------------------------------- AOT training-executable cache
+def test_trainstep_warm_restart_zero_compiles(cache_dir):
+    """With FLAGS_compile_cache_dir set, a rebuilt TrainStep with the same
+    specialization loads its executable instead of compiling — compiles
+    pinned to 0, loss bitwise (the restart time_to_first_step lever)."""
+
+    def build():
+        paddle.seed(11)
+        m = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                    parameters=m.parameters())
+        from paddle_tpu.jit import TrainStep
+
+        return TrainStep(m, opt, nn.MSELoss())
+
+    x = paddle.to_tensor(np.ones((4, 8), "float32"))
+    y = paddle.to_tensor(np.ones((4, 4), "float32"))
+    profiler.reset_counters("train_step.")
+    cold = float(build()(x, y)["loss"])
+    c = profiler.counters("train_step.")
+    assert c["train_step.compiles"] == 1
+    assert c["train_step.aot_cache_stores"] == 1
+    assert any(cache_dir.joinpath("train_step").glob("*.aotc"))
+    profiler.reset_counters("train_step.")
+    warm = float(build()(x, y)["loss"])
+    c = profiler.counters("train_step.")
+    assert c.get("train_step.compiles", 0) == 0, c
+    assert c["train_step.aot_cache_hits"] == 1
+    assert warm == cold  # bitwise: same executable, same math
+
+
+# ------------------------------------------------ elastic re-plan + resume
+def test_run_resilient_resumes_on_shrunk_device_count(tmp_path, cache_dir):
+    """The full elastic loop: a node dies mid-run, the supervisor HOLDs and
+    checkpoints, planner.elastic_replan re-plans for the SHRUNK device
+    count (4 -> 2) during the HOLD window, the checkpoint reshards through
+    the converter onto the new mesh, and training resumes from the
+    checkpointed step — with every dispatched program already compiled by
+    the search (zero training compiles in the whole run)."""
+    from paddle_tpu.distributed.elastic import ElasticNode
+    from paddle_tpu.distributed.store import TCPStore
+    from paddle_tpu.framework.flags import set_flags
+
+    model, _, crit = _tiny_gpt()
+    opt_factory = lambda: paddle.optimizer.AdamW(  # noqa: E731
+        learning_rate=1e-4, parameters=model.parameters())
+    ids = np.random.default_rng(0).integers(0, 128, (4, 16)).astype("int32")
+    tpl = {"annotated": planner_mod.annotated_specs(model)}
+
+    current = {}
+    mesh_sizes = []
+
+    def rebind(step):
+        current["step"] = step
+        mesh_sizes.append(int(step.mesh.size))
+
+    on_rescale = planner_mod.elastic_replan(
+        model, opt_factory, crit, inputs_spec=_SPEC,
+        devices_for=lambda members: 4 if len(members) >= 2 else 2,
+        on_step=rebind, templates=tpl, axes=("dp", "mp"))
+
+    plans = planner_mod.search(model, 4, inputs_spec=_SPEC, loss=crit,
+                               optimizer=opt_factory(), templates=tpl,
+                               axes=("dp", "mp"))
+    rebind(planner_mod.build_step(model, opt_factory(), crit,
+                                  next(p for p in plans if p.feasible)))
+    init_state = state_to_savable(current["step"].state)
+    init_shardings = dict(current["step"]._state_shardings)
+
+    def train(state_savable, i, members):
+        current["step"].set_state(state_from_savable(state_savable))
+        current["step"](ids, ids)
+        if i == 3 and len(members) == 2:
+            # node 1 goes zombie mid-run: heartbeat freezes, membership
+            # shrinks, and with it the device count
+            set_flags({"FLAGS_chaos": True,
+                       "FLAGS_chaos_freeze_heartbeat": str(n1.node_id)})
+            time.sleep(0.6)
+        return state_to_savable(current["step"].state)
+
+    master = TCPStore(is_master=True, timeout=10.0)
+    n0 = ElasticNode(master, heartbeat_interval=0.05, timeout=0.4)
+    client = TCPStore(port=master.port, timeout=5.0)
+    n1 = ElasticNode(client, heartbeat_interval=0.05, timeout=0.4)
+    try:
+        mgr = CheckpointManager(str(tmp_path / "ckpt"), keep_last_k=3)
+        events = []
+        profiler.reset_counters("train_step.")
+        state, restarts = run_resilient(
+            train, node=n0, manager=mgr, init_state=init_state,
+            num_steps=6, min_nodes=1, max_nodes=2, checkpoint_every=2,
+            max_restarts=3, backoff=0.01, settle=0.2, deadline=30.0,
+            shardings=init_shardings, on_rescale=on_rescale,
+            on_event=lambda kind, info: events.append((kind, info)))
+        assert restarts == 1
+        assert mesh_sizes == [4, 2]  # re-planned onto the shrunk mesh
+        # training continued from the checkpointed step to completion
+        final = state_from_savable(state)
+        assert int(np.asarray(final["step"])) == 6
+        hold = [i for k, i in events if k == "hold"][0]
+        resume = [i for k, i in events if k == "resume"][0]
+        assert resume["step"] == hold["step"]
+        assert resume["members"] == [n0.node_id]
+        # the checkpoint was resharded onto the new mesh
+        assert metrics.counters("converter.")["converter.reshards"] >= 1
+        # warm start: the planner's HOLD-window evaluation compiled every
+        # program this run dispatched — zero TrainStep compiles
+        c = profiler.counters("train_step.")
+        assert c.get("train_step.compiles", 0) == 0, c
+        assert c["train_step.aot_cache_hits"] >= 2
+    finally:
+        set_flags({"FLAGS_chaos": False, "FLAGS_chaos_freeze_heartbeat": ""})
+        n0.leave()
+        n1.leave()
+        client.close()
+        master.close()
+
+
+# ------------------------------------------------------ CLI + observability
+def test_planner_cli_json(capsys, cache_dir):
+    rc = planner_mod.main(["--devices", "2", "--json", "--no-cache",
+                           "--batch", "2", "--seq", "8", "--vocab", "64",
+                           "--hidden", "16", "--layers", "1", "--heads", "2",
+                           "--axes", "dp,mp"])
+    assert rc == 0
+    plans = json.loads(capsys.readouterr().out)
+    assert len(plans) >= 2  # dp2 + mp2 at least, per template
+    assert all(set(p) >= {"label", "score", "comm_bytes", "feasible"}
+               for p in plans)
+    best = plans[0]
+    assert best["feasible"]
+    # table mode prints the ranked rows
+    rc = planner_mod.main(["--devices", "2", "--no-cache",
+                           "--batch", "2", "--seq", "8", "--vocab", "64",
+                           "--hidden", "16", "--layers", "1", "--heads", "2",
+                           "--axes", "dp,mp"])
+    out = capsys.readouterr().out
+    assert rc == 0 and "pred ms" in out and best["label"] in out
+
+
+def test_plan_and_reshard_events_feed_report_section(tmp_path):
+    from paddle_tpu.observability import runlog
+    from paddle_tpu.observability.__main__ import analyze
+
+    model, opt, crit = _tiny_gpt()
+    runlog.monitor().clear()
+    planner_mod.search(model, 2, inputs_spec=_SPEC, loss=crit, optimizer=opt,
+                       templates={"annotated": planner_mod.annotated_specs(model)},
+                       meshes=[{"mp": 2}], cache=False)
+    mesh = _mesh((2,), ("mp",))
+    converter_mod.convert(
+        {"w": np.ones((4, 4), "float32")},
+        shardings={"w": NamedSharding(mesh, P("mp", None))}, label="test")
+    evs = runlog.monitor().events()
+    plan_evs = [e for e in evs if e.get("event") == "plan"]
+    assert plan_evs and plan_evs[-1]["chosen"]["label"]
+    assert plan_evs[-1]["search_ms"] > 0
+    reshard_evs = [e for e in evs if e.get("event") == "reshard"]
+    assert reshard_evs and reshard_evs[-1]["bytes"] == 4 * 4 * 4
+    a = analyze(evs)
+    assert a["planner"]["searches"] == len(plan_evs)
+    assert a["planner"]["reshards"] == len(reshard_evs)
+    assert a["planner"]["last_chosen"]["label"]
+
+
+def test_engine_plan_delegates_to_planner():
+    """Engine.plan(): the auto_parallel surface over the searched planner."""
+    from paddle_tpu.distributed import Engine
+
+    model, opt, crit = _tiny_gpt()
+    eng = Engine(model, loss=crit, optimizer=opt)
+    plans = eng.plan(n_devices=2, inputs_spec=_SPEC, meshes=[{"mp": 2}],
+                     cache=False)
+    assert plans and plans[0].n_devices == 2
