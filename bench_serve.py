@@ -19,7 +19,14 @@ Prints ONE JSON line on the bench.py schema: {"metric", "value", "unit",
 3. **time_to_first_token** cold (build + compile family + first prefill)
    and **restart_ttft**: the same engine spec rebuilt against a warm
    ``FLAGS_compile_cache_dir`` AOT executable cache, where the compile
-   family loads from disk instead of recompiling.
+   family loads from disk instead of recompiling;
+4. **fleet phase** (own ``BENCH_BUDGET_FLEET`` budget, own subprocess, same
+   graceful-degradation contract): a ≥2-replica ServingFleet serving the
+   shared-prefix request set — aggregate ``requests_per_sec`` fault-free,
+   ``p99_under_kill_ms`` with ``FLAGS_chaos_replica_kill_at`` firing
+   mid-stream (every request still finishes exactly once, bitwise — the
+   run asserts it), and ``scaleout_ttft_ms``: time-to-first-token on a
+   replica scaled out against the warm AOT cache (``compiles == 0``).
 
 Like bench.py, the process NEVER hangs into the driver's timeout and never
 exits non-zero: the default backend is probed in a throwaway child first and
@@ -241,7 +248,110 @@ def _measure():
     return out
 
 
+def _measure_fleet():
+    """The serving-fleet phase: throughput, p99 under a mid-stream replica
+    kill, and scale-out TTFT against the warm AOT cache. Asserts the kill
+    run's completions are exactly-once and bitwise-equal to the fault-free
+    run — the bench doubles as the fleet's integration check."""
+    import jax
+
+    if os.environ.get("BENCH_FORCE_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+
+    import paddle_tpu as paddle
+    from paddle_tpu import profiler
+    from paddle_tpu.inference import ServingFleet
+    from paddle_tpu.models.gpt import GPTConfig, GPTForPretraining
+    from paddle_tpu.testing import chaos
+
+    d0 = jax.devices()[0]
+    on_tpu = d0.platform in ("tpu", "axon") or "TPU" in getattr(d0, "device_kind", "")
+    if on_tpu:
+        cfg = GPTConfig(vocab_size=50304, hidden_size=1024, num_layers=16,
+                        num_heads=16, max_seq_len=1024)
+        slots, max_seq, max_new, n_requests = 8, 1024, 32, 24
+        chunk, fuse, prefix_mb, n_replicas = 128, 8, 256.0, 2
+    else:
+        cfg = GPTConfig.tiny()
+        slots, max_seq, max_new, n_requests = 2, 128, 8, 10
+        chunk, fuse, prefix_mb, n_replicas = 16, 2, 16.0, 2
+
+    paddle.seed(0)
+    model = GPTForPretraining(cfg)
+    model.eval()
+    rng = np.random.default_rng(0)
+    kw = dict(max_batch_slots=slots, max_seq_len=max_seq, prefill_chunk=chunk,
+              fuse=fuse, prefix_cache_mb=prefix_mb)
+    shared = rng.integers(0, cfg.vocab_size, (2 * chunk,)).astype("int32")
+    prompts = [np.concatenate([shared, rng.integers(
+        0, cfg.vocab_size, (int(n),)).astype("int32")])
+        for n in rng.integers(max(1, chunk // 4), chunk, n_requests)]
+
+    import tempfile
+
+    cache_dir = tempfile.mkdtemp(prefix="bench_fleet_aot_")
+    paddle.set_flags({"FLAGS_compile_cache_dir": cache_dir})
+    try:
+        # --- fault-free throughput (programs warm via the AOT store) ------
+        fleet = ServingFleet(model, replicas=n_replicas, **kw)
+        fids = [fleet.submit(p, max_new_tokens=max_new, seed=i)
+                for i, p in enumerate(prompts)]
+        fleet.run()  # warm run: compiles + serializes the family
+        want = {i: list(fleet.requests[f].tokens) for i, f in enumerate(fids)}
+        fleet = ServingFleet(model, replicas=n_replicas, **kw)
+        fids = [fleet.submit(p, max_new_tokens=max_new, seed=i)
+                for i, p in enumerate(prompts)]
+        t0 = time.perf_counter()
+        done = fleet.run()
+        dt = time.perf_counter() - t0
+        rps = len(done) / dt if dt > 0 else None
+
+        # --- p99 latency with a replica killed mid-stream -----------------
+        with chaos.inject(FLAGS_chaos_replica_kill_at=f"{n_replicas - 1}:2"):
+            fleet_k = ServingFleet(model, replicas=n_replicas, **kw)
+            fids_k = [fleet_k.submit(p, max_new_tokens=max_new, seed=i)
+                      for i, p in enumerate(prompts)]
+            done_k = fleet_k.run()
+        assert len(done_k) == len(prompts), "kill run lost completions"
+        for i, f in enumerate(fids_k):
+            assert list(done_k[f].tokens) == want[i], \
+                f"kill run diverged on request {i}"
+        lat = sorted(r.total_seconds for r in done_k.values())
+        p99_kill = _percentile(lat, 99)
+        stats_k = fleet_k.stats()
+
+        # --- scale-out TTFT at compiles == 0 ------------------------------
+        profiler.reset_counters("infer.")
+        t0 = time.perf_counter()
+        new = fleet.scale_out(1)
+        fid = fleet.submit(prompts[0], max_new_tokens=2, seed=0,
+                           replica=new[0])
+        while fleet.requests[fid].status != "finished":
+            fleet.step()
+        scaleout_ttft = fleet.requests[fid].first_token_ts - t0
+        scaleout_compiles = int(profiler.counters("infer.").get("infer.compiles", 0))
+    finally:
+        try:
+            paddle.set_flags({"FLAGS_compile_cache_dir": ""})
+        except Exception:
+            pass
+
+    return {
+        "replicas": n_replicas,
+        "requests": len(done),
+        "requests_per_sec": round(rps, 3) if rps else None,
+        "p99_under_kill_ms": round(p99_kill * 1e3, 2),
+        "requeues_under_kill": stats_k["requeues"],
+        "replica_deaths": len(stats_k["dead"]),
+        "scaleout_ttft_ms": round(scaleout_ttft * 1e3, 2),
+        "scaleout_compiles": scaleout_compiles,
+    }
+
+
 def main():
+    if os.environ.get("BENCH_ONE") == "fleet":
+        print(json.dumps(_measure_fleet()))
+        return
     if os.environ.get("BENCH_ONE"):
         print(json.dumps(_measure()))
         return
@@ -249,8 +359,10 @@ def main():
     from __graft_entry__ import _probe_default_backend
 
     budget = float(os.environ.get("BENCH_BUDGET_SERVE", 420))
+    budget_fleet = float(os.environ.get("BENCH_BUDGET_FLEET", 300))
     verdict = _probe_default_backend(timeout=75.0)
     extras = None
+    fleet_info = None
     error = None
     fallback = None
     if verdict is None:
@@ -258,16 +370,22 @@ def main():
             extras = _measure()
         except Exception as exc:
             error = f"{type(exc).__name__}: {exc}"
+        try:
+            fleet_info = _measure_fleet()
+        except Exception as exc:
+            fleet_info = {"status": "error",
+                          "error": f"{type(exc).__name__}: {exc}"}
     else:
         import subprocess
 
-        def _child(force_cpu):
-            env = dict(os.environ, BENCH_ONE="serve")
+        def _child(force_cpu, which="serve", timeout=None):
+            env = dict(os.environ, BENCH_ONE=which)
             if force_cpu:
                 env["BENCH_FORCE_CPU"] = "1"
                 env["JAX_PLATFORMS"] = "cpu"
             r = subprocess.run([sys.executable, os.path.abspath(__file__)], env=env,
-                               capture_output=True, text=True, timeout=budget)
+                               capture_output=True, text=True,
+                               timeout=budget if timeout is None else timeout)
             line = [l for l in r.stdout.splitlines() if l.startswith("{")][-1]
             return json.loads(line)
 
@@ -283,12 +401,22 @@ def main():
                 extras = _child(force_cpu=True)
             except Exception as exc:
                 error = fallback or f"{type(exc).__name__}"
+        # fleet phase: own budget, own child, graceful degradation — a
+        # timeout or crash leaves a structured status in the JSON, rc 0
+        try:
+            fleet_info = _child(force_cpu=(verdict is not True),
+                                which="fleet", timeout=budget_fleet)
+        except subprocess.TimeoutExpired:
+            fleet_info = {"status": "timeout", "budget_seconds": budget_fleet}
+        except Exception as exc:
+            fleet_info = {"status": "error", "error": f"{type(exc).__name__}"}
 
     if extras is None:
         print(json.dumps({"metric": "gpt_serving_throughput", "value": None,
                           "unit": "requests/sec", "vs_baseline": None,
                           "requests_per_sec": None, "latency_p50_ms": None,
-                          "latency_p99_ms": None, "error": error or "bench_error"}))
+                          "latency_p99_ms": None, "fleet": fleet_info,
+                          "error": error or "bench_error"}))
         return
 
     base_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -322,6 +450,8 @@ def main():
     out = {"metric": "gpt_serving_throughput", "value": extras["value"],
            "unit": "requests/sec", "vs_baseline": round(vs, 4)}
     out.update({k: v for k, v in extras.items() if k not in ("value",)})
+    if fleet_info is not None:
+        out["fleet"] = fleet_info
     if fallback:
         out["fallback"] = fallback
     print(json.dumps(out))

@@ -15,9 +15,13 @@ batching.
    collapse the prefill compile family to 2 programs), and
    ``prefix_cache_mb=M`` (KV reuse across requests sharing a prompt prefix
    — use when traffic shares system prompts / few-shot headers). All three
-   keep tokens bitwise equal to the plain path.
+   keep tokens bitwise equal to the plain path;
+5. (``--fleet``) the fault-tolerant fleet tier: 2 engine replicas behind
+   the prefix-affinity router, a chaos-injected replica kill mid-stream,
+   and every request finishing exactly once with tokens bitwise-equal to
+   the unkilled run — plus a load-shed and a deadline expiry.
 
-Run:  python examples/serve_gpt.py
+Run:  python examples/serve_gpt.py [--fleet]
 """
 import os
 import sys
@@ -101,6 +105,51 @@ def main():
     print(f"  prefix cache: {ps['hits']} hits / {ps['misses']} misses, "
           f"{ps['entries']} chunks ({ps['bytes_used'] // 1024} KiB), "
           f"stall p99 {max(r.stall_seconds for r in done2.values()) * 1e3:.2f} ms")
+
+    # 5) (--fleet) the fault-tolerant fleet: replica kill mid-stream,
+    #    requeue onto the survivor, exactly-once bitwise completions
+    if "--fleet" in sys.argv:
+        fleet_stage(model, rng, cfg)
+
+
+def fleet_stage(model, rng, cfg):
+    from paddle_tpu.inference import FleetOverloadError, ServingFleet
+    from paddle_tpu.testing import chaos
+
+    kw = dict(max_batch_slots=2, max_seq_len=64, prefill_chunk=8, fuse=2)
+    prompts = [rng.integers(0, cfg.vocab_size, (int(n),)).astype("int32")
+               for n in (5, 9, 3, 12, 7, 11)]
+
+    # unkilled single-replica reference: the tokens every request must get
+    ref = ServingFleet(model, replicas=1, **kw)
+    want = [ref.submit(p, max_new_tokens=6, seed=i) for i, p in enumerate(prompts)]
+    ref_done = ref.run()
+    want = [list(ref_done[f].tokens) for f in want]
+
+    with chaos.inject(FLAGS_chaos_replica_kill_at="1:2"):
+        fleet = ServingFleet(model, replicas=2, **kw)
+        fids = [fleet.submit(p, max_new_tokens=6, seed=i)
+                for i, p in enumerate(prompts)]
+        done = fleet.run()
+    st = fleet.stats()
+    ok = all(list(done[f].tokens) == want[i] for i, f in enumerate(fids))
+    print(f"fleet served {len(done)}/{len(prompts)} requests through a "
+          f"mid-stream replica kill (dead: {st['dead']}, requeues: "
+          f"{st['requeues']}), tokens bitwise-equal to the unkilled run: {ok}")
+
+    # graceful degradation: deadline expiry + queue-depth shed
+    small = ServingFleet(model, replicas=1, max_queue_depth=2, **kw)
+    fid = small.submit(prompts[3], max_new_tokens=40, deadline_s=0.001)
+    small.run()
+    print(f"  deadline: request {fid} ended "
+          f"{small.requests[fid].status} (slot reclaimed, not drained)")
+    small.submit(prompts[0], max_new_tokens=4)
+    small.submit(prompts[1], max_new_tokens=4)
+    try:
+        small.submit(prompts[2], max_new_tokens=4)
+    except FleetOverloadError as e:
+        print(f"  overload shed: {e}")
+    small.run()
 
 
 if __name__ == "__main__":

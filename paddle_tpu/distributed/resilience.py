@@ -12,8 +12,10 @@ restarts), rebuilt on this repo's own primitives:
   and ``restore_latest`` that walks back past corrupt/truncated
   checkpoints to the newest one whose checksums verify.
 - **retry** decorates transient store/IO calls with bounded
-  exponential-backoff retries (deterministic: no jitter, so injected-fault
-  tests replay exactly).
+  exponential-backoff retries. ``FLAGS_store_retry_jitter`` (default on)
+  applies capped FULL jitter — uniform(0, cap) — seeded through
+  ``framework.random.host_generator``, so N replicas retrying one dead
+  store de-correlate while injected-fault tests still replay exactly.
 - **watchdog** arms a timer around an uncancellable block (an XLA
   collective, a blocking store op) and reports — to stderr and an optional
   handler — when it is still pending past the deadline, instead of the
@@ -247,17 +249,35 @@ def _corrupt_array_data(step_dir: str):
 
 def retry(max_attempts: int = 3, base_delay: float = 0.05,
           max_delay: float = 2.0,
-          retry_on: Tuple[type, ...] = (OSError, TimeoutError)):
+          retry_on: Tuple[type, ...] = (OSError, TimeoutError),
+          jitter: Optional[bool] = None):
     """Bounded exponential-backoff retry for transient store/IO failures.
 
-    Deliberately deterministic (no jitter): attempt i sleeps
-    ``min(max_delay, base_delay * 2**i)``. After ``max_attempts`` failures
-    the last exception propagates unchanged.
+    Attempt i's backoff cap is ``min(max_delay, base_delay * 2**i)``; after
+    ``max_attempts`` failures the last exception propagates unchanged.
+
+    ``jitter`` selects the sleep inside that cap (None defers to
+    ``FLAGS_store_retry_jitter``, read per call so ``set_flags`` applies to
+    already-decorated functions):
+
+    - **full jitter** (the AWS discipline): sleep ``uniform(0, cap)``. N
+      replicas hammering a dead store spread their retries across the whole
+      window instead of thundering-herding on the same schedule. The stream
+      comes from :func:`framework.random.host_generator` seeded on
+      (``paddle.seed``, the decorated function's name, PADDLE_TRAINER_ID) —
+      bitwise-replayable under chaos tests, de-correlated across ranks.
+    - **off**: the pre-jitter deterministic sleeps (exactly ``cap``).
     """
     if max_attempts < 1:
         raise ValueError(f"max_attempts must be >= 1, got {max_attempts}")
 
     def deco(fn: Callable):
+        from ..framework import random as _random
+
+        tag = (f"retry/{getattr(fn, '__qualname__', fn)}"
+               f"/{os.environ.get('PADDLE_TRAINER_ID', '0')}")
+        rng_box: list = []  # created lazily so paddle.seed set later applies
+
         @functools.wraps(fn)
         def wrapper(*args, **kwargs):
             for attempt in range(max_attempts):
@@ -266,7 +286,13 @@ def retry(max_attempts: int = 3, base_delay: float = 0.05,
                 except retry_on:
                     if attempt == max_attempts - 1:
                         raise
-                    time.sleep(min(max_delay, base_delay * (2 ** attempt)))
+                    cap = min(max_delay, base_delay * (2 ** attempt))
+                    use = flag("FLAGS_store_retry_jitter") if jitter is None else jitter
+                    if use:
+                        if not rng_box:
+                            rng_box.append(_random.host_generator(tag))  # noqa: PTA104 (host-side retry backoff, never traced)
+                        cap = float(rng_box[0].uniform(0.0, cap))
+                    time.sleep(cap)
 
         return wrapper
 
@@ -276,15 +302,17 @@ def retry(max_attempts: int = 3, base_delay: float = 0.05,
 class RetryingStore:
     """Proxy wrapping a TCPStore's transient-failure-prone ops (set/get/
     add/wait/delete_key/num_keys) in the ``retry`` decorator; everything
-    else passes through."""
+    else passes through. ``jitter`` has :func:`retry` semantics (None
+    defers to ``FLAGS_store_retry_jitter`` — full jitter by default, so a
+    fleet of replicas retrying one dead store doesn't thundering-herd)."""
 
     _RETRIED = ("set", "get", "add", "wait", "delete_key", "num_keys")
 
     def __init__(self, store, max_attempts: int = 3, base_delay: float = 0.05,
-                 max_delay: float = 2.0):
+                 max_delay: float = 2.0, jitter: Optional[bool] = None):
         self._store = store
         deco = retry(max_attempts=max_attempts, base_delay=base_delay,
-                     max_delay=max_delay, retry_on=(OSError,))
+                     max_delay=max_delay, retry_on=(OSError,), jitter=jitter)
         for name in self._RETRIED:
             setattr(self, name, deco(getattr(store, name)))
 

@@ -93,6 +93,7 @@ define_flag("FLAGS_run_log_dir", "", "directory for the structured run log (JSON
 
 # Fault-tolerance runtime (distributed/resilience.py).
 define_flag("FLAGS_collective_timeout_s", 0.0, "watchdog: report a cross-process collective still pending after this many seconds (0 = off)")
+define_flag("FLAGS_store_retry_jitter", True, "full jitter on the resilience.retry/RetryingStore exponential backoff: attempt i sleeps uniform(0, min(max_delay, base_delay*2**i)) instead of the deterministic cap, so N replicas retrying a dead store spread out instead of thundering-herding. The jitter stream is seeded via framework.random (paddle.seed + PADDLE_TRAINER_ID), so chaos tests replay bitwise; off restores the pre-jitter deterministic sleeps")
 
 # Training-health guard (jit.TrainStep guard / paddle_tpu.stability).
 define_flag("FLAGS_train_guard", False, "fuse an all-finite check over loss+grads into every jit.TrainStep program and skip the param/opt/rng update in-graph when it trips (state stays bitwise at its pre-step value); read at TrainStep construction")
@@ -110,3 +111,5 @@ define_flag("FLAGS_chaos_store_delay_s", 0.0, "sleep this long before every stor
 define_flag("FLAGS_chaos_freeze_heartbeat", "", "comma list of elastic node ids whose heartbeat stops refreshing")
 define_flag("FLAGS_chaos_nan_at_step", -1, "inject non-finite gradients in-graph at this TrainStep step index (fires exactly once; read at TrainStep construction; -1 = off)")
 define_flag("FLAGS_chaos_nan_steps", 1, "number of consecutive steps the NaN-gradient injection fires for (default 1)")
+define_flag("FLAGS_chaos_replica_kill_at", "", "kill a serving-fleet engine replica mid-stream: 'R:K' kills replica R after its K-th decode tick (fires exactly once per replica per process). Drives the fleet kill/requeue tests")
+define_flag("FLAGS_chaos_replica_slow_ms", "", "inject per-tick latency into serving-fleet replicas: 'MS' slows every replica, 'R:MS' only replica R, by MS milliseconds per scheduler tick (a straggler/overloaded host; long enough and the fleet's heartbeat tracking declares it dead)")

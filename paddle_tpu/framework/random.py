@@ -23,6 +23,7 @@ class _RngState(threading.local):
     def __init__(self):
         self._root_key = None
         self.counter = 0
+        self.seed_value = 0  # last paddle.seed value (host-side derivations)
         # stack of (key, [counter]) installed by rng_scope for traced code
         self.scopes = []
 
@@ -44,7 +45,24 @@ def seed(value: int):
     """Reset the global RNG root key (paddle.seed parity)."""
     _STATE.root_key = jax.random.key(int(value))
     _STATE.counter = 0
+    _STATE.seed_value = int(value)
     return value
+
+
+def host_generator(tag: str = ""):
+    """A ``numpy.random.Generator`` derived deterministically from the global
+    seed (``paddle.seed``) and ``tag`` — host-side randomness (e.g. retry
+    backoff jitter) that never touches the device PRNG, never initializes the
+    XLA backend, and replays bitwise under chaos tests: same seed + same tag
+    ⇒ same stream. Distinct tags (and distinct seeds) give independent
+    streams, so N processes that fold their rank into ``tag`` de-correlate
+    while each still replays deterministically."""
+    import zlib
+
+    import numpy as np
+
+    base = zlib.crc32(f"{_STATE.seed_value}/{tag}".encode())
+    return np.random.default_rng(base)
 
 
 def get_rng_state():

@@ -19,7 +19,13 @@ On top of the artifact predictor sit the serving-engine pieces:
   with donated cache buffers;
 - :class:`ContinuousBatchingScheduler` (``.scheduler``) — in-flight
   batching: requests admitted into free batch slots mid-stream, bucketed
-  prefill padding, request-level telemetry.
+  prefill padding, per-request deadlines + mid-decode cancellation,
+  request-level telemetry;
+- :class:`ServingFleet` (``.fleet``) + :class:`Router` (``.router``) — the
+  fault-tolerant tier: N engine replicas behind prefix-cache-affinity
+  placement, heartbeat health tracking, kill-safe drain/requeue
+  (exactly-once, bitwise-identical completions through a mid-stream
+  replica death), queue-depth load shedding, and AOT-warm scale-out.
 
 Backend placement is honest: ``Config.enable_use_gpu`` records the REQUEST
 and the resolved backend is whatever the runtime actually has (TPU when
@@ -38,13 +44,23 @@ import jax.numpy as jnp
 import numpy as np
 
 from .engine import DecodeEngine, default_buckets
+from .fleet import (
+    EngineReplica,
+    FleetDrainedError,
+    FleetOverloadError,
+    FleetRequest,
+    ServingFleet,
+)
 from .prefix_cache import PrefixCache
+from .router import Router
 from .scheduler import ContinuousBatchingScheduler, Request
 
 __all__ = [
     "Config", "Predictor", "create_predictor", "PredictorTensor",
     "DecodeEngine", "ContinuousBatchingScheduler", "Request",
     "PrefixCache", "default_buckets", "get_version",
+    "ServingFleet", "EngineReplica", "FleetRequest", "Router",
+    "FleetOverloadError", "FleetDrainedError",
 ]
 
 

@@ -22,8 +22,16 @@ Round 2 of the serving hot path rides the engine's three throughput knobs:
   prompt-prefix KV cache skip the matched chunks entirely —
   ``admitted`` events carry ``prefix_tokens`` for hit-rate reporting.
 
+Requests carry optional **deadlines** (``submit(deadline_s=...)``) and can
+be **cancelled** mid-flight (:meth:`ContinuousBatchingScheduler.cancel`):
+an expired or cancelled request frees its batch slot immediately — even
+mid-decode — instead of holding it to drain, and lands in ``.cancelled``
+with status ``deadline_exceeded``/``cancelled``. The serving fleet builds
+its graceful degradation on both.
+
 Telemetry rides the PR-4 spine: every request emits ``request`` run-log
-events (``submitted`` → ``admitted`` → ``finished``) with queue/prefill/
+events (``submitted`` → ``admitted`` → ``finished``, or ``cancelled``/
+``deadline_exceeded``) with queue/prefill/
 decode/stall timings, the ``serving.*`` counters/gauges/histograms feed
 the metrics registry, and ``python -m paddle_tpu.observability report``
 renders a serving section (request rate, queue depth, latency/TTFT
@@ -42,15 +50,23 @@ __all__ = ["Request", "ContinuousBatchingScheduler"]
 
 
 class Request:
-    """One in-flight generation request and its lifecycle timestamps."""
+    """One in-flight generation request and its lifecycle timestamps.
+
+    ``status`` walks ``queued → prefilling → running → finished``, or ends
+    at ``cancelled`` / ``deadline_exceeded`` when :meth:`ContinuousBatching\
+Scheduler.cancel` (or the per-tick deadline sweep) reclaims it mid-flight.
+    """
 
     def __init__(self, rid: int, prompt: np.ndarray, max_new_tokens: int,
-                 eos_token_id: Optional[int], seed: int):
+                 eos_token_id: Optional[int], seed: int,
+                 deadline_s: Optional[float] = None):
         self.rid = rid
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.max_new_tokens = int(max_new_tokens)
         self.eos_token_id = eos_token_id
         self.seed = int(seed)
+        self.deadline_s = float(deadline_s) if deadline_s is not None else None
+        self.status = "queued"
         self.tokens: List[int] = []
         self.slot: Optional[int] = None
         self.bucket: Optional[int] = None
@@ -87,6 +103,13 @@ class Request:
     def total_seconds(self):
         return None if self.finished_ts is None else self.finished_ts - self.submitted_ts
 
+    def deadline_expired(self, now: Optional[float] = None) -> bool:
+        """True when the request carries a deadline and it has passed."""
+        if self.deadline_s is None:
+            return False
+        now = time.perf_counter() if now is None else now
+        return now - self.submitted_ts > self.deadline_s
+
     def output_ids(self) -> np.ndarray:
         """prompt + generated tokens, the served completion."""
         return np.concatenate([self.prompt, np.asarray(self.tokens, np.int32)])
@@ -105,14 +128,18 @@ class ContinuousBatchingScheduler:
         self._jobs: Dict[int, object] = {}        # slot -> engine _PrefillJob
         self.running: Dict[int, Request] = {}     # slot -> decoding request
         self.finished: Dict[int, Request] = {}    # rid -> request
+        self.cancelled: Dict[int, Request] = {}   # rid -> cancelled/expired
         self._next_rid = 0
 
     # ----------------------------------------------------------- lifecycle
     def submit(self, prompt, max_new_tokens: int = 16, eos_token_id: Optional[int] = None,
-               seed: int = 0) -> int:
+               seed: int = 0, deadline_s: Optional[float] = None) -> int:
         """Enqueue one prompt; returns the request id. Validation happens
         here (not at admission) so a bad request fails its caller, not the
-        serving loop."""
+        serving loop. ``deadline_s`` bounds the request's TOTAL time from
+        submission: a request still queued, prefilling, or decoding when it
+        expires is reclaimed on the next tick with status
+        ``deadline_exceeded`` (its slot frees mid-decode — no drain wait)."""
         from ..observability import runlog as _runlog
         from ..observability.metrics import counter_inc, gauge_set
 
@@ -121,8 +148,11 @@ class ContinuousBatchingScheduler:
         if n + int(max_new_tokens) > self.engine.max_seq_len:
             raise ValueError(f"prompt {n} + max_new_tokens {max_new_tokens} exceeds "
                              f"engine max_seq_len {self.engine.max_seq_len}")
+        if deadline_s is not None and deadline_s <= 0:
+            raise ValueError(f"deadline_s must be > 0, got {deadline_s}")
         self.engine.bucket_for(n)  # raises if no bucket/chunk tiling fits
-        r = Request(self._next_rid, prompt, max_new_tokens, eos_token_id, seed)
+        r = Request(self._next_rid, prompt, max_new_tokens, eos_token_id, seed,
+                    deadline_s=deadline_s)
         self._next_rid += 1
         self.queue.append(r)
         counter_inc("serving.requests_submitted")
@@ -131,6 +161,64 @@ class ContinuousBatchingScheduler:
                      prompt_tokens=n, max_new_tokens=int(max_new_tokens),
                      queue_depth=len(self.queue))
         return r.rid
+
+    def cancel(self, rid: int, status: str = "cancelled") -> bool:
+        """Cancel one in-flight request wherever it is: still queued, mid-
+        prefill, or mid-decode (its slot frees immediately — the next
+        admission reuses it; write-before-attend cache hygiene makes the
+        abandoned KV rows harmless). Emits a ``request`` run-log event with
+        ``status`` (``cancelled``, or ``deadline_exceeded`` from the deadline
+        sweep) and returns True; False when ``rid`` isn't in flight (already
+        finished, cancelled, or never submitted)."""
+        from ..observability import runlog as _runlog
+        from ..observability.metrics import counter_inc, gauge_set
+
+        r = None
+        for q in self.queue:
+            if q.rid == rid:
+                r = q
+                self.queue.remove(q)  # noqa: PTA104 (host-side serving loop, never traced)
+                gauge_set("serving.queue_depth", len(self.queue))
+                break
+        if r is None:
+            for slot, cand in list(self.prefilling.items()):  # noqa: PTA102 (host-side serving loop, never traced)
+                if cand.rid == rid:
+                    r = cand
+                    del self.prefilling[slot], self._jobs[slot]
+                    self.engine.free_slot(slot)
+                    break
+        if r is None:
+            for slot, cand in list(self.running.items()):  # noqa: PTA102 (host-side serving loop, never traced)
+                if cand.rid == rid:
+                    r = cand
+                    del self.running[slot]
+                    self.engine.free_slot(slot)
+                    break
+        if r is None:
+            return False
+        r.status = status
+        r.finished_ts = time.perf_counter()
+        self.cancelled[rid] = r
+        counter_inc("serving.deadline_exceeded" if status == "deadline_exceeded"
+                    else "serving.requests_cancelled")
+        gauge_set("serving.active_slots", len(self.running))
+        _runlog.emit("request", id=rid, status=status, component="serving",
+                     prompt_tokens=len(r.prompt), new_tokens=len(r.tokens),
+                     seconds=r.finished_ts - r.submitted_ts,
+                     deadline_s=r.deadline_s)
+        return True
+
+    def _expire_deadlines(self) -> None:
+        """Reclaim every in-flight request whose deadline has passed (one
+        sweep per tick: queued, prefilling, and decoding alike)."""
+        now = time.perf_counter()
+        expired = [r.rid for r in list(self.queue) if r.deadline_expired(now)]
+        expired += [r.rid for r in list(self.prefilling.values())
+                    if r.deadline_expired(now)]
+        expired += [r.rid for r in list(self.running.values())
+                    if r.deadline_expired(now)]
+        for rid in expired:
+            self.cancel(rid, status="deadline_exceeded")
 
     def _admit(self) -> None:
         """Claim free slots for queued requests (prefix-cache inserts happen
@@ -144,6 +232,7 @@ class ContinuousBatchingScheduler:
             slot = free.pop(0)
             r.slot = slot
             r.bucket = self.engine.bucket_for(len(r.prompt))
+            r.status = "prefilling"  # noqa: PTA104 (host-side serving loop, never traced)
             r.admitted_ts = time.perf_counter()
             job = self.engine.begin_prefill(
                 r.prompt, slot, max_new_tokens=r.max_new_tokens,
@@ -188,6 +277,7 @@ class ContinuousBatchingScheduler:
                          prefix_tokens=r.prefix_tokens, chunks=r.prefill_chunks,
                          stall_seconds=r.stall_seconds)
             if job.more:
+                r.status = "running"  # noqa: PTA104 (host-side serving loop, never traced)
                 self.running[slot] = r
             else:
                 self._finish(r)
@@ -196,6 +286,7 @@ class ContinuousBatchingScheduler:
         from ..observability import runlog as _runlog
         from ..observability.metrics import counter_inc, gauge_set, observe
 
+        r.status = "finished"
         r.finished_ts = time.perf_counter()
         self.engine.free_slot(r.slot)
         self.running.pop(r.slot, None)
@@ -218,6 +309,7 @@ class ContinuousBatchingScheduler:
         at fuse depth D, drained in order). Returns requests finished this
         tick."""
         before = set(self.finished)
+        self._expire_deadlines()
         self._admit()
         self._prefill_tick()
         if self.running:

@@ -49,7 +49,7 @@ for _name in (
     "collective.broadcast.calls", "collective.barrier.calls",
     "checkpoint.saves", "checkpoint.restores",
     "profiler.steps",
-) + metrics.SERVING_COUNTERS + metrics.KERNEL_COUNTERS \
+) + metrics.SERVING_COUNTERS + metrics.FLEET_COUNTERS + metrics.KERNEL_COUNTERS \
         + metrics.ANALYSIS_COUNTERS + metrics.PLANNER_COUNTERS:
     metrics.declare_counter(_name)
 del _name

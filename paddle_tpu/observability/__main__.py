@@ -11,8 +11,13 @@ Prints, from one structured run log (see :mod:`.runlog`):
   ``rollback``/``loss_scale`` events,
 - a serving section (request rate, queue depth, prefill/decode time split,
   latency p50/p99 and time-to-first-token, prefix-cache hit rate, fused
-  decode depth, chunked-prefill stall percentiles) when the run produced
-  ``request`` events (the continuous-batching scheduler's stream),
+  decode depth, chunked-prefill stall percentiles, cancellations and
+  deadline expiries) when the run produced ``request`` events (the
+  continuous-batching scheduler's stream),
+- a serving-fleet section (replicas alive/dead with death reasons,
+  requeues, load sheds, deadline hits, scale-outs, and per-replica
+  request rates) when the run produced ``fleet`` events
+  (inference/fleet.py's router + replica health stream),
 - a kernel-selection section (picked vs fallback per registry kernel, with
   the per-implementation breakdown) when the run produced
   ``kernel_select`` events (the ops kernel registry's stream),
@@ -118,6 +123,10 @@ def analyze(events: List[dict]) -> dict:
     reqs = [ev for ev in events if ev.get("event") == "request"]
     if reqs:
         out["serving"] = _analyze_serving(reqs)
+    # serving-fleet section from the fleet's membership/placement stream
+    flt = [ev for ev in events if ev.get("event") == "fleet"]
+    if flt:
+        out["fleet"] = _analyze_fleet(flt)  # noqa: PTA104 (host-side report printer)
     # sharding-analysis section from the SPMD analyzer's shard_check events
     # (FLAGS_shard_check: one per analyzed specialization)
     checks = [ev for ev in events if ev.get("event") == "shard_check"]
@@ -210,6 +219,11 @@ def _analyze_serving(reqs: List[dict]) -> dict:
         "wall_seconds": wall,
         "requests_per_sec": (len(finished) / wall) if (finished and wall > 0) else None,
     }
+    cancelled = len(by_status.get("cancelled", []))
+    expired = len(by_status.get("deadline_exceeded", []))
+    if cancelled or expired:
+        out["cancelled"] = cancelled  # noqa: PTA104 (host-side report printer)
+        out["deadline_exceeded"] = expired  # noqa: PTA104 (host-side report printer)
     depths = [ev["queue_depth"] for ev in reqs
               if isinstance(ev.get("queue_depth"), (int, float))]
     if depths:
@@ -256,6 +270,51 @@ def _analyze_serving(reqs: List[dict]) -> dict:
             "max_seconds": stalls[-1],
             "total_seconds": sum(stalls),
         }
+    return out
+
+
+def _analyze_fleet(flt: List[dict]) -> dict:
+    """Fleet-level stats from ``fleet`` events (membership, placements,
+    replica deaths, requeues, sheds, deadlines, scale-outs, completions)."""
+    by_kind = defaultdict(list)
+    for ev in flt:
+        by_kind[ev.get("kind", "?")].append(ev)  # noqa: PTA104 (host-side report printer)
+    out = {
+        "replica_deaths": len(by_kind.get("replica_dead", [])),
+        "requeues": len(by_kind.get("requeue", [])),
+        "sheds": len(by_kind.get("shed", [])),
+        "deadline_hits": len(by_kind.get("deadline", [])),
+        "scale_outs": sum(len(ev.get("replicas") or [1])
+                          for ev in by_kind.get("scale_out", [])),
+    }
+    memb = by_kind.get("membership", [])
+    if memb:
+        out["replicas_alive"] = memb[-1].get("alive")  # noqa: PTA104 (host-side report printer)
+        out["replicas_dead"] = memb[-1].get("dead")  # noqa: PTA104 (host-side report printer)
+    deaths = by_kind.get("replica_dead", [])
+    if deaths:
+        out["death_reasons"] = {ev.get("replica"): ev.get("reason")  # noqa: PTA104 (host-side report printer)
+                                for ev in deaths}
+    fin = by_kind.get("finished", [])
+    if fin:
+        ts = [ev["ts"] for ev in flt if isinstance(ev.get("ts"), (int, float))]
+        wall = (max(ts) - min(ts)) if len(ts) > 1 else 0.0
+        per: dict = defaultdict(int)
+        for ev in fin:
+            per[ev.get("replica")] += 1  # noqa: PTA104 (host-side report printer)
+        out["finished"] = len(fin)  # noqa: PTA104 (host-side report printer)
+        out["wall_seconds"] = wall  # noqa: PTA104 (host-side report printer)
+        out["per_replica_rps"] = {  # noqa: PTA104 (host-side report printer)
+            r: (n / wall if wall > 0 else None) for r, n in sorted(per.items())}
+        lats = sorted(ev["seconds"] for ev in fin
+                      if isinstance(ev.get("seconds"), (int, float)))
+        if lats:
+            out["latency"] = {  # noqa: PTA104 (host-side report printer)
+                "p50_seconds": _percentile(lats, 50),
+                "p99_seconds": _percentile(lats, 99),
+            }
+        replays = [ev for ev in fin if int(ev.get("attempts") or 1) > 1]
+        out["finished_after_requeue"] = len(replays)  # noqa: PTA104 (host-side report printer)
     return out
 
 
@@ -333,6 +392,36 @@ def print_report(path: str, a: dict) -> None:
             print(f"    prefill stall: p50 {stall['p50_seconds'] * 1e3:.2f} ms   "
                   f"p99 {stall['p99_seconds'] * 1e3:.2f} ms   "
                   f"total {stall['total_seconds']:.4f}s")
+        if sv.get("cancelled") or sv.get("deadline_exceeded"):
+            print(f"    reclaimed: {sv.get('cancelled', 0)} cancelled, "  # noqa: PTA105 (host-side report printer)
+                  f"{sv.get('deadline_exceeded', 0)} deadline-expired")
+    fl = a.get("fleet")
+    if fl:
+        print("  serving fleet (router + engine replicas):")  # noqa: PTA105 (host-side report printer)
+        alive = fl.get("replicas_alive")
+        dead = fl.get("replicas_dead")
+        if alive is not None:
+            print(f"    replicas: {len(alive)} alive {alive}   "  # noqa: PTA105 (host-side report printer)
+                  f"{len(dead or [])} dead {dead or []}")
+        print(f"    requeues: {fl['requeues']}   sheds: {fl['sheds']}   "  # noqa: PTA105 (host-side report printer)
+              f"deadline hits: {fl['deadline_hits']}   "
+              f"scale-outs: {fl['scale_outs']}")
+        for rid, reason in (fl.get("death_reasons") or {}).items():  # noqa: PTA102 (host-side report printer)
+            print(f"    replica {rid} died: {reason}")  # noqa: PTA105 (host-side report printer)
+        if fl.get("finished") is not None:
+            line = (f"    finished: {fl['finished']} "
+                    f"({fl.get('finished_after_requeue', 0)} after requeue)")
+            lat = fl.get("latency")
+            if lat:
+                line += (f"   latency p50 {lat['p50_seconds'] * 1e3:.2f} ms"
+                         f"  p99 {lat['p99_seconds'] * 1e3:.2f} ms")
+            print(line)  # noqa: PTA105 (host-side report printer)
+        rps = fl.get("per_replica_rps")
+        if rps:
+            parts = "  ".join(
+                f"r{rid} {v:.2f}/s" if v is not None else f"r{rid} -"
+                for rid, v in rps.items())
+            print(f"    per-replica throughput: {parts}")  # noqa: PTA105 (host-side report printer)
     sh = a.get("sharding")
     if sh:
         print("  sharding analysis (SPMD PTA2xx pre-flight, FLAGS_shard_check):")

@@ -156,8 +156,21 @@ SERVING_COUNTERS: Tuple[str, ...] = (
     "infer.aot_cache_hits", "infer.aot_cache_stores",
     "serving.requests_submitted", "serving.requests_admitted",
     "serving.requests_completed", "serving.tokens_generated",
+    "serving.requests_cancelled", "serving.deadline_exceeded",
     "serving.prefix_hits", "serving.prefix_misses",
     "serving.prefix_tokens_reused",
+)
+
+# Serving-fleet tier (inference/fleet.py + router.py): the failure-handling
+# ledger — requeues counts in-flight requests replayed off dead replicas,
+# sheds counts admissions rejected by queue-depth control, deadline_hits
+# counts per-request deadline expiries, and the routed_* pair splits
+# placements by discipline (prefix-chain affinity vs least-load).
+FLEET_COUNTERS: Tuple[str, ...] = (
+    "fleet.requests_submitted", "fleet.requests_completed",
+    "fleet.requeues", "fleet.sheds", "fleet.deadline_hits",
+    "fleet.replica_deaths", "fleet.scale_outs",
+    "fleet.routed_affinity", "fleet.routed_load",
 )
 
 # Kernel-registry selection series (paddle_tpu.ops.registry): one

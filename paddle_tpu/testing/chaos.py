@@ -28,6 +28,12 @@ Injection points:
   injection into its program (``FLAGS_chaos_nan_at_step``; an armed
   budget carried in the step state makes it fire exactly once per
   process, even across ``run_steps`` scans and divergence rollbacks).
+- **kill / slow a serving replica**: ``replica_kill_due(rid, tick)`` is
+  True exactly once when ``FLAGS_chaos_replica_kill_at`` ('R:K') names
+  replica R and it has served K decode ticks — the fleet turns it into a
+  mid-stream replica death (drain + requeue); ``replica_slow_ms(rid)``
+  reads ``FLAGS_chaos_replica_slow_ms`` ('MS' or 'R:MS') as per-tick
+  injected latency (a straggler the heartbeat tracker must catch).
 """
 from __future__ import annotations
 
@@ -126,6 +132,42 @@ def nan_grads_due():
     n = max(int(flag("FLAGS_chaos_nan_steps")), 1)
     _emit_inject(step=at, kind="nan_grads", n_steps=n)
     return int(at), n
+
+
+def replica_kill_due(replica_id, tick) -> bool:
+    """True — exactly once per (replica, process) — when
+    ``FLAGS_chaos_replica_kill_at`` ('R:K') names ``replica_id`` and it has
+    served at least K decode ticks. The serving fleet answers True with a
+    :class:`ChaosCrash` replica death (mark dead, drain, requeue)."""
+    if not enabled():
+        return False
+    spec = flag("FLAGS_chaos_replica_kill_at")
+    if not spec:
+        return False
+    rid, _, at = spec.partition(":")
+    if str(replica_id) != rid or int(tick) < int(at or 0):
+        return False
+    key = ("replica_kill", str(replica_id))
+    if key in _fired:
+        return False
+    _fired.add(key)
+    _emit_inject(kind="replica_kill", replica=replica_id, tick=int(tick))
+    return True
+
+
+def replica_slow_ms(replica_id) -> float:
+    """Injected per-tick latency in milliseconds for ``replica_id``:
+    ``FLAGS_chaos_replica_slow_ms`` is 'MS' (every replica) or 'R:MS' (one).
+    0.0 when chaos is off or the spec names another replica."""
+    if not enabled():
+        return 0.0
+    spec = flag("FLAGS_chaos_replica_slow_ms")
+    if not spec:
+        return 0.0
+    rid, sep, ms = spec.partition(":")
+    if not sep:
+        return float(rid)
+    return float(ms) if str(replica_id) == rid else 0.0
 
 
 def heartbeat_frozen(node_id) -> bool:

@@ -8,9 +8,9 @@ import pytest
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _run(script):
+def _run(script, *args):
     env = dict(os.environ, PYTHONPATH="", PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu")
-    r = subprocess.run([sys.executable, os.path.join(ROOT, "examples", script)],
+    r = subprocess.run([sys.executable, os.path.join(ROOT, "examples", script), *args],
                        capture_output=True, text=True, timeout=900, env=env, cwd=ROOT)
     assert r.returncode == 0, f"{script} failed:\n{r.stdout[-2000:]}\n{r.stderr[-2000:]}"
     return r.stdout
@@ -38,4 +38,12 @@ def test_serve_text_example():
 def test_serve_gpt_example():
     out = _run("serve_gpt.py")
     assert "2 compiled programs" in out
+
+
+@pytest.mark.slow
+def test_serve_gpt_fleet_example():
+    out = _run("serve_gpt.py", "--fleet")
+    assert "bitwise-equal to the unkilled run: True" in out
+    assert "overload shed" in out
+    assert "deadline_exceeded" in out
     assert "served 6 requests" in out
