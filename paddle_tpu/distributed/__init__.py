@@ -58,5 +58,10 @@ from .resilience import (  # noqa: F401
 )
 from . import converter  # noqa: F401
 from . import planner  # noqa: F401
+from .embedding import (  # noqa: F401
+    EmbeddingCheckpointRotation,
+    ShardedEmbedding,
+    sharded_embedding_lookup,
+)
 from .converter import CheckpointConversionError  # noqa: F401
 from .planner import Plan, PlannerError  # noqa: F401

@@ -1,0 +1,386 @@
+"""Mesh-sharded embedding tables — the recsys workload's sparse tier.
+
+Parity target: the reference's "100B-feature" recommender stack is a brpc
+parameter server (SURVEY §3 PS/HeterPS) that the TPU port declares out of
+scope; SURVEY §7 prescribes the replacement this module implements —
+"sparse embeddings via sharded embedding tables on the mesh". The PS's
+pull/push RPC pair becomes a pair of ``all_to_all`` collectives inside
+``shard_map``:
+
+- **lookup (pull)**: per shard, the local ids are deduplicated
+  (``jnp.unique`` with a static size — the sorted output doubles as the
+  PR-8 sort-based bucketing: unique ids arrive grouped by owner shard),
+  bucketed by owner (= ``id // rows_per_shard``), exchanged with one
+  ``all_to_all``, gathered from the owner's local ``[V/n, D]`` rows, and
+  returned with a second ``all_to_all``; an inverse-permute gather puts
+  rows back in request order. Payloads are O(batch), never O(vocab).
+- **gradient (push)**: a ``custom_vjp`` routes the incoming ``[T, D]``
+  cotangent back to the owner shards (token-level, stable-sorted by owner
+  so every row's contributions arrive in global token order) and
+  scatter-adds ONLY the touched local rows. No dense ``[V, D]`` gradient
+  ever exists globally — each shard materializes just its own
+  ``[V/n, D]`` cotangent block, and the bytes crossing the mesh are
+  O(batch·D). This extends the SelectedRows contract
+  (:mod:`paddle_tpu.framework.selected_rows`) into traced code; the
+  matching traced row-sparse optimizer is
+  :class:`paddle_tpu.optimizer.RowSparseAdam`.
+
+The token-order accumulation discipline makes the sharded lookup AND its
+gradient bitwise-identical to a single-device dense ``F.embedding``
+reference (tests pin uniform, power-law-skewed, duplicate-id and
+empty-shard batches on a dp4 CPU mesh).
+
+Online learning (the PS's streaming role) is covered by
+:class:`EmbeddingCheckpointRotation`: periodic row-sharded checkpoint
+publication through :class:`~paddle_tpu.distributed.resilience.
+CheckpointManager`, restorable onto a different mesh degree through the
+PR-10 converter (dp4 -> dp2 -> dp4 round-trips bitwise).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..nn.layer.base import Layer
+from ..observability import runlog as _runlog
+from ..observability.metrics import counter_inc as _counter_inc
+
+__all__ = [
+    "ShardedEmbedding", "sharded_embedding_lookup", "exchange_stats",
+    "EmbeddingCheckpointRotation",
+]
+
+
+def _round_up(n: int, m: int) -> int:
+    return -(-n // m) * m
+
+
+@functools.lru_cache(maxsize=None)
+def _local_lookup(n: int, axis: str, v_local: int, v_pad: int,
+                  num_emb: int, cap: int):
+    """The per-shard exchange body (ids ``[T]`` int32, table
+    ``[v_local, D]``), built once per static signature. ``v_pad`` (the
+    padded global row count) is the id sentinel: it is outside every
+    shard's range, so padded exchange slots can never alias a real row."""
+
+    @jax.custom_vjp
+    def lookup(table, ids):
+        out, _ = _fwd(table, ids)
+        return out
+
+    def _positions(owner_eff, T):
+        # offset-from-run-start positions, the PR-8 dispatch shape: bucket
+        # sizes via bincount, run starts via exclusive cumsum
+        counts = jnp.bincount(owner_eff, length=n + 1)
+        starts = (jnp.cumsum(counts) - counts).astype(jnp.int32)
+        return jnp.arange(T, dtype=jnp.int32) - starts[owner_eff]
+
+    def _fwd(table, ids):
+        T = ids.shape[0]
+        me = jax.lax.axis_index(axis)
+        valid = (ids >= 0) & (ids < num_emb)
+        ids_s = jnp.where(valid, ids, v_pad).astype(jnp.int32)
+        # unique ids, statically sized; jnp.unique sorts, so the result is
+        # already grouped by owner shard (owner = id // v_local ascends)
+        uniq, inv = jnp.unique(ids_s, size=T, fill_value=v_pad,
+                               return_inverse=True)
+        uniq = uniq.astype(jnp.int32)
+        inv = inv.reshape(T).astype(jnp.int32)
+        u_valid = uniq < v_pad
+        owner = jnp.clip(uniq // v_local, 0, n - 1).astype(jnp.int32)
+        owner_eff = jnp.where(u_valid, owner, n).astype(jnp.int32)
+        pos = _positions(owner_eff, T)
+        keep = u_valid & (pos < cap)
+        send = jnp.full((n, cap), v_pad, jnp.int32)
+        send = send.at[jnp.where(keep, owner_eff, n),
+                       jnp.where(keep, pos, 0)].set(uniq, mode="drop")
+        # id exchange: row d of the result holds the ids shard d asks me for
+        recv = jax.lax.all_to_all(send, axis, split_axis=0, concat_axis=0,
+                                  tiled=False)
+        lidx = jnp.clip(recv - me * v_local, 0, v_local - 1)
+        gathered = table[lidx]  # [n, cap, D]; padded slots are never read back
+        back = jax.lax.all_to_all(gathered, axis, split_axis=0, concat_axis=0,
+                                  tiled=False)
+        uniq_emb = back[jnp.clip(owner_eff, 0, n - 1),
+                        jnp.clip(pos, 0, cap - 1)]
+        out = uniq_emb[inv]
+        tok_live = valid & keep[inv]  # out-of-range or capacity-dropped -> 0-row
+        out = jnp.where(tok_live[:, None], out, 0.0).astype(table.dtype)
+        return out, (ids_s, tok_live)
+
+    def _bwd(res, dy):
+        # Token-level (not unique-level) routing, stable-sorted by owner:
+        # each shard holds a contiguous global-token range, so the owner's
+        # flat (peer, slot) scatter order IS global token order — the same
+        # per-row accumulation chain as the dense reference's single
+        # scatter, hence bitwise-equal grads even for cross-shard
+        # duplicate ids. Capacity is T here (never drops): every live
+        # token's gradient must land.
+        ids_s, tok_live = res
+        T = ids_s.shape[0]
+        me = jax.lax.axis_index(axis)
+        owner = jnp.clip(ids_s // v_local, 0, n - 1).astype(jnp.int32)
+        owner_eff = jnp.where(tok_live, owner, n).astype(jnp.int32)
+        order = jnp.argsort(owner_eff, stable=True).astype(jnp.int32)
+        oe_sorted = owner_eff[order]
+        pos = _positions(owner_eff, T)[order]
+        keep = oe_sorted < n
+        dy_sorted = jnp.where(tok_live[order][:, None], dy[order], 0.0)
+        row = jnp.where(keep, oe_sorted, n)
+        col = jnp.where(keep, pos, 0)
+        send_g = jnp.zeros((n, T) + dy.shape[1:], dy.dtype)
+        send_g = send_g.at[row, col].set(dy_sorted, mode="drop")
+        send_i = jnp.full((n, T), v_pad, jnp.int32)
+        send_i = send_i.at[row, col].set(ids_s[order], mode="drop")
+        g_recv = jax.lax.all_to_all(send_g, axis, split_axis=0,
+                                    concat_axis=0, tiled=False)
+        i_recv = jax.lax.all_to_all(send_i, axis, split_axis=0,
+                                    concat_axis=0, tiled=False)
+        flat_g = g_recv.reshape((n * T,) + dy.shape[1:])
+        flat_i = i_recv.reshape(n * T)
+        ok = flat_i < v_pad
+        lidx = jnp.where(ok, flat_i - me * v_local, v_local)
+        d_table = jnp.zeros((v_local,) + dy.shape[1:], dy.dtype)
+        # the row-sparse push: one scatter-add into the touched local rows
+        d_table = d_table.at[lidx].add(flat_g, mode="drop")
+        d_ids = np.zeros(ids_s.shape, dtype=jax.dtypes.float0)
+        return d_table, d_ids
+
+    lookup.defvjp(_fwd, _bwd)
+    return lookup
+
+
+def sharded_embedding_lookup(ids, table, mesh, axis: str = "dp",
+                             num_embeddings: Optional[int] = None,
+                             capacity: Optional[int] = None):
+    """Row-sharded embedding lookup over ``mesh[axis]`` inside shard_map.
+
+    ``table`` is the global ``[V, D]`` array (placed ``P(axis)``); ``ids``
+    is any-int-shaped with the leading (batch) dim sharded over ``axis``.
+    ``num_embeddings`` bounds the valid id range (defaults to V); ids
+    outside it return the zero row, the documented traced-mode contract
+    shared with ``F.embedding``. ``capacity`` caps the per-destination
+    unique-id exchange (a production knob for pathological skew);
+    overflowing ids drop to the zero row — the default (per-shard token
+    count) is exact. Returns ``ids.shape + (D,)``, batch-sharded.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    n = int(mesh.shape[axis])
+    V, D = int(table.shape[0]), int(table.shape[1])
+    if V % n != 0:
+        raise ValueError(
+            f"sharded_embedding_lookup: table rows {V} not divisible by "
+            f"mesh axis {axis!r} degree {n}; pad the table (ShardedEmbedding "
+            "pad_multiple handles this at construction)")
+    batch = int(ids.shape[0])
+    if batch % n != 0:
+        raise ValueError(
+            f"sharded_embedding_lookup: batch dim {batch} not divisible by "
+            f"mesh axis {axis!r} degree {n}")
+    t_local = int(np.prod(ids.shape)) // n
+    cap = t_local if capacity is None else max(1, min(int(capacity), t_local))
+    local = _local_lookup(n, axis, V // n, V,
+                          int(num_embeddings or V), cap)
+
+    def body(table_l, ids_l):
+        out = local(table_l, ids_l.reshape(-1))
+        return out.reshape(ids_l.shape + (D,))
+
+    in_specs = (P(axis), P(*([axis] + [None] * (ids.ndim - 1))))
+    out_specs = P(*([axis] + [None] * ids.ndim))
+    return jax.shard_map(body, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_vma=False)(table, ids)
+
+
+def exchange_stats(batch_tokens: int, vocab: int, dim: int, shards: int,
+                   capacity: Optional[int] = None, itemsize: int = 4) -> dict:
+    """Static per-step exchange accounting for one lookup over ``shards``
+    devices: ids/embedding payload bytes for the forward pair of
+    ``all_to_all``s and the backward pair, summed over devices (diagonal
+    included). Shape-derived — no dispatch needed, which is what lets the
+    bench and the run log report ``embedding_a2a_bytes_per_step`` without
+    instrumenting the compiled program."""
+    t_local = max(1, batch_tokens // max(1, shards))
+    cap = t_local if capacity is None else max(1, min(int(capacity), t_local))
+    ids_fwd = shards * shards * cap * 4
+    emb_fwd = shards * shards * cap * dim * itemsize
+    ids_bwd = shards * shards * t_local * 4
+    emb_bwd = shards * shards * t_local * dim * itemsize
+    return {
+        "shards": shards, "ids": batch_tokens, "capacity": cap,
+        "bytes_ids": ids_fwd + ids_bwd,
+        "bytes_emb": emb_fwd + emb_bwd,
+        "bytes_total": ids_fwd + emb_fwd + ids_bwd + emb_bwd,
+        "vocab": vocab, "dim": dim,
+    }
+
+
+class ShardedEmbedding(Layer):
+    """An embedding table row-sharded over a mesh axis.
+
+    The ``[V, D]`` weight is annotated ``dist_spec = P(axis)`` (and
+    ``_row_shard_axis``, the planner's template hint), so
+    ``fleet.distributed_step`` / ``planner.build_step`` place it
+    row-sharded; the forward routes lookups through
+    :func:`sharded_embedding_lookup` when the active mesh carries the axis
+    with degree > 1, and falls back to a dense local lookup (identical
+    zero-row semantics) on a single device. The mesh is resolved at trace
+    time from ``fleet``'s topology — the same hook the planner's candidate
+    scope overrides — unless an explicit ``mesh`` is pinned.
+
+    ``num_embeddings`` is the valid id range; the stored table is padded to
+    a ``pad_multiple`` row count so every mesh degree up to the multiple
+    divides it. In eager mode the layer records touched rows on the weight
+    (the ``Embedding(sparse=True)`` SelectedRows contract) so eager lazy
+    optimizers step only those rows.
+    """
+
+    def __init__(self, num_embeddings: int, embedding_dim: int,
+                 axis: str = "dp", mesh=None, capacity: Optional[int] = None,
+                 pad_multiple: int = 8, weight_attr=None, name=None):
+        super().__init__()
+        from jax.sharding import PartitionSpec as P
+
+        from ..nn import initializer as I
+
+        self.num_embeddings = int(num_embeddings)
+        self.embedding_dim = int(embedding_dim)
+        self.axis = axis
+        self.capacity = capacity
+        self._mesh = mesh
+        self.padded_rows = _round_up(self.num_embeddings, max(1, pad_multiple))
+        self.weight = self.create_parameter(
+            [self.padded_rows, self.embedding_dim], attr=weight_attr,
+            default_initializer=I.Normal(0.0, 1.0))
+        self.weight.dist_spec = P(axis)
+        self.weight._row_shard_axis = axis
+
+    def _resolve_mesh(self):
+        if self._mesh is not None:
+            return self._mesh
+        from .fleet import fleet
+
+        return fleet.mesh
+
+    def forward(self, x):
+        from ..framework.autograd import is_grad_enabled
+        from ..framework.selected_rows import is_traced_value, record_rows
+        from ..tensor._helpers import ensure_tensor, op
+
+        x = ensure_tensor(x)
+        mesh = self._resolve_mesh()
+        n = int(mesh.shape.get(self.axis, 1)) if mesh is not None else 1
+        num_emb, v_pad = self.num_embeddings, self.padded_rows
+        ids_val = x._value
+        if is_grad_enabled() and not is_traced_value(ids_val) \
+                and not self.weight.stop_gradient:
+            # eager SelectedRows contract: note touched rows for lazy
+            # optimizers, and account them (traced steps report through the
+            # run-log exchange events instead)
+            rows = np.unique(np.asarray(ids_val).ravel())
+            record_rows(self.weight, rows)
+            _counter_inc("embedding.rows_touched", int(rows.size))
+        _counter_inc("embedding.lookups")
+        if n > 1:
+            stats = exchange_stats(
+                int(np.prod(x.shape)), num_emb, self.embedding_dim, n,
+                self.capacity, np.dtype(self.weight._value.dtype).itemsize)
+            _counter_inc("embedding.ids_exchanged", stats["ids"])
+            _counter_inc("embedding.a2a_bytes", stats["bytes_total"])
+            _runlog.emit("embedding_exchange", axis=self.axis,
+                         traced=bool(is_traced_value(ids_val)), **stats)
+            cap = self.capacity
+
+            def fn(w, idx):
+                return sharded_embedding_lookup(
+                    idx, w, mesh, axis=self.axis, num_embeddings=num_emb,
+                    capacity=cap)
+
+            return op(fn, self.weight, x, _name="sharded_embedding")
+
+        def dense(w, idx):
+            # single-shard fallback: same zero-row semantics as the
+            # exchange path (and as traced F.embedding)
+            bad = (idx < 0) | (idx >= num_emb)
+            out = jnp.take(w, jnp.clip(idx, 0, v_pad - 1), axis=0)
+            return jnp.where(bad[..., None], 0.0, out).astype(w.dtype)
+
+        return op(dense, self.weight, x, _name="embedding_dense")
+
+    def exchange_stats(self, batch_tokens: int, shards: Optional[int] = None) -> dict:
+        """Static per-step a2a accounting for a ``batch_tokens``-id lookup
+        (see module-level :func:`exchange_stats`)."""
+        if shards is None:
+            mesh = self._resolve_mesh()
+            shards = int(mesh.shape.get(self.axis, 1)) if mesh is not None else 1
+        return exchange_stats(batch_tokens, self.num_embeddings,
+                              self.embedding_dim, shards, self.capacity,
+                              np.dtype(self.weight._value.dtype).itemsize)
+
+    def extra_repr(self):
+        return (f"num_embeddings={self.num_embeddings} (padded "
+                f"{self.padded_rows}), dim={self.embedding_dim}, "
+                f"axis={self.axis!r}")
+
+
+class EmbeddingCheckpointRotation:
+    """Online-learning checkpoint hook: periodic row-sharded embedding
+    checkpoint publication.
+
+    The reference PS streams per-key updates to stand-by storage; here the
+    sharded table already lives partitioned on the mesh, so the hook is
+    rotation policy around :class:`~paddle_tpu.distributed.resilience.
+    CheckpointManager`: every ``every`` optimizer steps the TrainStep state
+    is published atomically (keep-last-k GC is the manager's), with
+    ``embedding.rows_checkpointed`` accounting for the table leaves named
+    in ``table_names``. Restores go through
+    ``CheckpointManager.restore_latest(target=..., shardings=...)`` — the
+    PR-10 converter reshards row partitions bitwise across mesh degrees,
+    so an elastic rescale (dp4 -> dp2) resumes on a re-partitioned table.
+    """
+
+    def __init__(self, manager, every: int = 100, table_names=()):
+        if int(every) < 1:
+            raise ValueError(f"every must be >= 1, got {every}")
+        self.manager = manager
+        self.every = int(every)
+        self.table_names = tuple(table_names)
+        self._last_saved: Optional[int] = None
+
+    def maybe_save(self, state, step: int) -> Optional[str]:
+        """Publish ``state`` when ``step`` crosses the rotation period;
+        returns the checkpoint path or None when not due."""
+        if self._last_saved is not None and step - self._last_saved < self.every:
+            return None
+        return self.save(state, step)
+
+    def save(self, state, step: int) -> str:
+        from ..stability import state_to_savable
+
+        path = self.manager.save(state_to_savable(state), int(step))
+        params = state.get("params", {}) if isinstance(state, dict) else {}
+        rows = sum(int(params[name].shape[0]) for name in self.table_names
+                   if name in params)
+        if rows:
+            _counter_inc("embedding.rows_checkpointed", rows)
+        self._last_saved = int(step)
+        return path
+
+    def restore(self, target=None, shardings=None):
+        """(state, step) from the newest valid checkpoint, converted onto
+        ``target``/``shardings`` (a different mesh degree reshards the row
+        partition bitwise); None when no checkpoint exists. ``target`` is a
+        *savable* tree (``stability.state_to_savable``); the returned state
+        is already mapped back through ``state_from_savable``."""
+        from ..stability import state_from_savable
+
+        got = self.manager.restore_latest(target=target, shardings=shardings)
+        if got is None:
+            return None
+        state, step = got
+        return state_from_savable(state), step

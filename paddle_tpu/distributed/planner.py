@@ -53,6 +53,7 @@ import numpy as np
 
 __all__ = [
     "Plan", "PlannerError", "mesh_shapes", "annotated_specs",
+    "row_sharded_specs", "default_templates",
     "abstract_inputs", "search", "build_step", "elastic_replan",
     "format_plan_table", "main",
 ]
@@ -105,6 +106,30 @@ def annotated_specs(model) -> Dict[str, Any]:
     ``shard_tensor``) as a param-name -> PartitionSpec template."""
     return {n: p.dist_spec for n, p in model.named_parameters()
             if getattr(p, "dist_spec", None) is not None}
+
+
+def row_sharded_specs(model) -> Dict[str, Any]:
+    """Row-shard specs for params flagged ``_row_shard_axis``
+    (``ShardedEmbedding`` tables): a production-vocab table replicated
+    across the mesh is exactly the PTA206 waste finding, so the planner's
+    default templates must never emit it."""
+    from jax.sharding import PartitionSpec as P
+
+    return {n: P(p._row_shard_axis) for n, p in model.named_parameters()
+            if getattr(p, "_row_shard_axis", None)}
+
+
+def default_templates(model) -> Dict[str, Dict[str, Any]]:
+    """The template set ``search`` uses when none is supplied: the model's
+    annotations (plus embedding row specs) and a replicated baseline —
+    which still row-shards ``ShardedEmbedding`` tables, since replicating
+    them is never a candidate worth scoring at production vocab sizes."""
+    ann = annotated_specs(model)
+    row = row_sharded_specs(model)
+    templates: Dict[str, Dict[str, Any]] = (
+        {"annotated": {**row, **ann}} if (ann or row) else {})
+    templates.setdefault("replicated", dict(row))  # noqa: PTA104 (host-side, never traced)
+    return templates
 
 
 def _spec_entries(spec) -> List:
@@ -478,9 +503,7 @@ def search(model, n_devices: int, *, inputs_spec, labels_spec=None,
 
     # resolve the spec-template set
     if templates is None:
-        ann = annotated_specs(model)
-        templates = {"annotated": ann} if ann else {}
-        templates.setdefault("replicated", {})  # noqa: PTA104 (host-side, never traced)
+        templates = default_templates(model)
     resolved: Dict[str, Dict[str, Any]] = {}
     for name, t in templates.items():  # noqa: PTA102 (host-side, never traced)
         specs = t(model) if callable(t) else dict(t or {})

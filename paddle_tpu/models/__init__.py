@@ -17,3 +17,8 @@ from .gpt import (  # noqa: F401
     GPTForPretraining,
     GPTPretrainingCriterion,
 )
+from .dlrm import (  # noqa: F401
+    DLRM,
+    DLRMConfig,
+    DLRMCriterion,
+)

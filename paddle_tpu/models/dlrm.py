@@ -1,0 +1,122 @@
+"""DLRM-style recommender model over mesh-sharded embedding tables.
+
+The recsys workload SURVEY §7 scopes for the TPU port: a dense bottom MLP,
+N sparse-feature embedding bags served by ONE fused
+:class:`~paddle_tpu.distributed.embedding.ShardedEmbedding` table (the
+per-feature tables concatenate row-wise with static offsets — one
+``all_to_all`` exchange per step instead of N), the pairwise dot-product
+feature interaction, and a top MLP ending in a single logit. Training runs
+through the ordinary ``jit.TrainStep`` path — ``run_steps`` keeps the
+K-step one-dispatch scan — with :class:`paddle_tpu.optimizer.RowSparseAdam`
+supplying the per-step partial (touched-rows-only) embedding updates.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import nn
+from ..distributed.embedding import ShardedEmbedding
+from ..tensor._helpers import ensure_tensor, op
+
+
+class DLRMConfig:
+    """num_dense continuous features; one vocab size per sparse feature;
+    mlp tuples are hidden widths (bottom ends at embedding_dim, top at 1)."""
+
+    def __init__(self, num_dense=4, vocab_sizes=(64, 32, 128), embedding_dim=8,
+                 bottom_mlp=(16,), top_mlp=(16,), axis="dp", capacity=None,
+                 pad_multiple=8):
+        self.num_dense = int(num_dense)
+        self.vocab_sizes = tuple(int(v) for v in vocab_sizes)
+        self.embedding_dim = int(embedding_dim)
+        self.bottom_mlp = tuple(bottom_mlp)
+        self.top_mlp = tuple(top_mlp)
+        self.axis = axis
+        self.capacity = capacity
+        self.pad_multiple = int(pad_multiple)
+
+    @property
+    def num_sparse(self):
+        return len(self.vocab_sizes)
+
+    @property
+    def total_vocab(self):
+        return sum(self.vocab_sizes)
+
+    @staticmethod
+    def tiny():
+        """CPU-test scale: 3 features, 224 fused rows, D=8."""
+        return DLRMConfig()
+
+
+def _mlp(sizes):
+    pairs = zip(sizes[:-1], sizes[1:])
+    layers = [l for i, o in pairs for l in (nn.Linear(i, o), nn.ReLU())]
+    return nn.Sequential(*layers)
+
+
+class DLRM(nn.Layer):
+    """forward(dense [B, num_dense] f32, ids [B, F] int) -> logits [B, 1]."""
+
+    def __init__(self, config: DLRMConfig, mesh=None):
+        super().__init__()
+        self.config = config
+        d = config.embedding_dim
+        self.bottom = _mlp((config.num_dense,) + config.bottom_mlp + (d,))
+        self.embedding = ShardedEmbedding(
+            config.total_vocab, d, axis=config.axis, mesh=mesh,
+            capacity=config.capacity, pad_multiple=config.pad_multiple)
+        f = config.num_sparse
+        n_inter = (f + 1) * f // 2
+        top_sizes = (d + n_inter,) + config.top_mlp
+        hidden = [l for i, o in zip(top_sizes[:-1], top_sizes[1:])
+                  for l in (nn.Linear(i, o), nn.ReLU())]
+        self.top = nn.Sequential(*hidden, nn.Linear(top_sizes[-1], 1))
+        # per-feature row offsets into the fused table (static host ints)
+        self._offsets = tuple(int(x) for x in
+                              np.cumsum((0,) + config.vocab_sizes[:-1]))
+
+    def sparse_param_names(self):
+        """The fused-table param keys, as ``TrainStep`` state / optimizer
+        cores see them — the ``RowSparseAdam(sparse_params=...)`` input."""
+        return ["embedding.weight"]
+
+    def forward(self, dense, ids):
+        offsets = self._offsets
+
+        def shift(i):
+            return i + jnp.asarray(offsets, i.dtype)[None, :]
+
+        fused_ids = op(shift, ensure_tensor(ids), _name="dlrm_offsets")
+        bot = self.bottom(dense)                  # [B, D]
+        emb = self.embedding(fused_ids)           # [B, F, D]
+
+        def interact(d, e):
+            z = jnp.concatenate([d[:, None, :], e], axis=1)   # [B, F+1, D]
+            zz = jnp.einsum("bfd,bgd->bfg", z, z)
+            iu = jnp.triu_indices(z.shape[1], k=1)
+            return zz[:, iu[0], iu[1]]                        # [B, (F+1)F/2]
+
+        inter = op(interact, bot, emb, _name="dlrm_interact")
+
+        def cat(a, b):
+            return jnp.concatenate([a, b], axis=-1)
+
+        feats = op(cat, bot, inter, _name="dlrm_concat")
+        return self.top(feats)
+
+
+class DLRMCriterion:
+    """Binary cross-entropy with logits, mean over the batch (the CTR
+    objective); numerically stable log1p(exp) form, reductions in f32."""
+
+    def __call__(self, logits, labels):
+        def fn(x, y):
+            x = x.astype(jnp.float32).reshape(-1)
+            y = y.astype(jnp.float32).reshape(-1)
+            return jnp.mean(jnp.maximum(x, 0.0) - x * y
+                            + jnp.log1p(jnp.exp(-jnp.abs(x))))
+
+        return op(fn, ensure_tensor(logits), ensure_tensor(labels),
+                  _name="dlrm_bce")

@@ -66,8 +66,31 @@ def alpha_dropout(x, p=0.5, training=True, name=None):
 
 
 def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    """Row lookup with explicit out-of-range semantics.
+
+    XLA's gather clamps bad indices quietly (an id >= vocab silently read
+    the LAST row). Here the contract is explicit: in eager mode an
+    out-of-range id raises a structured ``ValueError`` naming the id and
+    its position; in traced code (where no host check can run) the lookup
+    returns the ZERO row for out-of-range ids — deterministic, and a bad-id
+    bug surfaces as missing signal instead of another row's gradient.
+    ``padding_idx`` rows emit zeros and receive no gradient."""
+
     def fn(w, idx):
-        out = jnp.take(w, idx, axis=0)
+        from ...framework.selected_rows import is_traced_value
+
+        v = w.shape[0]
+        bad = (idx < 0) | (idx >= v)
+        if not (is_traced_value(idx) or is_traced_value(w)):
+            if bool(jnp.any(bad)):
+                flat_bad = jnp.argmax(bad.reshape(-1))
+                pos = int(flat_bad)
+                offender = int(jnp.asarray(idx).reshape(-1)[pos])
+                raise ValueError(
+                    f"embedding(): id {offender} at flat position {pos} is "
+                    f"out of range [0, {v}) for a {v}-row table")
+        out = jnp.take(w, jnp.clip(idx, 0, v - 1), axis=0)
+        out = jnp.where(bad[..., None], 0.0, out).astype(w.dtype)
         if padding_idx is not None:
             mask = (idx == padding_idx)[..., None]
             out = jnp.where(mask, 0.0, out)

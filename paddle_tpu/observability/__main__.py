@@ -187,6 +187,24 @@ def analyze(events: List[dict]) -> dict:
             planner["reshard_seconds"] = sum(float(ev.get("seconds") or 0.0)  # noqa: PTA104 (host-side, never traced)
                                              for ev in reshard_evs)
         out["planner"] = planner  # noqa: PTA104 (host-side, never traced)
+    # recommender section from the sharded-embedding exchange events (one
+    # per ShardedEmbedding forward — per compiled program under jit) plus
+    # checkpoint-rotation publication counts
+    exch = [ev for ev in events if ev.get("event") == "embedding_exchange"]
+    if exch:
+        tables = sorted({(ev.get("vocab"), ev.get("dim")) for ev in exch})
+        last = exch[-1]
+        out["recsys"] = {
+            "lookups": len(exch),
+            "tables": [{"vocab": v, "dim": d} for v, d in tables],
+            "shards": last.get("shards"),
+            "ids_per_lookup": last.get("ids"),
+            # one fused table -> one lookup per step; the latest event's
+            # static payload is the per-step exchange cost
+            "a2a_bytes_per_step": int(last.get("bytes_total") or 0),
+            "exchange_capacity": last.get("capacity"),
+            "checkpoints_rotated": counts.get("checkpoint_save", 0),
+        }
     # kernel-selection section from the ops registry's kernel_select events
     # (one per distinct call signature: picked = a real kernel won,
     # fallback = the XLA composite served)
@@ -458,6 +476,18 @@ def print_report(path: str, a: dict) -> None:
             print(f"    checkpoint reshards: {pl['reshards']}   "  # noqa: PTA105 (host-side report printer)
                   f"{pl['reshard_bytes']:,} bytes in "
                   f"{pl['reshard_seconds']:.4f}s")
+    rc = a.get("recsys")
+    if rc:
+        print("  recommender (sharded-embedding exchange):")  # noqa: PTA105 (host-side report printer)
+        tables = "  ".join(f"[{t['vocab']}x{t['dim']}]"
+                           for t in rc.get("tables", []))
+        print(f"    lookups: {rc['lookups']}   tables: {tables or '-'}   "  # noqa: PTA105 (host-side report printer)
+              f"shards: {rc.get('shards')}")
+        print(f"    ids/lookup: {rc.get('ids_per_lookup')}   "  # noqa: PTA105 (host-side report printer)
+              f"a2a bytes/step: {int(rc.get('a2a_bytes_per_step') or 0):,}   "
+              f"capacity: {rc.get('exchange_capacity')}")
+        if rc.get("checkpoints_rotated"):
+            print(f"    checkpoints rotated: {rc['checkpoints_rotated']}")  # noqa: PTA105 (host-side report printer)
     ks = a.get("kernels")
     if ks:
         print("  kernel selection (ops registry, one row per kernel):")

@@ -209,6 +209,22 @@ PLANNER_COUNTERS: Tuple[str, ...] = (
 )
 
 
+# Recommender workload (distributed/embedding.py + models/dlrm.py):
+# embedding.lookups counts ShardedEmbedding forwards (per trace under jit
+# — one per compiled program — and per call in eager); ids_exchanged /
+# a2a_bytes are the static per-step exchange payloads those lookups
+# declared (shape-derived, see embedding.exchange_stats); rows_touched is
+# the eager-mode unique-row count (traced steps report through
+# embedding_exchange run-log events); rows_checkpointed counts table rows
+# published by EmbeddingCheckpointRotation. recsys.steps/examples are the
+# training-driver counters bench_recsys and the DLRM example bump.
+RECSYS_COUNTERS: Tuple[str, ...] = (
+    "recsys.steps", "recsys.examples",
+    "embedding.lookups", "embedding.ids_exchanged", "embedding.a2a_bytes",
+    "embedding.rows_touched", "embedding.rows_checkpointed",
+)
+
+
 # -------------------------------------------------------------------- gauges
 def gauge_set(name: str, value: float) -> None:
     _GAUGES[name] = value

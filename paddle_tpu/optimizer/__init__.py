@@ -12,4 +12,5 @@ from .optimizer import (  # noqa: F401
     Momentum,
     Optimizer,
     RMSProp,
+    RowSparseAdam,
 )

@@ -91,6 +91,55 @@ class AdamCore:
         return new_p, {"m": m.at[rows].set(m_r), "v": v.at[rows].set(v_r)}
 
 
+class RowSparseAdamCore(AdamCore):
+    """Adam whose traced update is row-sparse (lazy) for named embedding
+    tables: rows with an all-zero gradient this step keep their parameters
+    AND moments bitwise (no moment decay on unseen rows) — the
+    ``adam_op.h lazy_mode`` contract extended into compiled code, matching
+    the eager :meth:`AdamCore.row_update` path. Under a looked-up-rows
+    producer (``ShardedEmbedding``'s custom_vjp scatter-adds only touched
+    rows), the nonzero-grad row set IS the looked-up row set. The masked
+    update is elementwise over the row dim, so a row-sharded table updates
+    shard-locally with no extra collectives.
+
+    ``sparse`` names the state-tree param keys treated lazily (e.g.
+    ``DLRM.sparse_param_names()``); every other param takes the ordinary
+    dense Adam step.
+    """
+
+    def __init__(self, beta1=0.9, beta2=0.999, epsilon=1e-8, sparse=()):
+        super().__init__(beta1, beta2, epsilon)
+        self.sparse = frozenset(sparse)
+
+    def _row_update(self, g, m, v, p, lr, step):
+        touched = jnp.any(g != 0, axis=tuple(range(1, g.ndim)), keepdims=True)
+        g = g.astype(m.dtype)
+        m_new = jnp.where(touched, self.b1 * m + (1 - self.b1) * g, m)
+        v_new = jnp.where(touched, self.b2 * v + (1 - self.b2) * jnp.square(g), v)
+        t = step + 1
+        bc1 = 1 - self.b1**t
+        bc2 = 1 - self.b2**t
+        upd = (lr * (m_new / bc1) / (jnp.sqrt(v_new / bc2) + self.eps)).astype(p.dtype)
+        return jnp.where(touched, p - upd, p), m_new, v_new
+
+    def update(self, grads, state, params, lr, step):
+        sparse = self.sparse & set(params) if isinstance(params, dict) else frozenset()
+        if not sparse:
+            return super().update(grads, state, params, lr, step)
+        dense = set(params) - sparse
+        sub = lambda tree, ks: {k: tree[k] for k in ks}  # noqa: E731
+        new_p, new_st = super().update(
+            sub(grads, dense),
+            {"m": sub(state["m"], dense), "v": sub(state["v"], dense)},
+            sub(params, dense), lr, step)
+        new_m, new_v = dict(new_st["m"]), dict(new_st["v"])
+        new_p = dict(new_p)
+        for k in sparse:
+            new_p[k], new_m[k], new_v[k] = self._row_update(
+                grads[k], state["m"][k], state["v"][k], params[k], lr, step)
+        return new_p, {"m": new_m, "v": new_v}
+
+
 class AdamWCore(AdamCore):
     """Decoupled weight decay (reference: operators/optimizers/adamw_op). The
     ``apply_decay_fn`` predicate mirrors paddle's apply_decay_param_fun."""

@@ -207,6 +207,35 @@ class Adam(Optimizer):
         self._lazy_sparse = bool(lazy_mode)
 
 
+class RowSparseAdam(Optimizer):
+    """Adam with a row-sparse (lazy) traced update for the params named in
+    ``sparse_params`` — the recsys per-step partial embedding update: only
+    rows the batch looked up change (params and moments; unseen rows stay
+    bitwise), O(touched rows) semantics over a table whose vocab dwarfs any
+    batch. Eager mode inherits the ``Adam(lazy_mode=True)`` SelectedRows
+    path (``ShardedEmbedding``/``Embedding(sparse=True)`` record touched
+    rows). ``sparse_params`` uses TrainStep state keys — the model's
+    ``named_parameters`` names, e.g. ``DLRM.sparse_param_names()``.
+
+    ``weight_decay`` is rejected: decay touches every row every step, which
+    contradicts the lazy contract (use AdamW on the dense params instead).
+    """
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, sparse_params=(),
+                 weight_decay=None, grad_clip=None, name=None,
+                 multi_precision=False):
+        if weight_decay:
+            raise ValueError(
+                "RowSparseAdam does not support weight_decay: decay writes "
+                "every table row every step, defeating the row-sparse "
+                "update contract")
+        super().__init__(learning_rate, parameters, None, grad_clip,
+                         core=Fopt.RowSparseAdamCore(beta1, beta2, epsilon,
+                                                     sparse=sparse_params))
+        self._lazy_sparse = True
+
+
 class AdamW(Optimizer, _DecoupledWD):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, parameters=None, weight_decay=0.01, apply_decay_param_fun=None, grad_clip=None, lr_ratio=None, name=None, multi_precision=False):
         self.apply_decay_param_fun = apply_decay_param_fun

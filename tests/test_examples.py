@@ -23,6 +23,14 @@ def test_train_gpt_example():
 
 
 @pytest.mark.slow
+def test_train_dlrm_example():
+    out = _run("train_dlrm.py")
+    assert "resharded dp4 -> dp2 bitwise: True" in out
+    assert "examples/sec" in out
+    assert "embedding spec: ['dp']" in out
+
+
+@pytest.mark.slow
 def test_finetune_classifier_example():
     out = _run("finetune_classifier.py")
     assert "served int8 logits" in out

@@ -354,6 +354,36 @@ def test_run_resilient_resumes_on_shrunk_device_count(tmp_path, cache_dir):
 
 
 # ------------------------------------------------------ CLI + observability
+def test_planner_row_shards_sharded_embedding_tables():
+    """The template generator must emit row-sharded PartitionSpecs for
+    ``ShardedEmbedding`` tables in EVERY default template — a replicated
+    production-vocab table is exactly the PTA206 waste finding — and a
+    real search's chosen plan must carry the row spec."""
+    from paddle_tpu.models.dlrm import DLRM, DLRMConfig, DLRMCriterion
+    from paddle_tpu.optimizer import RowSparseAdam
+
+    paddle.seed(0)
+    cfg = DLRMConfig(num_dense=4, vocab_sizes=(32, 32), embedding_dim=8,
+                     bottom_mlp=(8,), top_mlp=(8,))
+    model = DLRM(cfg)
+    tpl = planner_mod.default_templates(model)
+    assert tpl["annotated"]["embedding.weight"] == P("dp")
+    assert tpl["replicated"]["embedding.weight"] == P("dp")  # never replicated
+
+    opt = RowSparseAdam(learning_rate=1e-3, parameters=model.parameters(),
+                        sparse_params=model.sparse_param_names())
+    inputs = [jax.ShapeDtypeStruct((8, cfg.num_dense), np.float32),
+              jax.ShapeDtypeStruct((8, cfg.num_sparse), np.int32)]
+    labels = [jax.ShapeDtypeStruct((8, 1), np.float32)]
+    plans = planner_mod.search(model, 2, inputs_spec=inputs,
+                               labels_spec=labels, loss=DLRMCriterion(),
+                               optimizer=opt, meshes=[{"dp": 2}],
+                               cache=False)
+    best = next(p for p in plans if p.feasible)
+    assert best.param_specs["embedding.weight"] == ["dp"]
+    assert best.collectives.get("all-to-all", 0) >= 1  # the exchange compiled
+
+
 def test_planner_cli_json(capsys, cache_dir):
     rc = planner_mod.main(["--devices", "2", "--json", "--no-cache",
                            "--batch", "2", "--seq", "8", "--vocab", "64",

@@ -1,0 +1,387 @@
+"""Sharded-embedding recommender subsystem (distributed/embedding.py,
+models/dlrm.py, optimizer.RowSparseAdam).
+
+Pins: sharded lookup forward AND gradient bitwise vs the single-device
+dense reference on a dp4 CPU mesh (uniform, power-law-skewed, duplicate-id
+and empty-shard batches); zero-row semantics for out-of-range ids and
+capacity overflow; the F.embedding satellite contract (eager ValueError,
+traced zero row, padding_idx grad masking); the row-sparse optimizer
+stepping only looked-up rows; DLRM through ``run_steps`` at one dispatch;
+embedding-shard checkpoint rotation surviving dp4 -> dp2 -> dp4 bitwise;
+and the recsys observability surface.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.distributed.embedding import (
+    EmbeddingCheckpointRotation,
+    ShardedEmbedding,
+    exchange_stats,
+    sharded_embedding_lookup,
+)
+from paddle_tpu.distributed.planner import Plan, build_step
+from paddle_tpu.models.dlrm import DLRM, DLRMConfig, DLRMCriterion
+from paddle_tpu.tensor._helpers import ensure_tensor, op
+
+V, D, B = 32, 8, 16
+
+
+def _mesh(n):
+    return Mesh(np.asarray(jax.devices()[:n]), ("dp",))
+
+
+def _table():
+    rng = np.random.default_rng(0)
+    return rng.normal(size=(V, D)).astype(np.float32)
+
+
+_ID_BATCHES = {
+    "uniform": np.random.default_rng(1).integers(0, V, B).astype(np.int32),
+    "skewed": np.minimum((np.random.default_rng(2).pareto(1.0, B) * 3)
+                         .astype(np.int32), V - 1),
+    "duplicates": np.array([3] * 8 + [17] * 8, np.int32),
+    # every id owned by shard 0: shards 1..3 serve zero requests
+    "empty_shards": np.random.default_rng(3).integers(0, V // 4, B).astype(np.int32),
+}
+
+
+# ------------------------------------------------- lookup fwd+grad bitwise
+@pytest.mark.parametrize("kind", sorted(_ID_BATCHES))
+def test_sharded_lookup_bitwise_vs_dense(kind):
+    mesh = _mesh(4)
+    table = _table()
+    ids = _ID_BATCHES[kind]
+    sh = NamedSharding(mesh, P("dp"))
+    tj = jax.device_put(jnp.asarray(table), sh)
+    ij = jax.device_put(jnp.asarray(ids), sh)
+
+    def loss_sharded(t, i):
+        o = sharded_embedding_lookup(i, t, mesh, axis="dp")
+        return jnp.sum(jnp.sin(o) * o), o
+
+    def loss_dense(t, i):
+        o = jnp.take(t, i, axis=0)
+        return jnp.sum(jnp.sin(o) * o), o
+
+    (_, outs), gs = jax.jit(jax.value_and_grad(loss_sharded, has_aux=True))(tj, ij)
+    (_, outd), gd = jax.jit(jax.value_and_grad(loss_dense, has_aux=True))(
+        jnp.asarray(table), jnp.asarray(ids))
+    np.testing.assert_array_equal(np.asarray(outs), np.asarray(outd))
+    np.testing.assert_array_equal(np.asarray(gs), np.asarray(gd))
+
+
+def test_sharded_lookup_out_of_range_zero_row_and_grad():
+    mesh = _mesh(4)
+    table = _table()
+    ids = np.array([0, 5, V + 3, -1] * 4, np.int32)  # 2 bad ids per quarter
+
+    def f(t, i):
+        return sharded_embedding_lookup(i, t, mesh, axis="dp",
+                                        num_embeddings=V)
+
+    out = np.asarray(jax.jit(f)(jnp.asarray(table), jnp.asarray(ids)))
+    bad = (ids < 0) | (ids >= V)
+    np.testing.assert_array_equal(out[bad], 0.0)
+    np.testing.assert_array_equal(out[~bad], table[ids[~bad]])
+    g = jax.jit(jax.grad(lambda t, i: jnp.sum(f(t, i))))(
+        jnp.asarray(table), jnp.asarray(ids))
+    # bad ids contribute no gradient anywhere
+    want = np.zeros_like(table)
+    np.add.at(want, ids[~bad], 1.0)
+    np.testing.assert_array_equal(np.asarray(g), want)
+
+
+def test_sharded_lookup_capacity_overflow_drops_to_zero_row():
+    mesh = _mesh(4)
+    table = _table()
+    # shard 0 owns rows [0, 8); ask it for 3 unique rows per requesting
+    # device with capacity 2 -> the 3rd unique id (highest, ids are
+    # deduped sorted) drops to the zero row
+    ids = np.array([0, 1, 2, 0] * 4, np.int32)
+
+    def f(t, i):
+        return sharded_embedding_lookup(i, t, mesh, axis="dp", capacity=2)
+
+    out = np.asarray(jax.jit(f)(jnp.asarray(table), jnp.asarray(ids)))
+    dropped = ids == 2
+    np.testing.assert_array_equal(out[dropped], 0.0)
+    np.testing.assert_array_equal(out[~dropped], table[ids[~dropped]])
+
+
+def test_sharded_embedding_layer_dense_fallback_matches_f_embedding():
+    paddle.seed(7)
+    emb = ShardedEmbedding(V, D, axis="dp")  # no mesh -> dense path
+    ids = paddle.to_tensor(_ID_BATCHES["uniform"])
+    ref = nn.functional.embedding(ids, emb.weight)
+    out = emb(ids)
+    np.testing.assert_array_equal(np.asarray(out.numpy()),
+                                  np.asarray(ref.numpy()))
+
+
+def test_divisibility_errors_are_structured():
+    mesh = _mesh(4)
+    with pytest.raises(ValueError, match="not divisible"):
+        sharded_embedding_lookup(jnp.zeros(16, jnp.int32),
+                                 jnp.zeros((30, D), jnp.float32), mesh)
+    with pytest.raises(ValueError, match="batch dim"):
+        sharded_embedding_lookup(jnp.zeros(6, jnp.int32),
+                                 jnp.zeros((V, D), jnp.float32), mesh)
+
+
+# ------------------------------------------------- F.embedding satellites
+def test_f_embedding_eager_out_of_range_raises():
+    w = paddle.to_tensor(_table())
+    ids = paddle.to_tensor(np.array([1, 2, 40, 3], np.int32))
+    with pytest.raises(ValueError, match=r"id 40 at flat position 2"):
+        nn.functional.embedding(ids, w)
+    with pytest.raises(ValueError, match=r"out of range \[0, 32\)"):
+        nn.functional.embedding(paddle.to_tensor(np.array([-1], np.int32)), w)
+
+
+def test_f_embedding_traced_clip_to_zero_row():
+    table = _table()
+    ids = np.array([1, 40, -2, 3], np.int32)
+
+    @jax.jit
+    def f(w, i):
+        out = nn.functional.embedding(paddle.to_tensor(i), paddle.to_tensor(w))
+        return out._value
+
+    out = np.asarray(f(jnp.asarray(table), jnp.asarray(ids)))
+    np.testing.assert_array_equal(out[0], table[1])
+    np.testing.assert_array_equal(out[3], table[3])
+    np.testing.assert_array_equal(out[1], 0.0)  # >= V: zero row, not row V-1
+    np.testing.assert_array_equal(out[2], 0.0)  # < 0: zero row, not row 0
+
+
+def test_f_embedding_padding_idx_masks_output_and_grad():
+    w = paddle.to_tensor(_table(), stop_gradient=False)
+    ids = paddle.to_tensor(np.array([2, 0, 2, 5], np.int32))
+    out = nn.functional.embedding(ids, w, padding_idx=2)
+    np.testing.assert_array_equal(np.asarray(out.numpy())[[0, 2]], 0.0)
+    out.sum().backward()
+    g = np.asarray(w.grad.numpy())
+    np.testing.assert_array_equal(g[2], 0.0)  # padding row gets no grad
+    assert g[0].sum() != 0 and g[5].sum() != 0
+
+
+# --------------------------------------------- row-sparse optimizer (lazy)
+class _EmbOnly(nn.Layer):
+    def __init__(self, rows, dim):
+        super().__init__()
+        self.emb = ShardedEmbedding(rows, dim, axis="dp")
+
+    def forward(self, ids):
+        return self.emb(ids)
+
+
+class _DotLoss:
+    def __call__(self, out, y):
+        return op(lambda o, v: jnp.sum(o * v), ensure_tensor(out),
+                  ensure_tensor(y), _name="dot_loss")
+
+
+def _emb_steps(opt_factory):
+    paddle.seed(0)
+    model = _EmbOnly(V, D)
+    opt = opt_factory(model)
+    step = paddle.jit.TrainStep(model, opt, _DotLoss(), seed=0)
+    w0 = np.asarray(step.state["params"]["emb.weight"])
+    rng = np.random.default_rng(0)
+    ids1 = np.array([1, 3, 3, 9], np.int32)          # touch rows {1, 3, 9}
+    ids2 = np.array([1, 9, 9, 12], np.int32)         # row 3 NOT touched
+    y1 = rng.normal(size=(4, D)).astype(np.float32)
+    y2 = rng.normal(size=(4, D)).astype(np.float32)
+    step((ids1,), (y1,))
+    w1 = np.asarray(step.state["params"]["emb.weight"])
+    m1 = np.asarray(step.state["opt"]["m"]["emb.weight"])
+    step((ids2,), (y2,))
+    return w0, w1, m1, step
+
+
+def test_row_sparse_adam_steps_only_looked_up_rows():
+    from paddle_tpu.optimizer import Adam, RowSparseAdam
+
+    w0, w1, m1, step = _emb_steps(lambda m: RowSparseAdam(
+        learning_rate=0.1, parameters=m.parameters(),
+        sparse_params=["emb.weight"]))
+    w2 = np.asarray(step.state["params"]["emb.weight"])
+    m2 = np.asarray(step.state["opt"]["m"]["emb.weight"])
+    v2 = np.asarray(step.state["opt"]["v"]["emb.weight"])
+    touched1, touched2 = {1, 3, 9}, {1, 9, 12}
+    never = sorted(set(range(V)) - touched1 - touched2)
+    # rows never looked up: params AND moments bitwise at init (zeros)
+    np.testing.assert_array_equal(w2[never], w0[never])
+    np.testing.assert_array_equal(m2[never], 0.0)
+    np.testing.assert_array_equal(v2[never], 0.0)
+    # row 3 was looked up in step 1 only: step 2 leaves it bitwise —
+    # params at their post-step-1 value, moment un-decayed
+    np.testing.assert_array_equal(w2[3], w1[3])
+    np.testing.assert_array_equal(m2[3], m1[3])
+    assert np.abs(m1[3]).sum() > 0  # the moment is live, not trivially zero
+
+    # teeth: dense Adam WOULD have moved row 3 in step 2 (moment decay)
+    _, w1d, m1d, dstep = _emb_steps(lambda m: Adam(
+        learning_rate=0.1, parameters=m.parameters()))
+    w2d = np.asarray(dstep.state["params"]["emb.weight"])
+    m2d = np.asarray(dstep.state["opt"]["m"]["emb.weight"])
+    assert not np.array_equal(w2d[3], w1d[3])
+    assert not np.array_equal(m2d[3], m1d[3])
+    # and on touched rows the two paths agree step 1 (zero moments in)
+    np.testing.assert_array_equal(w1[3], w1d[3])
+
+
+def test_row_sparse_adam_rejects_weight_decay():
+    from paddle_tpu.optimizer import RowSparseAdam
+
+    with pytest.raises(ValueError, match="weight_decay"):
+        RowSparseAdam(weight_decay=0.1)
+
+
+# ------------------------------------------------------- DLRM training path
+_CFG = DLRMConfig(num_dense=4, vocab_sizes=(64, 32, 128), embedding_dim=8,
+                  bottom_mlp=(16,), top_mlp=(16,))
+
+
+def _dlrm_batch(rng, batch=8):
+    dense = rng.normal(size=(batch, _CFG.num_dense)).astype(np.float32)
+    ids = np.stack([rng.integers(0, v, batch) for v in _CFG.vocab_sizes],
+                   axis=1).astype(np.int32)
+    labels = rng.integers(0, 2, (batch, 1)).astype(np.float32)
+    return (dense, ids), (labels,)
+
+
+def _dlrm_plan(ndev):
+    return Plan(mesh={"dp": ndev} if ndev > 1 else {}, template="row",
+                n_devices=ndev, param_specs={"embedding.weight": ["dp"]})
+
+
+def _dlrm_step(ndev, seed=0):
+    from paddle_tpu.optimizer import RowSparseAdam
+
+    paddle.seed(seed)
+    model = DLRM(_CFG)
+    opt = RowSparseAdam(learning_rate=1e-2, parameters=model.parameters(),
+                        sparse_params=model.sparse_param_names())
+    return build_step(model, opt, DLRMCriterion(), _dlrm_plan(ndev),
+                      devices=jax.devices()[:ndev], seed=0), model
+
+
+def test_dlrm_run_steps_one_dispatch_and_sharded_parity():
+    from paddle_tpu import profiler
+
+    step4, _ = _dlrm_step(4)
+    rng = np.random.default_rng(0)
+    batches = [_dlrm_batch(rng) for _ in range(3)]
+    profiler.reset_counters("train_step.")
+    metrics = step4.run_steps(batches)
+    c = profiler.counters("train_step.")
+    assert c["train_step.dispatches"] == 1  # K steps, ONE XLA dispatch
+    assert c["train_step.steps"] == 3
+    losses4 = np.asarray(metrics["loss"].numpy())
+    assert losses4.shape == (3,) and np.all(np.isfinite(losses4))
+
+    # sharded dp4 training matches the single-device run: the lookup is
+    # bitwise; MLP grad all-reduce association makes the rest ~1e-6
+    step1, _ = _dlrm_step(1)
+    m1 = step1.run_steps(batches)
+    np.testing.assert_allclose(losses4, np.asarray(m1["loss"].numpy()),
+                               rtol=2e-5, atol=2e-6)
+    w4 = np.asarray(step4.state["params"]["embedding.weight"])
+    w1 = np.asarray(step1.state["params"]["embedding.weight"])
+    np.testing.assert_allclose(w4, w1, rtol=2e-5, atol=2e-6)
+
+
+def test_embedding_checkpoint_rotation_dp4_dp2_dp4_bitwise(tmp_path):
+    from paddle_tpu.distributed.resilience import CheckpointManager
+    from paddle_tpu.observability.metrics import counters, reset_counters
+    from paddle_tpu.stability import state_to_savable
+
+    step4, model4 = _dlrm_step(4)
+    rng = np.random.default_rng(0)
+    step4.run_steps([_dlrm_batch(rng) for _ in range(2)])
+    flat0 = {str(p): np.asarray(l) for p, l in
+             jax.tree_util.tree_flatten_with_path(
+                 state_to_savable(step4.state))[0]}
+
+    reset_counters("embedding.")
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), keep_last_k=2)
+    rot = EmbeddingCheckpointRotation(mgr, every=1,
+                                      table_names=model4.sparse_param_names())
+    assert rot.maybe_save(step4.state, 2)
+    assert counters("embedding.")["embedding.rows_checkpointed"] > 0
+    assert rot.maybe_save(step4.state, 2) is None  # within the period
+
+    # elastic scale-DOWN: restore the dp4 checkpoint onto a dp2 mesh
+    step2, _ = _dlrm_step(2)
+    got = rot.restore(target=state_to_savable(step2.state),
+                      shardings=dict(step2._state_shardings))
+    assert got is not None
+    state2, at = got
+    assert at == 2
+    step2.set_state(state2)
+    rot2 = EmbeddingCheckpointRotation(
+        CheckpointManager(str(tmp_path / "ckpt2")), every=1,
+        table_names=model4.sparse_param_names())
+    rot2.save(step2.state, 3)
+
+    # back UP to dp4: the round-tripped state is bitwise the original
+    step4b, _ = _dlrm_step(4)
+    state4, _ = rot2.restore(target=state_to_savable(step4b.state),
+                             shardings=dict(step4b._state_shardings))
+    flat1 = {str(p): np.asarray(l) for p, l in
+             jax.tree_util.tree_flatten_with_path(
+                 state_to_savable(state4))[0]}
+    assert flat0.keys() == flat1.keys()
+    for key in flat0:
+        np.testing.assert_array_equal(flat0[key], flat1[key], err_msg=key)
+    # ...and the restored dp2 step can actually train on
+    step2.run_steps([_dlrm_batch(rng)])
+
+
+# ------------------------------------------------------- observability
+def test_embedding_exchange_events_and_counters():
+    import paddle_tpu.observability as obs
+    from paddle_tpu.observability.metrics import counters, reset_counters
+
+    reset_counters("embedding.")
+    obs.monitor().clear()
+    mesh = _mesh(4)
+    paddle.seed(0)
+    emb = ShardedEmbedding(V, D, axis="dp", mesh=mesh)
+    ids = jax.device_put(jnp.asarray(_ID_BATCHES["uniform"]),
+                         NamedSharding(mesh, P("dp")))
+    emb.weight._value = jax.device_put(emb.weight._value,
+                                       NamedSharding(mesh, P("dp")))
+    with paddle.no_grad():
+        emb(paddle.to_tensor(ids))
+    c = counters("embedding.")
+    stats = exchange_stats(B, V, D, 4)
+    assert c["embedding.lookups"] == 1
+    assert c["embedding.ids_exchanged"] == B
+    assert c["embedding.a2a_bytes"] == stats["bytes_total"] > 0
+    evs = obs.monitor().events("embedding_exchange")
+    assert len(evs) == 1 and evs[0]["shards"] == 4
+    # the report CLI renders a recsys section from these events
+    from paddle_tpu.observability.__main__ import analyze
+
+    section = analyze(evs)["recsys"]
+    assert section["lookups"] == 1
+    assert section["a2a_bytes_per_step"] == stats["bytes_total"]
+    assert section["shards"] == 4
+
+
+def test_recsys_counters_predeclared():
+    from paddle_tpu.observability.metrics import RECSYS_COUNTERS, counters
+
+    have = counters()
+    for name in RECSYS_COUNTERS:
+        assert name in have, name

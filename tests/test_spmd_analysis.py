@@ -154,6 +154,37 @@ def test_mis_sharded_gpt_mp_layer_pta201_pta202():
     json.dumps(js)  # fully serializable
 
 
+# ----------------------------------------- sharded-embedding exchange pin
+def test_sharded_embedding_exchange_pta202_clean():
+    """The recsys ``ShardedEmbedding`` exchange on a dp4 CPU mesh:
+    fwd + grad carry the deliberate ``all_to_all`` pair(s) — a routed
+    exchange, NOT a contraction reshard — so the analyzer must report the
+    all-to-alls in the schedule with ZERO PTA202 findings (and no implicit
+    full-gather of the table: payloads stay O(batch))."""
+    from paddle_tpu.distributed.embedding import sharded_embedding_lookup
+
+    mesh = Mesh(np.asarray(jax.devices()[:4]), ("dp",))
+    V, D, B = 32, 8, 16
+    table = jnp.arange(V * D, dtype=jnp.float32).reshape(V, D) / (V * D)
+    ids = (jnp.arange(B, dtype=jnp.int32) * 5) % V
+    sh = lambda s: NamedSharding(mesh, s)  # noqa: E731
+
+    def loss(t, i):
+        out = sharded_embedding_lookup(i, t, mesh, axis="dp")
+        return jnp.sum(out * out)
+
+    jf = jax.jit(jax.grad(loss), in_shardings=(sh(P("dp")), sh(P("dp"))),
+                 out_shardings=sh(P("dp")))
+    rep = analyze_jit(jf, (table, ids), label="sharded-embedding",
+                      options=ShardCheckOptions(allgather_warn_bytes=1))
+    # id exchange + embedding return (fwd) and the grad push (bwd)
+    assert rep.counts().get("all-to-all", 0) >= 3
+    assert not any(d.code == "PTA202" for d in rep.diagnostics), \
+        [d.message for d in rep.diagnostics if d.code == "PTA202"]
+    assert not any(d.code == "PTA201" for d in rep.diagnostics), \
+        [d.message for d in rep.diagnostics if d.code == "PTA201"]
+
+
 # ------------------------------------------- dryrun mesh families via fleet
 def _fleet_step(dp, mp, sdp=1, stage=0):
     from paddle_tpu.distributed import fleet
