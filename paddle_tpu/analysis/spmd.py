@@ -382,5 +382,13 @@ def shard_check(compiled, component: str, label: str = "", kind: str = "",
         if d.severity == "warning":
             _warnings.warn(f"FLAGS_shard_check: {d}", stacklevel=3)
     if errors and raise_on_error:
-        raise ProgramAnalysisError(errors)
+        # PTA204/205 abort the dispatch — leave a flight-recorder dump so
+        # the post-mortem carries the analysis verdict and the event tail
+        from ..observability import flightrec as _flightrec
+
+        err = ProgramAnalysisError(errors)
+        _flightrec.dump("analysis_error", err, component=component,
+                        label=label, kind=kind,
+                        codes=[d.code for d in errors])
+        raise err
     return report

@@ -420,6 +420,10 @@ def run_resilient(train_step_fn: Callable[[Any, int, List[int]], Any], *,
     backoff; the fault that exhausts the budget propagates. Returns
     ``(final_state, restarts_used)``.
     """
+    from ..observability import exporter as _exporter
+    from ..observability import flightrec as _flightrec
+    from ..observability import trace as _trace
+
     members = node.wait_for(min_nodes, max_nodes, settle=settle,
                             deadline=deadline)
     state, step = init_state, 0
@@ -429,6 +433,12 @@ def run_resilient(train_step_fn: Callable[[Any, int, List[int]], Any], *,
     if restored is not None:
         state, step = restored
     restarts = 0
+    # one trace id for the whole supervised run: every step event and every
+    # incident span (hold / rollback / rescale / resume) correlates to it
+    run_trace = _trace.new_trace_id("resilient")
+    # live export for the long-lived worker (no-op at FLAGS_metrics_port=0)
+    _exporter.ensure_started(store=getattr(node, "store", None),
+                             rank=getattr(node, "node_id", 0))
 
     def _emit(kind, **info):
         if on_event is not None:
@@ -444,56 +454,68 @@ def run_resilient(train_step_fn: Callable[[Any, int, List[int]], Any], *,
 
     _membership_events([], members, step)
     _emit("start", step=step, members=members)
-    while step < num_steps:
-        try:
-            if membership_check_every and step % membership_check_every == 0:
-                alive = node.alive_nodes()
-                if alive != members:
-                    raise _MembershipChanged(f"{members} -> {alive}")
-            chaos.crash_if_due("train_step", step)
-            state = train_step_fn(state, step, members)
-        except (WorkerFault, chaos.ChaosCrash, _MembershipChanged) as fault:
-            if restarts >= max_restarts:
-                _emit("giveup", step=step, fault=repr(fault))
-                raise
-            restarts += 1
-            _emit("hold", step=step, fault=repr(fault), restart=restarts)
-            from ..stability import DivergenceFault
+    with _trace.attach(run_trace):  # step events inherit the run's trace id
+        while step < num_steps:
+            try:
+                if membership_check_every and step % membership_check_every == 0:
+                    alive = node.alive_nodes()
+                    if alive != members:
+                        raise _MembershipChanged(f"{members} -> {alive}")
+                chaos.crash_if_due("train_step", step)
+                state = train_step_fn(state, step, members)
+            except (WorkerFault, chaos.ChaosCrash, _MembershipChanged) as fault:
+                if restarts >= max_restarts:
+                    _emit("giveup", step=step, fault=repr(fault))
+                    raise
+                restarts += 1
+                _emit("hold", step=step, fault=repr(fault), restart=restarts)
+                _trace.span_event("resilient.hold", trace_id=run_trace,
+                                  step=step, restart=restarts,
+                                  fault=type(fault).__name__)
+                from ..stability import DivergenceFault
 
-            if isinstance(fault, DivergenceFault):
-                # divergence rewind: the in-flight state is numerically
-                # poisoned — restore WITHOUT persisting it first
-                _counter_inc("stability.rollbacks")
-                _runlog.emit("rollback", step=step, reason=str(fault),
-                             rollbacks=restarts)
-            else:
-                manager.save(state, step)  # HOLD: make current progress durable
-            time.sleep(backoff * (2 ** (restarts - 1)))
-            prev_members = members
-            members = node.wait_for(min_nodes, max_nodes, settle=settle,
-                                    deadline=deadline)
-            _membership_events(prev_members, members, step)
-            if on_rescale is not None and members != prev_members:
-                # elastic re-plan during the HOLD window: the hook searches/
-                # builds for the new topology (compiling the new mesh's
-                # program now, while nothing else runs) and hands back the
-                # target+shardings the checkpoint should reshard onto
-                rescaled = on_rescale(members, state)
-                if rescaled is not None:
-                    if isinstance(rescaled, tuple):
-                        restore_target, restore_shardings = rescaled
-                    else:
-                        restore_target = rescaled
-                    state = restore_target
-            restored = manager.restore_latest(target=restore_target,
-                                              shardings=restore_shardings)
-            if restored is not None:
-                state, step = restored
-            _emit("resume", step=step, members=members, restart=restarts)
-            continue
-        step += 1
-        if checkpoint_every and step % checkpoint_every == 0:
-            manager.save(state, step)
+                if isinstance(fault, DivergenceFault):
+                    # divergence rewind: the in-flight state is numerically
+                    # poisoned — restore WITHOUT persisting it first
+                    _counter_inc("stability.rollbacks")
+                    _runlog.emit("rollback", step=step, reason=str(fault),
+                                 rollbacks=restarts, trace=run_trace)
+                    _flightrec.dump("divergence", fault, step=step,
+                                    restart=restarts)
+                else:
+                    manager.save(state, step)  # HOLD: make current progress durable
+                time.sleep(backoff * (2 ** (restarts - 1)))
+                prev_members = members
+                members = node.wait_for(min_nodes, max_nodes, settle=settle,
+                                        deadline=deadline)
+                _membership_events(prev_members, members, step)
+                if on_rescale is not None and members != prev_members:
+                    # elastic re-plan during the HOLD window: the hook
+                    # searches/builds for the new topology (compiling the new
+                    # mesh's program now, while nothing else runs) and hands
+                    # back the target+shardings the checkpoint reshards onto
+                    with _trace.trace_span("resilient.rescale",
+                                           trace_id=run_trace, step=step,
+                                           members=list(members)):
+                        rescaled = on_rescale(members, state)
+                    if rescaled is not None:
+                        if isinstance(rescaled, tuple):
+                            restore_target, restore_shardings = rescaled
+                        else:
+                            restore_target = rescaled
+                        state = restore_target
+                restored = manager.restore_latest(target=restore_target,
+                                                  shardings=restore_shardings)
+                if restored is not None:
+                    state, step = restored
+                _emit("resume", step=step, members=members, restart=restarts)
+                _trace.span_event("resilient.resume", trace_id=run_trace,
+                                  step=step, restart=restarts,
+                                  members=list(members))
+                continue  # noqa: PTA103 (host-side, never traced)
+            step += 1
+            if checkpoint_every and step % checkpoint_every == 0:
+                manager.save(state, step)
     manager.save(state, num_steps)
     _emit("done", step=num_steps, restarts=restarts)
     return state, restarts

@@ -90,6 +90,11 @@ on_flag_set("FLAGS_compile_cache_dir", _apply_compile_cache_dir)
 # Observability spine (paddle_tpu/observability/).
 define_flag("FLAGS_monitor", True, "always-on runtime telemetry: step/compile/checkpoint run-log events, timeline spans and span histograms (spans become no-ops when off)")
 define_flag("FLAGS_run_log_dir", "", "directory for the structured run log (JSONL, one run-<pid>.jsonl per process); empty keeps events only in the in-memory ring")
+define_flag("FLAGS_run_log_max_mb", 64.0, "size-based run-log rotation: when run-<pid>.jsonl exceeds this many MiB it is renamed to run-<pid>.1.jsonl (replacing any prior rotation) and a fresh file is opened; 0 disables rotation (unbounded growth)")
+define_flag("FLAGS_run_log_keep", 16, "keep-last-k GC of stale run logs: when a process opens its run log it deletes dead pids' run-*.jsonl files under FLAGS_run_log_dir beyond the newest k (by mtime); 0 disables the GC")
+define_flag("FLAGS_trace", True, "distributed tracing plane (observability/trace.py): deterministic per-request/per-run trace ids propagated through ServingFleet submit->route->prefill->decode->requeue->delivery and run_resilient per-step/per-incident spans, emitted as 'span' run-log events; off allocates no ids and emits no span events (the bench's tracing-off arm)")
+define_flag("FLAGS_metrics_port", 0, "live metrics export (observability/exporter.py): serve /metrics (Prometheus text), /healthz and /snapshot (JSON) on this localhost port from a stdlib HTTP server started by ServingFleet and run_resilient workers; 0 (default) disables the server")
+define_flag("FLAGS_flightrec_events", 256, "crash flight recorder (observability/flightrec.py): dump the last N run-log ring events plus a metrics snapshot to flightrec-<pid>.json on replica death, DivergenceFault, PTA204/205 analysis errors and unhandled dispatch exceptions; 0 disables the recorder")
 
 # Fault-tolerance runtime (distributed/resilience.py).
 define_flag("FLAGS_collective_timeout_s", 0.0, "watchdog: report a cross-process collective still pending after this many seconds (0 = off)")

@@ -418,12 +418,21 @@ class DecodeEngine:
                              bytes_accessed=info.get("bytes_accessed"),
                              peak_bytes=info.get("peak_bytes"))
         try:
-            return entry(*args)
-        except (TypeError, ValueError):
-            if entry is jitfn:
-                raise
-            self._compiled[sig] = jitfn  # AOT aval drift: jit path forever
-            return jitfn(*args)
+            try:
+                return entry(*args)
+            except (TypeError, ValueError):
+                if entry is jitfn:
+                    raise
+                self._compiled[sig] = jitfn  # AOT aval drift: jit path forever
+                return jitfn(*args)
+        except Exception as exc:
+            # unhandled dispatch fault (aval drift already fell back above):
+            # leave a flight-recorder dump, then let the fault propagate
+            from ..observability import flightrec as _flightrec
+
+            _flightrec.dump("dispatch_exception", exc, component="infer",
+                            which=which, label=label or which)
+            raise
 
     # ------------------------------------------------------------ slot API
     def bucket_for(self, prompt_len: int) -> int:

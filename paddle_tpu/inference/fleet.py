@@ -50,7 +50,10 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from ..observability import exporter as _exporter
+from ..observability import flightrec as _flightrec
 from ..observability import runlog as _runlog
+from ..observability import trace as _trace
 from ..observability.metrics import counter_inc, gauge_set, observe
 from ..testing import chaos
 from .router import Router
@@ -92,18 +95,20 @@ class FleetRequest:
     replica that finishes the request)."""
 
     __slots__ = ("fid", "prompt", "max_new_tokens", "eos_token_id", "seed",
-                 "deadline_s", "status", "tokens", "replica", "attempts",
-                 "submitted_ts", "first_token_ts", "finished_ts")
+                 "deadline_s", "trace_id", "status", "tokens", "replica",
+                 "attempts", "submitted_ts", "first_token_ts", "finished_ts")
 
     def __init__(self, fid: int, prompt, max_new_tokens: int,
                  eos_token_id: Optional[int], seed: int,
-                 deadline_s: Optional[float]):
+                 deadline_s: Optional[float],
+                 trace_id: Optional[str] = None):
         self.fid = fid
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.max_new_tokens = int(max_new_tokens)
         self.eos_token_id = eos_token_id
         self.seed = int(seed)
         self.deadline_s = deadline_s
+        self.trace_id = trace_id      # one id, submit through delivery
         self.status = "queued"
         self.tokens: List[int] = []
         self.replica: Optional[int] = None    # current/last placement
@@ -230,8 +235,19 @@ class ServingFleet:
         for _ in range(int(replicas)):
             self._add_replica()
         self._emit_membership()
+        # live export (FLAGS_metrics_port; no-op at the default 0): the
+        # fleet driver is exactly the long-lived process /metrics exists for
+        _exporter.register_health("fleet", self._health)
+        _exporter.ensure_started(store=self._store)
 
     # ------------------------------------------------------------ replicas
+    def _health(self) -> dict:
+        """The /healthz probe: fleet liveness is replica liveness."""
+        alive = sorted(self._alive())
+        dead = sorted(set(self.replicas) - set(alive))
+        return {"ok": bool(alive), "replicas_alive": alive,
+                "replicas_dead": dead, "queue_depth": self.queue_depth()}
+
     def _beat(self, rid: int) -> None:
         self._store.set(f"{self._HB_PREFIX}/{rid}", repr(time.time()))
 
@@ -332,8 +348,11 @@ class ServingFleet:
         fid = self._next_fid
         self._next_fid += 1
         freq = FleetRequest(fid, prompt, max_new_tokens, eos_token_id, seed,
-                            deadline_s)
+                            deadline_s, trace_id=_trace.new_trace_id("fleet"))
         self.requests[fid] = freq
+        _runlog.emit("fleet", kind="submitted", component="fleet", id=fid,
+                     trace=freq.trace_id, prompt_tokens=len(freq.prompt),
+                     max_new_tokens=freq.max_new_tokens)
         self._place(freq, rid, reason)
         counter_inc("fleet.requests_submitted")
         gauge_set("fleet.queue_depth", self.queue_depth())
@@ -349,13 +368,14 @@ class ServingFleet:
         local = rep.scheduler.submit(
             freq.prompt, max_new_tokens=freq.max_new_tokens,
             eos_token_id=freq.eos_token_id, seed=freq.seed,
-            deadline_s=deadline_s)
+            deadline_s=deadline_s, trace_id=freq.trace_id)
         self.router.register(freq.prompt, rid)
         freq.replica = rid
         freq.status = "running"
         self._inflight[rid][local] = freq.fid
         _runlog.emit("fleet", kind="placed", component="fleet", id=freq.fid,
-                     replica=rid, reason=reason, attempt=freq.attempts)
+                     replica=rid, reason=reason, attempt=freq.attempts,
+                     trace=freq.trace_id)
 
     # ----------------------------------------------------------- the loop
     def step(self) -> List[FleetRequest]:
@@ -413,7 +433,8 @@ class ServingFleet:
             observe("fleet.latency_seconds", freq.total_seconds)
             _runlog.emit("fleet", kind="finished", component="fleet",
                          id=fid, replica=rep.rid, new_tokens=len(freq.tokens),
-                         seconds=freq.total_seconds, attempts=freq.attempts)
+                         seconds=freq.total_seconds, attempts=freq.attempts,
+                         trace=freq.trace_id)
             done.append(freq)  # noqa: PTA104 (host-side serving loop, never traced)
         for local in [l for l in list(inflight) if l in rep.scheduler.cancelled]:
             fid = inflight.pop(local)
@@ -426,7 +447,8 @@ class ServingFleet:
                          kind=("deadline" if freq.status == "deadline_exceeded"
                                else "cancelled"),
                          component="fleet", id=fid,
-                         replica=rep.rid, status=freq.status)
+                         replica=rep.rid, status=freq.status,
+                         trace=freq.trace_id)
 
     def _on_replica_death(self, rep: EngineReplica, exc: BaseException) -> None:
         rep.alive = False
@@ -435,9 +457,15 @@ class ServingFleet:
         self.router.forget_replica(rep.rid)
         pending = self._inflight.pop(rep.rid, {})
         self._inflight[rep.rid] = {}
+        lost_traces = sorted({t for t in (
+            self.requests[fid].trace_id for fid in pending.values())
+            if t is not None})
         _runlog.emit("fleet", kind="replica_dead", component="fleet",
                      replica=rep.rid, reason=rep.death_reason,
-                     inflight=len(pending))
+                     inflight=len(pending), traces=lost_traces)
+        _flightrec.dump("replica_death", exc, replica=rep.rid,
+                        inflight=sorted(pending.values()),
+                        traces=lost_traces)
         self._emit_membership()
         survivors = self._alive()
         if not survivors and pending:
@@ -461,7 +489,7 @@ class ServingFleet:
                 counter_inc("fleet.deadline_hits")
                 _runlog.emit("fleet", kind="deadline", component="fleet",
                              id=freq.fid, replica=freq.replica,
-                             status="deadline_exceeded")
+                             status="deadline_exceeded", trace=freq.trace_id)
                 return
         freq.attempts += 1
         self.requeues += 1
@@ -469,7 +497,8 @@ class ServingFleet:
         rid, reason = self.router.place(
             freq.prompt, {r: rep.load() for r, rep in survivors.items()})
         _runlog.emit("fleet", kind="requeue", component="fleet", id=freq.fid,
-                     replica=rid, from_replica=freq.replica, reason=reason)
+                     replica=rid, from_replica=freq.replica, reason=reason,
+                     trace=freq.trace_id)
         self._place(freq, rid, f"requeue/{reason}", deadline_s=remaining)
 
     def run(self, max_ticks: Optional[int] = None) -> Dict[int, FleetRequest]:

@@ -59,13 +59,15 @@ Scheduler.cancel` (or the per-tick deadline sweep) reclaims it mid-flight.
 
     def __init__(self, rid: int, prompt: np.ndarray, max_new_tokens: int,
                  eos_token_id: Optional[int], seed: int,
-                 deadline_s: Optional[float] = None):
+                 deadline_s: Optional[float] = None,
+                 trace_id: Optional[str] = None):
         self.rid = rid
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.max_new_tokens = int(max_new_tokens)
         self.eos_token_id = eos_token_id
         self.seed = int(seed)
         self.deadline_s = float(deadline_s) if deadline_s is not None else None
+        self.trace_id = trace_id      # one id across every process/replica
         self.status = "queued"
         self.tokens: List[int] = []
         self.slot: Optional[int] = None
@@ -133,14 +135,20 @@ class ContinuousBatchingScheduler:
 
     # ----------------------------------------------------------- lifecycle
     def submit(self, prompt, max_new_tokens: int = 16, eos_token_id: Optional[int] = None,
-               seed: int = 0, deadline_s: Optional[float] = None) -> int:
+               seed: int = 0, deadline_s: Optional[float] = None,
+               trace_id: Optional[str] = None) -> int:
         """Enqueue one prompt; returns the request id. Validation happens
         here (not at admission) so a bad request fails its caller, not the
         serving loop. ``deadline_s`` bounds the request's TOTAL time from
         submission: a request still queued, prefilling, or decoding when it
         expires is reclaimed on the next tick with status
-        ``deadline_exceeded`` (its slot frees mid-decode — no drain wait)."""
+        ``deadline_exceeded`` (its slot frees mid-decode — no drain wait).
+        ``trace_id`` links this request to an existing distributed trace
+        (the fleet passes its id down so submit→admit→prefill→decode→finish
+        all correlate); without one a fresh id is allocated when tracing is
+        enabled."""
         from ..observability import runlog as _runlog
+        from ..observability import trace as _trace
         from ..observability.metrics import counter_inc, gauge_set
 
         prompt = np.asarray(prompt, np.int32).reshape(-1)
@@ -151,15 +159,17 @@ class ContinuousBatchingScheduler:
         if deadline_s is not None and deadline_s <= 0:
             raise ValueError(f"deadline_s must be > 0, got {deadline_s}")
         self.engine.bucket_for(n)  # raises if no bucket/chunk tiling fits
+        if trace_id is None:
+            trace_id = _trace.new_trace_id("serving")
         r = Request(self._next_rid, prompt, max_new_tokens, eos_token_id, seed,
-                    deadline_s=deadline_s)
+                    deadline_s=deadline_s, trace_id=trace_id)
         self._next_rid += 1
         self.queue.append(r)
         counter_inc("serving.requests_submitted")
         gauge_set("serving.queue_depth", len(self.queue))
         _runlog.emit("request", id=r.rid, status="submitted", component="serving",
                      prompt_tokens=n, max_new_tokens=int(max_new_tokens),
-                     queue_depth=len(self.queue))
+                     queue_depth=len(self.queue), trace=r.trace_id)
         return r.rid
 
     def cancel(self, rid: int, status: str = "cancelled") -> bool:
@@ -205,7 +215,7 @@ class ContinuousBatchingScheduler:
         _runlog.emit("request", id=rid, status=status, component="serving",
                      prompt_tokens=len(r.prompt), new_tokens=len(r.tokens),
                      seconds=r.finished_ts - r.submitted_ts,
-                     deadline_s=r.deadline_s)
+                     deadline_s=r.deadline_s, trace=r.trace_id)
         return True
 
     def _expire_deadlines(self) -> None:
@@ -249,6 +259,7 @@ class ContinuousBatchingScheduler:
         the stream; prefill time spent while decodes were waiting counts as
         stall."""
         from ..observability import runlog as _runlog
+        from ..observability import trace as _trace
         from ..observability.metrics import counter_inc, gauge_set, observe
 
         for slot in list(self.prefilling):
@@ -259,6 +270,10 @@ class ContinuousBatchingScheduler:
             done = self.engine.prefill_step(job)
             dt = time.perf_counter() - t0
             r.prefill_chunks += 1
+            if r.trace_id is not None:
+                _trace.span_event("serving.prefill_chunk", trace_id=r.trace_id,
+                                  seconds=dt, id=r.rid, slot=slot,
+                                  chunk=r.prefill_chunks, done=bool(done))
             if decode_waiting:
                 r.stall_seconds += dt
                 observe("serving.prefill_stall_seconds", dt)
@@ -275,7 +290,7 @@ class ContinuousBatchingScheduler:
                          slot=slot, bucket=r.bucket, queue_depth=len(self.queue),
                          queue_seconds=r.queue_seconds, seconds=r.prefill_seconds,
                          prefix_tokens=r.prefix_tokens, chunks=r.prefill_chunks,
-                         stall_seconds=r.stall_seconds)
+                         stall_seconds=r.stall_seconds, trace=r.trace_id)
             if job.more:
                 r.status = "running"  # noqa: PTA104 (host-side serving loop, never traced)
                 self.running[slot] = r
@@ -300,7 +315,8 @@ class ContinuousBatchingScheduler:
                      queue_seconds=r.queue_seconds, prefill_seconds=r.prefill_seconds,
                      decode_seconds=r.decode_seconds, total_seconds=r.total_seconds,
                      ttft_seconds=r.ttft_seconds, fuse=self.engine.fuse,
-                     prefix_tokens=r.prefix_tokens, stall_seconds=r.stall_seconds)
+                     prefix_tokens=r.prefix_tokens, stall_seconds=r.stall_seconds,
+                     trace=r.trace_id)
 
     def step(self) -> List[Request]:
         """One scheduler tick: admit queued requests into free slots, run
@@ -313,7 +329,18 @@ class ContinuousBatchingScheduler:
         self._admit()
         self._prefill_tick()
         if self.running:
+            traced = sorted({r.trace_id for r in self.running.values()
+                             if r.trace_id is not None})
+            t0 = time.perf_counter()
             toks, emitted, active = self.engine.decode_step()
+            if traced:
+                from ..observability import trace as _trace
+
+                # one fused dispatch advances EVERY running slot: a single
+                # span event fanned across the traces it served
+                _trace.span_event("serving.decode", trace_id=None,
+                                  seconds=time.perf_counter() - t0,
+                                  traces=traced, slots=len(self.running))
             toks = np.atleast_2d(toks)
             emitted = np.atleast_2d(emitted)
             for d in range(toks.shape[0]):

@@ -398,19 +398,31 @@ class TrainStep:
                          bytes_accessed=info.get("bytes_accessed"),
                          peak_bytes=info.get("peak_bytes"))
         try:
-            return entry(self.state, batch)
-        except (TypeError, ValueError):
-            if entry is jitfn:
-                raise
-            # AOT executables validate avals strictly; on drift fall back to
-            # the jitted path permanently for this signature
-            self._compiled[sig] = jitfn
-            return jitfn(self.state, batch)
+            try:
+                return entry(self.state, batch)
+            except (TypeError, ValueError):
+                if entry is jitfn:
+                    raise
+                # AOT executables validate avals strictly; on drift fall back to
+                # the jitted path permanently for this signature
+                self._compiled[sig] = jitfn
+                return jitfn(self.state, batch)
+        except Exception as exc:
+            # unhandled dispatch fault (aval drift already fell back above):
+            # leave a flight-recorder dump, then let the fault propagate
+            from ..observability import flightrec as _flightrec
+
+            _flightrec.dump("dispatch_exception", exc,
+                            component="train_step", which=which,
+                            step=self._host_step)
+            raise
 
     def __call__(self, inputs, labels):
         from ..observability import runlog as _runlog
         from ..observability import span as _span
         from ..profiler import counter_inc
+
+        from ..observability import trace as _trace
 
         inputs = self._as_arrays(inputs)
         labels = self._as_arrays(labels)
@@ -420,7 +432,7 @@ class TrainStep:
         counter_inc("train_step.steps")
         self._host_step += 1
         _runlog.emit("step", step=self._host_step, component="train_step",
-                     k=1, seconds=sp.seconds)
+                     k=1, seconds=sp.seconds, trace=_trace.current_trace())
         return {k: _wrap_tree(v) for k, v in metrics.items()}
 
     def run_steps(self, batches, k=None):
@@ -459,8 +471,10 @@ class TrainStep:
                         f"pre-stacked batch leaf has leading dim {leaf.shape[:1]}, "
                         f"expected ({k},); pass per-step batches without k= to "
                         "have run_steps stack them")
+        from ..observability import measured as _measured
         from ..observability import runlog as _runlog
         from ..observability import span as _span
+        from ..observability import trace as _trace
         from ..profiler import counter_inc
 
         with _span("train_step.run_steps") as sp:
@@ -469,7 +483,13 @@ class TrainStep:
         counter_inc("train_step.steps", k)
         self._host_step += k
         _runlog.emit("step", step=self._host_step, component="train_step",
-                     k=k, seconds=sp.seconds)
+                     k=k, seconds=sp.seconds, trace=_trace.current_trace())
+        # measured step times, keyed by the auto-parallel plan fingerprint
+        # (planner.build_step attaches .plan) — the evidence base the cost
+        # model can calibrate against (persistence + schema this PR)
+        fp = getattr(getattr(self, "plan", None), "fingerprint", None)
+        if fp and sp.seconds is not None:
+            _measured.record(fp, sp.seconds, k)
         return {name: _wrap_tree(v) for name, v in metrics.items()}
 
     def explain(self, analyze: bool = False) -> list:

@@ -17,22 +17,41 @@ production observability stack for the TPU runtime. Four pieces:
   (``cost_analysis``/``memory_analysis`` at every Executor/TrainStep
   compile) behind ``Executor.explain()`` / ``TrainStep.explain()``.
 
+PR 14 adds the cross-process plane on top:
+
+- :mod:`.trace` — deterministic trace/span ids propagated end-to-end
+  (fleet request submit→route→prefill→decode→requeue→delivery;
+  ``run_resilient`` per-step and per-incident spans), ``span`` run-log
+  events, and TCPStore clock sync for merged timelines.
+- :mod:`.exporter` — stdlib HTTP ``/metrics`` (Prometheus), ``/healthz``,
+  ``/snapshot`` on ``FLAGS_metrics_port``.
+- :mod:`.flightrec` — bounded crash flight recorder dumping the run-log
+  ring + metrics snapshot to ``flightrec-<pid>.json`` on replica death,
+  DivergenceFault, PTA204/205 errors, and dispatch exceptions.
+- :mod:`.measured` — measured step times persisted per plan fingerprint
+  under ``FLAGS_compile_cache_dir/measured/``.
+
 Everything is gated by ``FLAGS_monitor`` (default on; spans and events
-become no-ops when off) and reading the run log back is
-``python -m paddle_tpu.observability report <run.jsonl>``.
+become no-ops when off); reading logs back is
+``python -m paddle_tpu.observability report <run.jsonl>`` — or, fleet
+wide, ``report --merge <dir>`` / ``trace <dir> --out trace.json``.
 """
 from __future__ import annotations
 
-from . import introspect, metrics, runlog, spans  # noqa: F401
+from . import exporter, flightrec, introspect, measured  # noqa: F401
+from . import metrics, runlog, spans, trace  # noqa: F401
 from .introspect import cost_summary, format_cost_table  # noqa: F401
 from .metrics import observe, prometheus_text, snapshot  # noqa: F401
 from .runlog import Monitor, emit, monitor  # noqa: F401
 from .spans import Span, span  # noqa: F401
+from .trace import attach, new_trace_id, span_event, trace_span  # noqa: F401
 
 __all__ = [
-    "metrics", "runlog", "spans", "introspect", "Monitor", "monitor",
-    "emit", "span", "Span", "observe", "snapshot", "prometheus_text",
-    "cost_summary", "format_cost_table",
+    "metrics", "runlog", "spans", "introspect", "trace", "exporter",
+    "flightrec", "measured", "Monitor", "monitor", "emit", "span", "Span",
+    "observe", "snapshot", "prometheus_text", "cost_summary",
+    "format_cost_table", "new_trace_id", "attach", "trace_span",
+    "span_event",
 ]
 
 # Pre-declare the runtime's counter series so a Prometheus scrape (or the
@@ -51,6 +70,6 @@ for _name in (
     "profiler.steps",
 ) + metrics.SERVING_COUNTERS + metrics.FLEET_COUNTERS + metrics.KERNEL_COUNTERS \
         + metrics.ANALYSIS_COUNTERS + metrics.PLANNER_COUNTERS \
-        + metrics.RECSYS_COUNTERS:
+        + metrics.RECSYS_COUNTERS + metrics.OBS_COUNTERS:
     metrics.declare_counter(_name)
 del _name

@@ -27,14 +27,28 @@ Prints, from one structured run log (see :mod:`.runlog`):
   events (distributed/planner.py + converter.py).
 
 ``--json`` emits the same analysis as one JSON object for tooling.
+
+Fleet-wide (PR 14): ``report --merge <dir>`` collects EVERY
+``run-*.jsonl`` under a directory (rotated ``run-<pid>.1.jsonl``
+generations included, replayed first), aligns each process's clock by the
+offset its ``clock_sync`` event recorded against rank 0 (see
+``trace.sync_clocks``), and renders one fleet-wide report on top of the
+single-log analysis: per-process table, per-replica request lanes,
+requeue edges (which request moved from which dead replica to which
+survivor), cross-rank step skew percentiles, and per-trace event paths.
+``trace <dir> --out trace.json`` renders the same merged, clock-aligned
+timeline as a chrome trace (``chrome://tracing`` / Perfetto) with one
+track per process.
 """
 from __future__ import annotations
 
 import argparse
 import json
+import os
+import re
 import sys
 from collections import defaultdict
-from typing import List
+from typing import Dict, List
 
 
 def load_events(path: str) -> List[dict]:
@@ -336,6 +350,214 @@ def _analyze_fleet(flt: List[dict]) -> dict:
     return out
 
 
+_RUN_LOG_NAME = re.compile(r"^run-(\d+)(\.1)?\.jsonl$")
+
+
+def collect_run_logs(root: str) -> Dict[int, List[str]]:
+    """Every ``run-<pid>.jsonl`` (+ rotated ``.1`` generation) under
+    ``root``, recursively, grouped by pid — rotated generation first so a
+    process's events replay in emission order."""
+    by_pid: Dict[int, List[str]] = {}
+    for dirpath, _dirs, names in os.walk(root):  # noqa: PTA102 (host-side, never traced)
+        for name in names:
+            if _RUN_LOG_NAME.match(name):
+                pid = int(_RUN_LOG_NAME.match(name).group(1))
+                by_pid.setdefault(pid, []).append(os.path.join(dirpath, name))  # noqa: PTA104 (host-side, never traced)
+    for paths in by_pid.values():
+        paths.sort(key=lambda p: (not p.endswith(".1.jsonl"), p))  # noqa: PTA104 (host-side, never traced)
+    return dict(sorted(by_pid.items()))
+
+
+def load_processes(root: str) -> Dict[int, dict]:
+    """Per-process event streams + the clock offset each process published
+    (its ``clock_sync`` event; 0 when the process never synced)."""
+    procs: Dict[int, dict] = {}
+    for pid, paths in collect_run_logs(root).items():  # noqa: PTA102 (host-side, never traced)
+        events: List[dict] = []
+        for p in paths:
+            events.extend(load_events(p))  # noqa: PTA104 (host-side, never traced)
+        offset, rank = 0.0, None
+        for ev in events:
+            if ev.get("event") == "clock_sync":
+                offset = float(ev.get("offset") or 0.0)
+                rank = ev.get("rank")
+        procs[pid] = {"events": events, "offset": offset, "rank": rank,  # noqa: PTA104 (host-side, never traced)
+                      "files": [os.path.basename(p) for p in paths]}
+    return procs
+
+
+def merge_processes(procs: Dict[int, dict]) -> List[dict]:
+    """One clock-aligned stream: every event stamped with its ``_pid`` and
+    its ``ts`` shifted onto rank 0's clock, sorted by aligned time."""
+    merged: List[dict] = []
+    for pid, info in procs.items():  # noqa: PTA102 (host-side, never traced)
+        for ev in info["events"]:
+            aev = dict(ev)
+            if isinstance(ev.get("ts"), (int, float)):
+                aev["ts"] = ev["ts"] - info["offset"]  # noqa: PTA104 (host-side, never traced)
+            aev["_pid"] = pid  # noqa: PTA104 (host-side, never traced)
+            merged.append(aev)  # noqa: PTA104 (host-side, never traced)
+    merged.sort(key=lambda e: e.get("ts") if isinstance(e.get("ts"), (int, float)) else 0.0)
+    return merged
+
+
+def _event_trace_ids(ev: dict) -> List[str]:
+    tids = [ev["trace"]] if ev.get("trace") else []
+    tids.extend(t for t in (ev.get("traces") or []) if t)
+    return tids
+
+
+def _path_label(ev: dict) -> str:
+    kind = ev.get("event")
+    if kind == "span":
+        return str(ev.get("name"))
+    if kind == "fleet":
+        return f"fleet.{ev.get('kind')}"
+    if kind == "request":
+        return f"request.{ev.get('status')}"
+    return str(kind)
+
+
+_MAX_TRACE_PATHS = 100
+
+
+def analyze_merged(root: str) -> dict:
+    """The fleet-wide analysis over every run log under ``root``: the
+    single-log :func:`analyze` on the merged clock-aligned stream, plus the
+    cross-process sections (per-replica lanes, requeue edges, step skew,
+    per-trace paths) only a merged view can produce."""
+    procs = load_processes(root)
+    merged = merge_processes(procs)
+    out = {
+        "processes": {pid: {
+            "rank": info["rank"], "offset_seconds": info["offset"],
+            "events": len(info["events"]), "files": info["files"],
+        } for pid, info in procs.items()},
+        "merged": analyze(merged) if merged else {},
+    }
+    # per-replica lanes: placed -> finished/deadline/cancelled intervals on
+    # the aligned clock, the per-replica occupancy picture
+    lanes: Dict[int, List[dict]] = defaultdict(list)
+    open_by_id: Dict[int, tuple] = {}
+    edges: List[dict] = []
+    for ev in merged:
+        if ev.get("event") != "fleet":
+            continue
+        kind = ev.get("kind")
+        if kind == "placed":
+            open_by_id[ev.get("id")] = (ev.get("replica"), ev.get("ts"))  # noqa: PTA104 (host-side, never traced)
+        elif kind == "requeue":
+            edges.append({"id": ev.get("id"), "from": ev.get("from_replica"),  # noqa: PTA104 (host-side, never traced)
+                          "to": ev.get("replica"), "trace": ev.get("trace")})
+        elif kind in ("finished", "deadline", "cancelled"):
+            start = open_by_id.pop(ev.get("id"), (ev.get("replica"), None))
+            lanes[ev.get("replica")].append({  # noqa: PTA104 (host-side, never traced)
+                "id": ev.get("id"), "start_ts": start[1],
+                "end_ts": ev.get("ts"), "status": kind,
+                "attempts": ev.get("attempts"), "trace": ev.get("trace")})
+    if lanes:
+        out["lanes"] = {r: lanes[r] for r in sorted(lanes)}  # noqa: PTA104 (host-side report printer)
+    if edges:
+        out["requeue_edges"] = edges  # noqa: PTA104 (host-side report printer)
+    # cross-rank step skew: for each step index reported by >= 2 processes,
+    # the spread of aligned completion times — the straggler metric
+    by_step: Dict[int, Dict[int, float]] = defaultdict(dict)
+    for ev in merged:
+        if (ev.get("event") == "step" and ev.get("step") is not None
+                and isinstance(ev.get("ts"), (int, float))):
+            by_step[ev["step"]][ev["_pid"]] = ev["ts"]  # noqa: PTA104 (host-side, never traced)
+    spreads = sorted(max(d.values()) - min(d.values())
+                     for d in by_step.values() if len(d) >= 2)
+    if spreads:
+        out["step_skew"] = {  # noqa: PTA104 (host-side report printer)
+            "steps_compared": len(spreads),
+            "p50_seconds": _percentile(spreads, 50),
+            "p99_seconds": _percentile(spreads, 99),
+            "max_seconds": spreads[-1],
+        }
+    # per-trace event paths: every event carrying a trace id, in aligned
+    # order — the submit->route->prefill->decode->requeue->delivery story
+    paths: Dict[str, dict] = {}
+    for ev in merged:
+        for tid in _event_trace_ids(ev):
+            row = paths.setdefault(tid, {"events": 0, "processes": [], "path": []})
+            row["events"] += 1  # noqa: PTA104 (host-side, never traced)
+            if ev["_pid"] not in row["processes"]:
+                row["processes"].append(ev["_pid"])  # noqa: PTA104 (host-side, never traced)
+            if len(paths) <= _MAX_TRACE_PATHS:
+                row["path"].append(_path_label(ev))  # noqa: PTA104 (host-side, never traced)
+    if paths:
+        out["traces"] = {"count": len(paths), "paths": paths}  # noqa: PTA104 (host-side report printer)
+    return out
+
+
+def chrome_trace_doc(root: str) -> dict:
+    """The merged, clock-aligned timeline as a chrome-trace document: one
+    track (pid) per process, complete events for everything that measured a
+    duration (``seconds``; the event's ts is its END), instants otherwise."""
+    procs = load_processes(root)
+    merged = merge_processes(procs)
+    stamps = [ev["ts"] for ev in merged if isinstance(ev.get("ts"), (int, float))]
+    t0 = min(stamps) if stamps else 0.0
+    events: List[dict] = []
+    for pid, info in procs.items():  # noqa: PTA102 (host-side, never traced)
+        label = (f"rank {info['rank']}" if info["rank"] is not None else "process")
+        events.append({"name": "process_name", "ph": "M", "pid": pid, "tid": 0,  # noqa: PTA104 (host-side, never traced)
+                       "args": {"name": f"{label} (pid {pid})"}})
+    arg_keys = ("trace", "span", "parent", "id", "step", "replica", "k",
+                "kind", "status", "error", "chunk", "slot")
+    for ev in merged:
+        ts = ev.get("ts")
+        if not isinstance(ts, (int, float)):
+            continue
+        kind = ev.get("event")
+        base = {
+            "name": _path_label(ev), "cat": kind, "pid": ev["_pid"],
+            "tid": str(ev.get("component") or kind),
+            "args": {k: ev[k] for k in arg_keys if ev.get(k) is not None},
+        }
+        secs = ev.get("seconds")
+        if isinstance(secs, (int, float)) and secs > 0:
+            base.update(ph="X", ts=(ts - t0 - secs) * 1e6, dur=secs * 1e6)  # noqa: PTA104 (host-side, never traced)
+        else:
+            base.update(ph="i", s="t", ts=(ts - t0) * 1e6)  # noqa: PTA104 (host-side, never traced)
+        events.append(base)  # noqa: PTA104 (host-side, never traced)
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def print_merged(root: str, m: dict) -> None:
+    print(f"merged run logs: {root}")  # noqa: PTA105 (host-side, never traced)
+    print("  processes:")  # noqa: PTA105 (host-side, never traced)
+    for pid, p in m["processes"].items():  # noqa: PTA102 (host-side, never traced)
+        rank = p["rank"] if p["rank"] is not None else "-"
+        print(f"    pid {pid:<8} rank {rank!s:<3} offset "  # noqa: PTA105 (host-side, never traced)
+              f"{p['offset_seconds'] * 1e3:+9.2f} ms   events {p['events']:<6} "
+              f"files {', '.join(p['files'])}")
+    sk = m.get("step_skew")
+    if sk:
+        print(f"  cross-rank step skew ({sk['steps_compared']} steps): "  # noqa: PTA105 (host-side, never traced)
+              f"p50 {sk['p50_seconds'] * 1e3:.2f} ms   "
+              f"p99 {sk['p99_seconds'] * 1e3:.2f} ms   "
+              f"max {sk['max_seconds'] * 1e3:.2f} ms")
+    lanes = m.get("lanes")
+    if lanes:
+        print("  per-replica lanes (aligned clock):")  # noqa: PTA105 (host-side, never traced)
+        for rid, rows in lanes.items():  # noqa: PTA102 (host-side, never traced)
+            spans = "  ".join(
+                f"#{r['id']}[{r['status']}"
+                + (f",x{r['attempts']}" if (r.get('attempts') or 1) > 1 else "")
+                + "]" for r in rows)
+            print(f"    replica {rid}: {spans}")  # noqa: PTA105 (host-side, never traced)
+    for e in m.get("requeue_edges") or []:
+        print(f"  requeue: request {e['id']} replica {e['from']} -> "  # noqa: PTA105 (host-side, never traced)
+              f"{e['to']}" + (f"   trace {e['trace']}" if e.get("trace") else ""))
+    tr = m.get("traces")
+    if tr:
+        print(f"  traces: {tr['count']}")  # noqa: PTA105 (host-side, never traced)
+    if m.get("merged"):
+        print_report("<merged>", m["merged"])
+
+
 def print_report(path: str, a: dict) -> None:
     print(f"run log: {path}")
     print(f"  events: {a['events']}  wall: {a['wall_seconds']:.3f}s  "
@@ -500,10 +722,42 @@ def print_report(path: str, a: dict) -> None:
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="python -m paddle_tpu.observability")
     sub = p.add_subparsers(dest="cmd", required=True)
-    rep = sub.add_parser("report", help="summarize a run-log JSONL file")
-    rep.add_argument("path", help="run-log .jsonl written under FLAGS_run_log_dir")
+    rep = sub.add_parser("report", help="summarize a run-log JSONL file "
+                                        "(or, with --merge, a directory)")
+    rep.add_argument("path", help="run-log .jsonl written under "
+                                  "FLAGS_run_log_dir (a directory with --merge)")
+    rep.add_argument("--merge", action="store_true",
+                     help="PATH is a run-log directory: merge every "
+                          "run-*.jsonl under it, clock-aligned via each "
+                          "process's clock_sync offset")
     rep.add_argument("--json", action="store_true", help="emit the analysis as JSON")
+    tr = sub.add_parser("trace", help="render a merged chrome trace from a "
+                                      "run-log directory")
+    tr.add_argument("path", help="run-log directory (FLAGS_run_log_dir)")
+    tr.add_argument("--out", default="trace.json",
+                    help="output chrome-trace path (default: trace.json)")
     args = p.parse_args(argv)
+    if args.cmd == "trace":
+        doc = chrome_trace_doc(args.path)
+        n = sum(1 for ev in doc["traceEvents"] if ev.get("ph") != "M")
+        if not n:
+            print(f"[trace] no events under {args.path}", file=sys.stderr)  # noqa: PTA105 (host-side, never traced)
+            return 1
+        with open(args.out, "w") as f:
+            json.dump(doc, f)
+        print(f"[trace] wrote {n} events from "  # noqa: PTA105 (host-side, never traced)
+              f"{len(collect_run_logs(args.path))} process(es) to {args.out}")
+        return 0
+    if args.merge:
+        m = analyze_merged(args.path)
+        if not m["processes"]:
+            print(f"[report] no run-*.jsonl under {args.path}", file=sys.stderr)  # noqa: PTA105 (host-side, never traced)
+            return 1
+        if args.json:
+            print(json.dumps(m, indent=2))  # noqa: PTA105 (host-side, never traced)
+        else:
+            print_merged(args.path, m)
+        return 0
     events = load_events(args.path)
     if not events:
         print(f"[report] no events in {args.path}", file=sys.stderr)

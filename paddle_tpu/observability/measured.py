@@ -1,0 +1,95 @@
+"""Measured step-time persistence, keyed by plan fingerprint.
+
+The auto-parallel planner ranks candidate plans with an analytic cost
+model; the *measured* wall time of the plan that actually ran is strictly
+better evidence. ``TrainStep.run_steps`` reports every dispatch here and
+the samples accumulate under::
+
+    FLAGS_compile_cache_dir/measured/<fingerprint>.json
+
+one JSON document per plan fingerprint (the schedule digest from
+``distributed.planner``; steps built without a plan key on a signature
+hash instead). This PR persists and schema-stabilizes the data; feeding
+it back into plan search is future work — the document format is the
+contract::
+
+    {"format": 1, "fingerprint": ..., "samples": <dispatch count>,
+     "steps": <fused steps total>, "total_seconds": ...,
+     "mean_step_seconds": ..., "recent_step_seconds": [... last 64 ...],
+     "updated_unix": ...}
+
+Writes are atomic (temp + rename, the compile-cache idiom) and best
+effort: a read-only cache dir must never fail a training step. No-op when
+``FLAGS_compile_cache_dir`` is unset.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+from ..framework.flags import flag
+from . import metrics
+
+__all__ = ["record", "load", "path_for"]
+
+_RECENT_KEEP = 64
+
+
+def path_for(fingerprint: str) -> Optional[str]:
+    """Where ``fingerprint``'s measurement doc lives, or None when
+    persistence is off (no compile cache dir)."""
+    d = flag("FLAGS_compile_cache_dir")
+    if not d:
+        return None
+    return os.path.join(str(d), "measured", f"{fingerprint}.json")
+
+
+def load(fingerprint: str) -> Optional[dict]:
+    """The persisted measurement doc for ``fingerprint``, or None."""
+    path = path_for(fingerprint)
+    if path is None:
+        return None
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return doc if doc.get("format") == 1 else None
+
+
+def record(fingerprint: Optional[str], seconds: float,
+           k: int = 1) -> Optional[str]:
+    """Fold one measured dispatch (``k`` fused steps over ``seconds``
+    wall) into ``fingerprint``'s doc; returns the path written, or None
+    when persistence is off. Never raises."""
+    if not fingerprint:
+        return None
+    path = path_for(fingerprint)
+    if path is None:
+        return None
+    doc = load(fingerprint) or {
+        "format": 1, "fingerprint": fingerprint, "samples": 0, "steps": 0,
+        "total_seconds": 0.0, "recent_step_seconds": [],
+    }
+    k = max(1, int(k))
+    doc["samples"] += 1
+    doc["steps"] += k
+    doc["total_seconds"] += float(seconds)
+    doc["mean_step_seconds"] = doc["total_seconds"] / doc["steps"]
+    recent = doc.get("recent_step_seconds", [])
+    recent.append(float(seconds) / k)
+    doc["recent_step_seconds"] = recent[-_RECENT_KEEP:]
+    import time
+
+    doc["updated_unix"] = time.time()
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = f"{path}.tmp-{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, path)
+    except OSError:
+        return None
+    metrics.counter_inc("measured.persists")
+    return path

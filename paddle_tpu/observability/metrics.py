@@ -28,7 +28,8 @@ from typing import Dict, Iterable, List, Optional, Tuple
 __all__ = [
     "Histogram", "counter_inc", "counters", "reset_counters", "gauge_set",
     "gauges", "observe", "histogram", "histograms", "declare_counter",
-    "declare_histogram", "snapshot", "prometheus_text", "reset_all",
+    "declare_histogram", "declare_help", "snapshot", "prometheus_text",
+    "escape_help", "escape_label_value", "reset_all",
 ]
 
 # Default span-duration buckets (seconds): half-decade geometric ladder from
@@ -135,14 +136,23 @@ def reset_counters(prefix: str = "") -> None:
             del _COUNTERS[k]
 
 
-def declare_counter(name: str) -> None:
+def declare_counter(name: str, help_str: str = "") -> None:
     """Pre-register ``name`` so it exports as 0 before the first increment
-    (scrapes see the full series set from process start)."""
+    (scrapes see the full series set from process start). ``help_str``
+    becomes the series' ``# HELP`` line in the Prometheus exposition."""
     _DECLARED_COUNTERS.add(name)
     _COUNTERS.setdefault(name, 0)
+    if help_str:
+        _HELP[name] = help_str  # noqa: PTA104 (host-side, never traced)
+
+
+def declare_help(name: str, help_str: str) -> None:
+    """Attach ``# HELP`` text to any series (counter, gauge, histogram)."""
+    _HELP[name] = help_str
 
 
 _DECLARED_COUNTERS: set = set()
+_HELP: Dict[str, str] = {}
 
 # Serving-tier series (inference engine + continuous-batching scheduler):
 # pre-declared here so a scrape of an idle predictor process already shows
@@ -225,6 +235,39 @@ RECSYS_COUNTERS: Tuple[str, ...] = (
 )
 
 
+# Observability plane itself (PR 14: trace.py / flightrec.py / runlog
+# rotation / measured.py / exporter.py) — the plane meters its own cost so
+# "is tracing expensive" is answerable from the same scrape.
+OBS_COUNTERS: Tuple[str, ...] = (
+    "trace.traces", "trace.spans",
+    "flightrec.dumps",
+    "runlog.rotations", "runlog.gc_removed",
+    "measured.persists",
+    "exporter.requests", "exporter.bind_failures",
+)
+
+
+# Every gauge_set / observe call in paddle_tpu/ with a literal series name
+# must appear in the matching tuple below — tests/test_observability.py's
+# declaration drift guard greps the package and fails on a name set here
+# drifting from the names used at call sites. (Dynamically-named series —
+# f-strings, span names — are exempt: the guard only parses literals.)
+KNOWN_GAUGES: Tuple[str, ...] = (
+    "serving.prefix_cache_bytes", "serving.queue_depth",
+    "serving.active_slots",
+    "fleet.replicas_alive", "fleet.replicas_dead", "fleet.queue_depth",
+    "stability.lr", "amp.loss_scale",
+)
+
+KNOWN_HISTOGRAMS: Tuple[str, ...] = (
+    "infer.tokens_per_decode_dispatch",
+    "serving.prefill_stall_seconds", "serving.ttft_seconds",
+    "serving.queue_seconds", "serving.latency_seconds",
+    "fleet.latency_seconds",
+    "hapi.step",
+)
+
+
 # -------------------------------------------------------------------- gauges
 def gauge_set(name: str, value: float) -> None:
     _GAUGES[name] = value
@@ -283,27 +326,58 @@ def _prom_name(name: str, suffix: str = "") -> str:
     return f"paddle_tpu_{base}{suffix}"
 
 
-def prometheus_text() -> str:
-    """Render every series in the Prometheus text exposition format.
-    Histogram series follow the convention: ``<name>_bucket{le=...}``
-    (cumulative), ``<name>_sum``, ``<name>_count``; durations are seconds."""
+def escape_help(text: str) -> str:
+    """Escape ``# HELP`` text per the exposition format: backslash and
+    newline (double quotes are legal raw in help text)."""
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def escape_label_value(value: str) -> str:
+    """Escape a label value per the exposition format: backslash, newline,
+    and double quote."""
+    return (str(value).replace("\\", "\\\\").replace("\n", "\\n")
+            .replace('"', '\\"'))
+
+
+def _help_lines(name: str, pn: str) -> List[str]:
+    help_str = _HELP.get(name)
+    return [f"# HELP {pn} {escape_help(help_str)}"] if help_str else []
+
+
+def prometheus_text(prefix: str = "") -> str:
+    """Render every series (name-prefix-filtered when ``prefix`` is given)
+    in the Prometheus text exposition format. Counters get the ``_total``
+    suffix, histograms the ``<name>_seconds_bucket{le=...}`` (cumulative) /
+    ``_sum`` / ``_count`` triple — durations are seconds. Declared help
+    text renders as ``# HELP`` with backslash/newline escaping; the ``le``
+    label values go through :func:`escape_label_value` like any other."""
     lines: List[str] = []
     for name in sorted(_COUNTERS):
+        if not name.startswith(prefix):
+            continue
         pn = _prom_name(name, "_total")
+        lines.extend(_help_lines(name, pn))  # noqa: PTA104 (host-side, never traced)
         lines.append(f"# TYPE {pn} counter")
         lines.append(f"{pn} {_COUNTERS[name]:g}")
     for name in sorted(_GAUGES):
+        if not name.startswith(prefix):
+            continue
         pn = _prom_name(name)
+        lines.extend(_help_lines(name, pn))  # noqa: PTA104 (host-side, never traced)
         lines.append(f"# TYPE {pn} gauge")
         lines.append(f"{pn} {_GAUGES[name]:g}")
     for name in sorted(_HISTOGRAMS):
+        if not name.startswith(prefix):
+            continue
         h = _HISTOGRAMS[name]
         pn = _prom_name(name, "_seconds")
+        lines.extend(_help_lines(name, pn))  # noqa: PTA104 (host-side, never traced)
         lines.append(f"# TYPE {pn} histogram")
         cum = 0
         for bound, n in zip(h.bounds, h.bucket_counts):
             cum += n
-            lines.append(f'{pn}_bucket{{le="{bound:g}"}} {cum}')
+            le = escape_label_value(f"{bound:g}")
+            lines.append(f'{pn}_bucket{{le="{le}"}} {cum}')  # noqa: PTA104 (host-side, never traced)
         lines.append(f'{pn}_bucket{{le="+Inf"}} {h.count}')
         lines.append(f"{pn}_sum {h.sum:g}")
         lines.append(f"{pn}_count {h.count}")

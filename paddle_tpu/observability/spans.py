@@ -30,13 +30,20 @@ __all__ = ["span", "Span"]
 class Span:
     """One timed section. Use via ``with span(name): ...``; after exit,
     ``seconds`` holds the wall duration (also recorded into the histogram
-    metric ``name``)."""
+    metric ``name``) and ``error`` is True when the body raised.
 
-    __slots__ = ("name", "seconds", "_t0", "_re")
+    Exit is **exception-safe**: a raising body still closes the
+    RecordEvent (so the chrome-trace nesting stays balanced for the next
+    span), still records the histogram observation, and — when a trace
+    context is attached (:mod:`.trace`) — emits the span's run-log event
+    with ``error=true``. The original exception always propagates."""
+
+    __slots__ = ("name", "seconds", "error", "_t0", "_re")
 
     def __init__(self, name: str):
         self.name = name
         self.seconds: Optional[float] = None
+        self.error = False
         self._t0 = 0
         self._re = None
 
@@ -48,13 +55,21 @@ class Span:
         self._t0 = time.perf_counter_ns()
         return self
 
-    def __exit__(self, *exc):
+    def __exit__(self, exc_type, exc, tb):
         dt = (time.perf_counter_ns() - self._t0) / 1e9
-        if self._re is not None:
-            self._re.end()
+        self.error = exc_type is not None
+        try:
+            if self._re is not None:
+                self._re.end()
+        finally:
             self._re = None
-        self.seconds = dt
-        metrics.observe(self.name, dt)
+            self.seconds = dt
+            metrics.observe(self.name, dt)
+            from . import trace as _trace
+
+            if _trace.current_trace() is not None:
+                _trace.span_event(self.name, trace_id=_trace.current_trace(),
+                                  seconds=dt, error=self.error)
         return False
 
 
@@ -64,6 +79,7 @@ class _NullSpan:
     __slots__ = ()
     name = ""
     seconds = None
+    error = False
 
     def __enter__(self):
         return self

@@ -4,6 +4,7 @@ compiled-program introspection, report CLI, and the profiler satellites
 """
 import json
 import os
+import re
 import time
 
 import numpy as np
@@ -418,3 +419,257 @@ class TestMonitorOverheadPath:
         doc = json.loads(open(out).read())
         names = {e.get("name") for e in doc["traceEvents"]}
         assert "train_step.step" in names
+
+
+# --------------------------------------------- run-log rotation + GC (PR 14)
+class TestRunLogRotation:
+    def test_oversized_log_rotates_once_and_continues(self, run_log_dir):
+        prev = paddle.get_flags("FLAGS_run_log_max_mb")["FLAGS_run_log_max_mb"]
+        paddle.set_flags({"FLAGS_run_log_max_mb": 0.002})  # ~2 KB
+        before = metrics.counters("runlog.").get("runlog.rotations", 0)
+        try:
+            for i in range(60):
+                obs.emit("rot_ev", i=i, pad="x" * 60)
+        finally:
+            paddle.set_flags({"FLAGS_run_log_max_mb": prev})
+        obs.monitor().flush()
+        pid = os.getpid()
+        rotated = run_log_dir / f"run-{pid}.1.jsonl"
+        current = run_log_dir / f"run-{pid}.jsonl"
+        assert rotated.exists() and current.exists()
+        assert metrics.counters("runlog.")["runlog.rotations"] > before
+        # the fresh generation announces its lineage
+        head = json.loads(current.read_text().splitlines()[0])
+        assert head["event"] == "run_start"
+        assert head["rotated_from"].endswith(".1.jsonl")
+        assert head["rotation"] >= 1
+        # merge CLI replays both generations in emission order
+        from paddle_tpu.observability.__main__ import collect_run_logs, load_processes
+
+        paths = collect_run_logs(str(run_log_dir))[pid]
+        assert [os.path.basename(p) for p in paths] == \
+            [f"run-{pid}.1.jsonl", f"run-{pid}.jsonl"]
+        events = load_processes(str(run_log_dir))[pid]["events"]
+        idx = [e["i"] for e in events if e.get("event") == "rot_ev"]
+        # only one rotated generation is kept, so the oldest events may be
+        # gone — but what survives must be a contiguous, ordered suffix
+        assert idx and idx[-1] == 59
+        assert idx == list(range(idx[0], 60))
+
+    def test_gc_removes_stale_dead_pid_logs(self, run_log_dir):
+        # fabricate dead processes' logs (pids above Linux pid_max can't
+        # be alive); own-pid log and the newest k dead survive
+        dead = [5000000 + i for i in range(5)]
+        for i, pid in enumerate(dead):
+            p = run_log_dir / f"run-{pid}.jsonl"
+            p.write_text('{"ts": 1.0, "event": "run_start"}\n')
+            os.utime(p, (1000.0 + i, 1000.0 + i))
+        (run_log_dir / f"run-{dead[-1]}.1.jsonl").write_text("{}\n")
+        prev = paddle.get_flags("FLAGS_run_log_keep")["FLAGS_run_log_keep"]
+        before = metrics.counters("runlog.").get("runlog.gc_removed", 0)
+        paddle.set_flags({"FLAGS_run_log_keep": 2})
+        try:
+            obs.monitor().close()  # force a fresh sink open -> GC pass
+            obs.emit("gc_trigger")
+        finally:
+            paddle.set_flags({"FLAGS_run_log_keep": prev})
+        obs.monitor().flush()
+        names = {p.name for p in run_log_dir.glob("run-*.jsonl")}
+        assert f"run-{os.getpid()}.jsonl" in names
+        # newest two dead pids (by mtime) kept, incl. the rotated sibling
+        assert f"run-{dead[-1]}.jsonl" in names
+        assert f"run-{dead[-1]}.1.jsonl" in names
+        assert f"run-{dead[-2]}.jsonl" in names
+        for pid in dead[:-2]:
+            assert f"run-{pid}.jsonl" not in names
+        assert metrics.counters("runlog.")["runlog.gc_removed"] == before + 3
+
+    def test_gc_disabled_at_zero_keep(self, run_log_dir):
+        p = run_log_dir / "run-5000099.jsonl"
+        p.write_text("{}\n")
+        prev = paddle.get_flags("FLAGS_run_log_keep")["FLAGS_run_log_keep"]
+        paddle.set_flags({"FLAGS_run_log_keep": 0})
+        try:
+            obs.monitor().close()
+            obs.emit("gc_off_trigger")
+        finally:
+            paddle.set_flags({"FLAGS_run_log_keep": prev})
+        assert p.exists()
+
+
+# ------------------------------------------ declaration drift guard (PR 14)
+class TestDeclarationDriftGuard:
+    """Every counter/gauge/histogram used with a LITERAL name anywhere in
+    paddle_tpu/ must be pre-declared in metrics.py, so scrapes of an idle
+    process already export the full series set and a typo'd series name
+    fails here instead of silently forking a new series. Dynamic names
+    (f-strings, variables) are exempt — only quoted literals are parsed.
+    Note: no word-boundary anchor before the call names — aliases like
+    ``_gauge_set(`` must match too."""
+
+    CALL = re.compile(r'(counter_inc|gauge_set|observe)\(\s*[\'"]([^\'"]+)[\'"]')
+
+    def _scan(self):
+        import paddle_tpu
+
+        root = os.path.dirname(os.path.abspath(paddle_tpu.__file__))
+        found = {"counter_inc": set(), "gauge_set": set(), "observe": set()}
+        for dirpath, _dirs, names in os.walk(root):
+            for name in names:
+                if not name.endswith(".py"):
+                    continue
+                src = open(os.path.join(dirpath, name)).read()
+                for fn, series in self.CALL.findall(src):
+                    found[fn].add(series)
+        return found
+
+    def test_counter_literals_are_declared(self):
+        found = self._scan()
+        assert found["counter_inc"], "scan found no counter call sites"
+        undeclared = found["counter_inc"] - metrics._DECLARED_COUNTERS
+        assert not undeclared, (
+            f"counter_inc literals not declared in metrics.py: "
+            f"{sorted(undeclared)}")
+
+    def test_gauge_literals_are_known(self):
+        found = self._scan()
+        assert found["gauge_set"], "scan found no gauge call sites"
+        unknown = found["gauge_set"] - set(metrics.KNOWN_GAUGES)
+        assert not unknown, (
+            f"gauge_set literals missing from metrics.KNOWN_GAUGES: "
+            f"{sorted(unknown)}")
+
+    def test_histogram_literals_are_known(self):
+        found = self._scan()
+        assert found["observe"], "scan found no histogram call sites"
+        unknown = found["observe"] - set(metrics.KNOWN_HISTOGRAMS)
+        assert not unknown, (
+            f"observe literals missing from metrics.KNOWN_HISTOGRAMS: "
+            f"{sorted(unknown)}")
+
+    def test_obs_plane_counters_declared(self):
+        for name in metrics.OBS_COUNTERS:
+            assert name in metrics._DECLARED_COUNTERS
+
+
+# -------------------------------------- Prometheus conformance + golden pin
+class TestPrometheusConformance:
+    def _fresh_golden_series(self):
+        for reg in (metrics._COUNTERS, metrics._GAUGES, metrics._HISTOGRAMS,
+                    metrics._HELP):
+            for k in [k for k in reg if k.startswith("golden.")]:
+                del reg[k]
+        metrics._DECLARED_COUNTERS.difference_update(
+            {k for k in metrics._DECLARED_COUNTERS if k.startswith("golden.")})
+
+    def test_escape_help_and_label_value(self):
+        assert metrics.escape_help("a\\b\nc") == "a\\\\b\\nc"
+        assert metrics.escape_help('quotes " stay raw') == 'quotes " stay raw'
+        assert metrics.escape_label_value('v"1\\2\n3') == 'v\\"1\\\\2\\n3'
+
+    def test_histogram_buckets_are_cumulative(self):
+        self._fresh_golden_series()
+        h = metrics.histogram("golden.cum", bounds=[0.1, 1.0, 10.0])
+        for v in (0.05, 0.5, 0.6, 5.0, 50.0):
+            h.observe(v)
+        text = metrics.prometheus_text(prefix="golden.cum")
+        assert 'golden_cum_seconds_bucket{le="0.1"} 1' in text
+        assert 'golden_cum_seconds_bucket{le="1"} 3' in text
+        assert 'golden_cum_seconds_bucket{le="10"} 4' in text
+        assert 'golden_cum_seconds_bucket{le="+Inf"} 5' in text
+        assert "golden_cum_seconds_count 5" in text
+        assert "golden_cum_seconds_sum 56.15" in text
+        self._fresh_golden_series()
+
+    def test_suffixes_and_name_sanitization(self):
+        self._fresh_golden_series()
+        metrics.counter_inc("golden.a-b.c", 1)
+        text = metrics.prometheus_text(prefix="golden.a")
+        # dots/dashes fold to underscores; counters get _total exactly once
+        assert "paddle_tpu_golden_a_b_c_total 1" in text
+        assert "_total_total" not in text
+        self._fresh_golden_series()
+
+    def test_golden_file_pin(self):
+        """The full exposition for a fixed series set is pinned byte-for-
+        byte — any format drift (help escaping, suffixing, bucket
+        cumulation, ordering) fails here first."""
+        self._fresh_golden_series()
+        metrics.declare_counter(
+            "golden.requests",
+            'requests served, incl. "bad" ones\nsecond line \\ backslash')
+        metrics.counter_inc("golden.requests", 3)
+        metrics.gauge_set("golden.temp", 1.5)
+        metrics.declare_help("golden.temp", "current temperature")
+        h = metrics.histogram("golden.latency", bounds=[0.01, 0.1, 1.0])
+        for v in (0.005, 0.05, 0.5, 5.0):
+            h.observe(v)
+        metrics.declare_help("golden.latency", "request latency")
+        text = metrics.prometheus_text(prefix="golden.")
+        golden = open(os.path.join(os.path.dirname(__file__), "golden",
+                                   "prometheus.golden.txt")).read()
+        assert text == golden
+        self._fresh_golden_series()
+
+
+# ----------------------------------- measured step-time persistence (PR 14)
+class TestMeasuredStepTimes:
+    @pytest.fixture
+    def cache_dir(self, tmp_path):
+        prev = paddle.get_flags("FLAGS_compile_cache_dir")["FLAGS_compile_cache_dir"]
+        paddle.set_flags({"FLAGS_compile_cache_dir": str(tmp_path)})
+        yield tmp_path
+        paddle.set_flags({"FLAGS_compile_cache_dir": prev})
+
+    def test_record_accumulates_schema(self, cache_dir):
+        from paddle_tpu.observability import measured
+
+        p = measured.record("fp123", 0.25, k=5)
+        assert p == str(cache_dir / "measured" / "fp123.json")
+        measured.record("fp123", 0.15, k=5)
+        doc = measured.load("fp123")
+        assert doc["format"] == 1
+        assert doc["fingerprint"] == "fp123"
+        assert doc["samples"] == 2 and doc["steps"] == 10
+        assert abs(doc["total_seconds"] - 0.40) < 1e-9
+        assert abs(doc["mean_step_seconds"] - 0.04) < 1e-9
+        assert doc["recent_step_seconds"] == pytest.approx([0.05, 0.03])
+        assert doc["updated_unix"] > 0
+        # a corrupt doc reads as absent, not a crash
+        open(p, "w").write("not json{")
+        assert measured.load("fp123") is None
+
+    def test_noop_without_cache_dir(self):
+        from paddle_tpu.observability import measured
+
+        prev = paddle.get_flags("FLAGS_compile_cache_dir")["FLAGS_compile_cache_dir"]
+        paddle.set_flags({"FLAGS_compile_cache_dir": ""})
+        try:
+            assert measured.path_for("x") is None
+            assert measured.record("x", 0.1) is None
+        finally:
+            paddle.set_flags({"FLAGS_compile_cache_dir": prev})
+
+    def test_run_steps_persists_by_plan_fingerprint(self, cache_dir):
+        from types import SimpleNamespace
+
+        from paddle_tpu import nn
+        from paddle_tpu.observability import measured
+
+        model = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=model.parameters())
+        step = paddle.jit.TrainStep(model, opt, nn.CrossEntropyLoss())
+        step.plan = SimpleNamespace(fingerprint="plan_fp_test")
+        X = np.random.randn(8, 4).astype("float32")
+        Y = np.random.randint(0, 2, (8,)).astype("int64")
+        step.run_steps((np.stack([X] * 3), np.stack([Y] * 3)), k=3)
+        step.run_steps((np.stack([X] * 3), np.stack([Y] * 3)), k=3)
+        doc = measured.load("plan_fp_test")
+        assert doc is not None
+        assert doc["samples"] == 2 and doc["steps"] == 6
+        assert doc["mean_step_seconds"] > 0
+
+    def test_planless_steps_do_not_persist(self, cache_dir):
+        _tiny_train(run_steps_k=2)
+        assert not (cache_dir / "measured").exists()
