@@ -30,7 +30,16 @@ Prints ONE JSON line on the bench.py schema: {"metric", "value", "unit",
    and ``trace_overhead_pct``: the same warm fleet run timed with
    ``FLAGS_trace`` off vs the full tracing plane writing span events to
    a run-log dir (< 2% budget) — the on-arm's merged chrome trace is
-   written next to the run logs and reported as ``trace_artifact``.
+   written next to the run logs and reported as ``trace_artifact``;
+5. **procfleet phase** (own ``BENCH_BUDGET_PROCFLEET`` budget, own
+   subprocess): the cross-process ProcServingFleet — subprocess replicas
+   behind the store-RPC transport — vs the in-process fleet on the same
+   request set (``requests_per_sec`` / ``requests_per_sec_inproc`` /
+   ``transport_overhead_pct``), ``p99_under_sigkill_ms`` with
+   ``FLAGS_chaos_replica_sigkill_at`` delivering a real ``kill -9`` to one
+   replica mid-stream (bitwise exactly-once asserted), streaming
+   ``stream_ttft_p50_ms`` (first token chunk across the process boundary),
+   and ``child_compiles`` pinning the warm AOT boot (0 == no recompiles).
 
 Like bench.py, the process NEVER hangs into the driver's timeout and never
 exits non-zero: the default backend is probed in a throwaway child first and
@@ -403,9 +412,142 @@ def _measure_fleet():
     }
 
 
+def _measure_procfleet():
+    """The cross-process fleet phase: subprocess replicas behind the
+    store-RPC transport vs the in-process fleet on the same request set
+    (``*_inproc`` fields → transport overhead), p99 latency with one
+    replica killed by a real SIGKILL mid-stream, and streaming TTFT (time
+    to the first token CHUNK delivered across the process boundary). Both
+    procfleet arms assert exactly-once bitwise completions — the bench
+    doubles as the kill -9 integration check."""
+    import jax
+
+    if os.environ.get("BENCH_FORCE_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+
+    import paddle_tpu as paddle
+    from paddle_tpu.inference import ProcServingFleet, ServingFleet
+    from paddle_tpu.models.gpt import GPTConfig, GPTForPretraining
+    from paddle_tpu.testing import chaos
+
+    d0 = jax.devices()[0]
+    on_tpu = d0.platform in ("tpu", "axon") or "TPU" in getattr(d0, "device_kind", "")
+    if on_tpu:
+        cfg = GPTConfig(vocab_size=50304, hidden_size=1024, num_layers=16,
+                        num_heads=16, max_seq_len=1024)
+        slots, max_seq, max_new, n_requests = 8, 1024, 32, 24
+        chunk, fuse, n_replicas = 128, 8, 2
+    else:
+        cfg = GPTConfig.tiny()
+        slots, max_seq, max_new, n_requests = 2, 128, 8, 10
+        chunk, fuse, n_replicas = 16, 2, 2
+
+    paddle.seed(0)
+    model = GPTForPretraining(cfg)
+    model.eval()
+    rng = np.random.default_rng(0)
+    kw = dict(max_batch_slots=slots, max_seq_len=max_seq, prefill_chunk=chunk,
+              fuse=fuse)
+    prompts = [rng.integers(0, cfg.vocab_size, (int(n),)).astype("int32")
+               for n in rng.integers(max(1, chunk // 4), chunk, n_requests)]
+
+    import tempfile
+
+    cache_dir = tempfile.mkdtemp(prefix="bench_procfleet_aot_")
+    paddle.set_flags({"FLAGS_compile_cache_dir": cache_dir})
+    try:
+        # --- in-process arm: warm the AOT store, pin the reference tokens,
+        # then a timed fault-free run — the transport-overhead baseline ----
+        warm = ServingFleet(model, replicas=n_replicas, **kw)
+        fids = [warm.submit(p, max_new_tokens=max_new, seed=i)
+                for i, p in enumerate(prompts)]
+        warm.run()  # compiles + serializes the program family
+        want = [list(warm.requests[f].tokens) for f in fids]
+        fl = ServingFleet(model, replicas=n_replicas, **kw)
+        fids = [fl.submit(p, max_new_tokens=max_new, seed=i)
+                for i, p in enumerate(prompts)]
+        t0 = time.perf_counter()
+        done = fl.run()
+        dt_in = time.perf_counter() - t0
+        rps_in = len(done) / dt_in if dt_in > 0 else None
+        ttft_in = sorted(r.ttft_seconds for r in done.values())
+        lat_in = sorted(r.total_seconds for r in done.values())
+
+        # --- cross-process arm, fault-free: boot cost, throughput, and
+        # streaming TTFT (first chunk across the process boundary) --------
+        t0 = time.perf_counter()
+        pf = ProcServingFleet(cfg, replicas=n_replicas,
+                              heartbeat_timeout=120.0, **kw)
+        boot_s = time.perf_counter() - t0
+        try:
+            stream = pf.submit(prompts[0], max_new_tokens=max_new, seed=0,
+                               stream=True)
+            fids = [stream.fid] + [pf.submit(p, max_new_tokens=max_new, seed=i)
+                                   for i, p in enumerate(prompts) if i > 0]
+            t0 = time.perf_counter()
+            chunks = list(stream)
+            done_p = pf.run(timeout_s=600)
+            dt_p = time.perf_counter() - t0
+            assert len(done_p) == len(prompts), "procfleet lost completions"
+            got = [list(pf.requests[f].tokens) for f in fids]
+            assert got == want, "procfleet diverged from the in-process run"
+            assert [t for c in chunks for t in c] == want[0], "stream diverged"
+            rps_p = len(done_p) / dt_p if dt_p > 0 else None
+            ttft_p = sorted(r.ttft_seconds for r in done_p.values())
+            counters = pf.child_counters()
+            child_compiles = sum(c.get("compiles", 0) for c in counters.values())
+        finally:
+            pf.shutdown()
+
+        # --- p99 with one subprocess killed by a real SIGKILL mid-stream --
+        with chaos.inject(
+                FLAGS_chaos_replica_sigkill_at=f"{n_replicas - 1}:2"):
+            pf_k = ProcServingFleet(cfg, replicas=n_replicas,
+                                    heartbeat_timeout=120.0, **kw)
+            try:
+                fids_k = [pf_k.submit(p, max_new_tokens=max_new, seed=i)
+                          for i, p in enumerate(prompts)]
+                done_k = pf_k.run(timeout_s=600)
+                assert len(done_k) == len(prompts), "sigkill run lost completions"
+                for i, f in enumerate(fids_k):
+                    assert list(done_k[f].tokens) == want[i], \
+                        f"sigkill run diverged on request {i}"
+                lat_k = sorted(r.total_seconds for r in done_k.values())
+                stats_k = pf_k.stats()
+            finally:
+                pf_k.shutdown()
+    finally:
+        try:
+            paddle.set_flags({"FLAGS_compile_cache_dir": ""})
+        except Exception:
+            pass
+
+    overhead = ((rps_in / rps_p - 1.0) * 100.0
+                if rps_in and rps_p else None)
+    return {
+        "replicas": n_replicas,
+        "requests": len(done_p),
+        "requests_per_sec": round(rps_p, 3) if rps_p else None,
+        "requests_per_sec_inproc": round(rps_in, 3) if rps_in else None,
+        "transport_overhead_pct": round(overhead, 2) if overhead is not None else None,
+        "p99_under_sigkill_ms": round(_percentile(lat_k, 99) * 1e3, 2),
+        "latency_p99_ms_inproc": round(_percentile(lat_in, 99) * 1e3, 2),
+        "stream_ttft_p50_ms": round(_percentile(ttft_p, 50) * 1e3, 2),
+        "ttft_p50_ms_inproc": round(_percentile(ttft_in, 50) * 1e3, 2),
+        "requeues_under_sigkill": stats_k["requeues"],
+        "replica_deaths": len(stats_k["dead"]),
+        "boot_seconds": round(boot_s, 3),
+        "child_compiles": child_compiles,  # 0 == the warm-boot pin held
+        "stream_chunks": len(chunks),
+    }
+
+
 def main():
     if os.environ.get("BENCH_ONE") == "fleet":
         print(json.dumps(_measure_fleet()))
+        return
+    if os.environ.get("BENCH_ONE") == "procfleet":
+        print(json.dumps(_measure_procfleet()))
         return
     if os.environ.get("BENCH_ONE"):
         print(json.dumps(_measure()))
@@ -415,9 +557,11 @@ def main():
 
     budget = float(os.environ.get("BENCH_BUDGET_SERVE", 420))
     budget_fleet = float(os.environ.get("BENCH_BUDGET_FLEET", 300))
+    budget_procfleet = float(os.environ.get("BENCH_BUDGET_PROCFLEET", 300))
     verdict = _probe_default_backend(timeout=75.0)
     extras = None
     fleet_info = None
+    procfleet_info = None
     error = None
     fallback = None
     if verdict is None:
@@ -430,6 +574,11 @@ def main():
         except Exception as exc:
             fleet_info = {"status": "error",
                           "error": f"{type(exc).__name__}: {exc}"}
+        try:
+            procfleet_info = _measure_procfleet()
+        except Exception as exc:
+            procfleet_info = {"status": "error",
+                              "error": f"{type(exc).__name__}: {exc}"}
     else:
         import subprocess
 
@@ -465,12 +614,25 @@ def main():
             fleet_info = {"status": "timeout", "budget_seconds": budget_fleet}
         except Exception as exc:
             fleet_info = {"status": "error", "error": f"{type(exc).__name__}"}
+        # procfleet phase: subprocess replicas under real SIGKILL — its own
+        # budget and child so a wedged transport can't eat the whole bench
+        try:
+            procfleet_info = _child(force_cpu=(verdict is not True),
+                                    which="procfleet",
+                                    timeout=budget_procfleet)
+        except subprocess.TimeoutExpired:
+            procfleet_info = {"status": "timeout",
+                              "budget_seconds": budget_procfleet}
+        except Exception as exc:
+            procfleet_info = {"status": "error",
+                              "error": f"{type(exc).__name__}"}
 
     if extras is None:
         print(json.dumps({"metric": "gpt_serving_throughput", "value": None,
                           "unit": "requests/sec", "vs_baseline": None,
                           "requests_per_sec": None, "latency_p50_ms": None,
                           "latency_p99_ms": None, "fleet": fleet_info,
+                          "procfleet": procfleet_info,
                           "error": error or "bench_error"}))
         return
 
@@ -507,6 +669,8 @@ def main():
     out.update({k: v for k, v in extras.items() if k not in ("value",)})
     if fleet_info is not None:
         out["fleet"] = fleet_info
+    if procfleet_info is not None:
+        out["procfleet"] = procfleet_info
     if fallback:
         out["fallback"] = fallback
     print(json.dumps(out))

@@ -205,6 +205,91 @@ class ElasticMembershipManager:
             members = self.node.wait_for(self.min_np, self.max_np)
 
 
+class ServeController(CollectiveController):
+    """``--serve``: every worker slot hosts one cross-process serving
+    replica (``python -m paddle_tpu.inference.procfleet``) instead of a
+    training script. The rank-0 node hosts the fleet TCPStore at
+    ``--master``; replicas connect to it, register store membership
+    (``procfleet/<ns>/members_n`` + their heartbeat key), and idle until a
+    serving front adopts them via ``ProcServingFleet.attach(master, ns=ns)``.
+    The positional argument is a JSON spec file::
+
+        {"ns": "serve", "model": {"seed": 0, "config": {...GPTConfig kwargs}},
+         "engine_kwargs": {"max_batch_slots": 2, ...}, "beat_interval": 0.05}
+
+    A front-end ``shutdown()`` drains every replica (exit 0), so
+    ``watch()`` returns 0 and the launcher exits clean."""
+
+    def __init__(self, ctx: LaunchContext, spec: dict):
+        super().__init__(ctx)
+        self.spec = dict(spec)
+        self.store = None
+
+    def host_store(self):
+        a = self.ctx.args
+        if a.rank != 0:
+            return
+        from ..store import TCPStore
+
+        host, port = a.master.rsplit(":", 1)
+        self.store = TCPStore(host=host, port=int(port), is_master=True,
+                              world_size=1, timeout=60.0)
+
+    def spawn(self, nnodes=None, node_rank=None):
+        import json
+
+        from ...inference.procfleet import (CHILD_CMD, SPEC_ENV, child_env,
+                                            current_jax_config)
+
+        a = self.ctx.args
+        base = (a.rank if node_rank is None else node_rank) * a.nproc_per_node
+        self.procs = []
+        for i in range(a.nproc_per_node):
+            rid = base + i
+            spec = dict(self.spec)
+            spec.setdefault("ns", "serve")  # noqa: PTA104 (host-side, never traced)
+            spec.setdefault("jax_config", current_jax_config())  # noqa: PTA104 (host-side, never traced)
+            spec.update({"rid": rid, "endpoint": a.master})  # noqa: PTA104 (host-side, never traced)
+            # trainer id 0 is the serving front (the attach() parent);
+            # replicas take 1..N so trace/span id streams decorrelate
+            env = child_env({SPEC_ENV: json.dumps(spec),
+                             "PADDLE_TRAINER_ID": str(rid + 1)})
+            log_path = None
+            stdout = None
+            if a.log_dir:
+                os.makedirs(a.log_dir, exist_ok=True)
+                log_path = os.path.join(a.log_dir, f"replica.{rid}.log")
+                stdout = open(log_path, "ab")
+            proc = subprocess.Popen(CHILD_CMD, env=env, stdout=stdout,
+                                    stderr=subprocess.STDOUT if stdout else None)
+            self.procs.append(WorkerProc(rid, proc, log_path))  # noqa: PTA104 (host-side, never traced)
+
+
+def _serve(ns, script_args) -> int:
+    import json
+
+    spec = {}
+    if ns.training_script:
+        with open(ns.training_script) as f:
+            spec = json.load(f)
+    controller = ServeController(LaunchContext(ns, script_args), spec)
+    controller.host_store()
+    try:
+        controller.spawn()
+        print(f"[launch][serve] {ns.nproc_per_node} replica(s) on node "  # noqa: PTA105 (host-side, never traced)
+              f"{ns.rank}; store endpoint {ns.master} ns "
+              f"{spec.get('ns', 'serve')!r} — attach with "
+              f"ProcServingFleet.attach({ns.master!r})",
+              file=sys.stderr, flush=True)
+        return controller.watch()
+    finally:
+        if controller.store is not None:
+            try:
+                controller.store.close()
+            except OSError:
+                pass
+
+
 def _parser():
     p = argparse.ArgumentParser(prog="paddle_tpu.distributed.launch", description="multi-host collective launcher (reference launch/main.py parity)")
     p.add_argument("--nnodes", type=int, default=1, help="number of nodes (hosts)")
@@ -216,6 +301,7 @@ def _parser():
     p.add_argument("--elastic_retries", type=int, default=0, help="relaunch the collective up to N times on worker failure")
     p.add_argument("--elastic_np", type=str, default=os.environ.get("PADDLE_ELASTIC_NP"), help="elastic node range 'min:max' (or 'n'): membership-managed launch with rescaling")
     p.add_argument("--elastic_timeout", type=float, default=3.0, help="heartbeat staleness (s) before a node is considered gone")
+    p.add_argument("--serve", action="store_true", help="boot cross-process serving replicas (paddle_tpu.inference.procfleet) instead of a training script; the positional argument is the fleet spec JSON (model config + engine kwargs), rank 0 hosts the store at --master, and a front-end adopts the fleet with ProcServingFleet.attach")
     p.add_argument("training_script", type=str)
     return p
 
@@ -223,6 +309,8 @@ def _parser():
 def launch(argv=None):
     argv = list(sys.argv[1:] if argv is None else argv)
     ns, script_args = _parser().parse_known_args(argv)
+    if ns.serve:
+        return _serve(ns, script_args)
     ctx = LaunchContext(ns, script_args)
     controller = CollectiveController(ctx)
     if ns.elastic_np:

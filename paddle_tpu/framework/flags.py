@@ -118,3 +118,5 @@ define_flag("FLAGS_chaos_nan_at_step", -1, "inject non-finite gradients in-graph
 define_flag("FLAGS_chaos_nan_steps", 1, "number of consecutive steps the NaN-gradient injection fires for (default 1)")
 define_flag("FLAGS_chaos_replica_kill_at", "", "kill a serving-fleet engine replica mid-stream: 'R:K' kills replica R after its K-th decode tick (fires exactly once per replica per process). Drives the fleet kill/requeue tests")
 define_flag("FLAGS_chaos_replica_slow_ms", "", "inject per-tick latency into serving-fleet replicas: 'MS' slows every replica, 'R:MS' only replica R, by MS milliseconds per scheduler tick (a straggler/overloaded host; long enough and the fleet's heartbeat tracking declares it dead)")
+define_flag("FLAGS_chaos_replica_sigkill_at", "", "SIGKILL a cross-process serving replica mid-stream: 'R:K' makes the ProcServingFleet parent send SIGKILL to replica R's subprocess after harvesting its K-th tick message (fires exactly once per replica per process). The real-process form of FLAGS_chaos_replica_kill_at — no Python exception, the child just dies")
+define_flag("FLAGS_chaos_replica_hang_ms", "", "wedge a cross-process serving replica without exiting: 'MS' (every replica) or 'R:MS' (one) makes the child stop publishing heartbeats for MS milliseconds after its first served tick while the process stays alive (a zombie the parent's stale-beat sweep must catch). Fires exactly once per replica per process")

@@ -52,6 +52,7 @@ from .fleet import (
     ServingFleet,
 )
 from .prefix_cache import PrefixCache
+from .procfleet import ProcReplica, ProcServingFleet, TokenStream
 from .router import Router
 from .scheduler import ContinuousBatchingScheduler, Request
 
@@ -61,6 +62,7 @@ __all__ = [
     "PrefixCache", "default_buckets", "get_version",
     "ServingFleet", "EngineReplica", "FleetRequest", "Router",
     "FleetOverloadError", "FleetDrainedError",
+    "ProcServingFleet", "ProcReplica", "TokenStream",
 ]
 
 

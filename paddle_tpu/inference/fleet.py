@@ -232,6 +232,11 @@ class ServingFleet:
         self._next_fid = 0
         self._next_rid = 0
         self.requeues = 0
+        # cascade-death bookkeeping: _on_replica_death is re-entrant (a
+        # survivor can die while absorbing requeued work — _place runs a
+        # synchronous submit); the outermost call owns the drain loop
+        self._requeue_backlog: List[int] = []
+        self._draining = False
         for _ in range(int(replicas)):
             self._add_replica()
         self._emit_membership()
@@ -451,6 +456,16 @@ class ServingFleet:
                          trace=freq.trace_id)
 
     def _on_replica_death(self, rep: EngineReplica, exc: BaseException) -> None:
+        """Mark dead, forget chains, requeue in-flight work. Re-entrant:
+        requeue placement can kill the survivor it lands on (its scheduler
+        submit runs synchronously), re-entering this method mid-drain. A
+        nested call parks the newly dead replica's fids on the shared
+        backlog and returns; the OUTERMOST call keeps draining until the
+        backlog is empty, so a cascade (every survivor dying in turn)
+        still raises one FleetDrainedError accounting for every lost fid
+        — the single-pass version dropped the outer pending set."""
+        if not rep.alive:
+            return
         rep.alive = False
         rep.death_reason = f"{type(exc).__name__}: {exc}"
         counter_inc("fleet.replica_deaths")
@@ -467,11 +482,23 @@ class ServingFleet:
                         inflight=sorted(pending.values()),
                         traces=lost_traces)
         self._emit_membership()
-        survivors = self._alive()
-        if not survivors and pending:
-            raise FleetDrainedError(sorted(pending.values()))
-        for fid in pending.values():
-            self._requeue(self.requests[fid], survivors)
+        self._requeue_backlog.extend(sorted(pending.values()))
+        if self._draining:
+            return  # nested death: the outermost drain loop absorbs it
+        self._draining = True
+        try:
+            lost: List[int] = []
+            while self._requeue_backlog:
+                fid = self._requeue_backlog.pop(0)
+                survivors = self._alive()  # recomputed: the set shrinks mid-drain
+                if not survivors:
+                    lost.append(fid)  # noqa: PTA104 (host-side serving loop, never traced)
+                    continue
+                self._requeue(self.requests[fid], survivors)
+            if lost:
+                raise FleetDrainedError(sorted(lost))
+        finally:
+            self._draining = False
 
     def _requeue(self, freq: FleetRequest, survivors: Dict[int, EngineReplica]):
         """Re-place one request lost to a replica death. The replay runs the

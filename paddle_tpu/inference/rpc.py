@@ -1,0 +1,127 @@
+"""Compact store-backed RPC for the cross-process serving fleet.
+
+The parent supervisor and each replica subprocess already share exactly one
+piece of infrastructure: the :class:`~..distributed.store.TCPStore` (the
+same rendezvous substrate the launcher, the elastic membership layer, and
+the fleet heartbeats ride). This module turns it into a pair of ordered,
+single-writer message channels per replica::
+
+    procfleet/<ns>/<rid>/in    parent -> child   submit / cancel / drain
+    procfleet/<ns>/<rid>/out   child -> parent   tick / chunk / finished
+
+A :class:`Channel` is an append-only log: the (single) writer serializes
+each message as JSON under ``<prefix>/m/<seq>`` and THEN bumps the
+``<prefix>/n`` counter — so a reader that observes ``n == k`` can fetch
+messages ``1..k`` without racing a half-published entry, and a writer that
+dies mid-send (SIGKILL, segfault) leaves at worst an orphaned key the
+counter never acknowledged. Reads are destructive (``delete_key`` after
+fetch) so a long-lived serving store doesn't accumulate the whole token
+history. Ordering is total per channel: sequence numbers are assigned by
+the writer, drained in order by the reader — the property the per-token
+streaming ledger's chunk sequence numbers build on.
+
+Heartbeats deliberately do NOT ride the message log (a beat per tick would
+dominate the store traffic): each replica overwrites one well-known key,
+``procfleet/<ns>/<rid>/hb``, with a monotonic beat counter plus its local
+``infer.*`` counters (compiles / AOT hits — per-process state the parent
+cannot see any other way) and the parent's stale-beat sweep watches the
+counter for motion, not the wall clock, so cross-host clock skew cannot
+fake a death.
+
+Every envelope carries the fleet ``trace_id`` (PR 14): the child attaches
+it to its scheduler submission, so one trace spans parent placement, child
+prefill/decode spans, requeue, and delivery across process boundaries.
+"""
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Dict, List, Optional
+
+__all__ = ["Channel", "Heartbeat", "channel_prefix", "hb_key"]
+
+
+def channel_prefix(ns: str, rid: int, direction: str) -> str:
+    """The store key prefix for one replica-channel direction ('in' is
+    parent->child, 'out' is child->parent)."""
+    return f"procfleet/{ns}/{rid}/{direction}"
+
+
+def hb_key(ns: str, rid: int) -> str:
+    return f"procfleet/{ns}/{rid}/hb"
+
+
+class Channel:
+    """One direction of ordered message flow over a TCPStore.
+
+    Exactly one process may :meth:`send` and exactly one may :meth:`recv`
+    on a given prefix — sequence numbers are writer-local, which is what
+    makes the set-then-bump publication protocol race-free without a
+    store-side transaction."""
+
+    def __init__(self, store, prefix: str):
+        self.store = store
+        self.prefix = prefix
+        self._sent = 0   # writer: last sequence number published
+        self._read = 0   # reader: last sequence number consumed
+
+    # ------------------------------------------------------------- writer
+    def send(self, kind: str, **payload: Any) -> int:
+        """Publish one message; returns its sequence number. The message
+        body lands under ``m/<seq>`` BEFORE the ``n`` counter acknowledges
+        it, so readers never observe a torn write."""
+        self._sent += 1
+        msg = {"kind": kind, "seq": self._sent}
+        msg.update(payload)
+        self.store.set(f"{self.prefix}/m/{self._sent}", json.dumps(msg))
+        self.store.add(f"{self.prefix}/n", 1)
+        return self._sent
+
+    # ------------------------------------------------------------- reader
+    def recv(self, timeout: float = 2.0) -> List[Dict[str, Any]]:
+        """Drain every message published since the last call, in order.
+        Non-blocking when nothing is pending (one counter read); the
+        ``timeout`` only bounds the body fetch of an acknowledged message
+        (which the writer has already set — it arrives immediately)."""
+        n = int(self.store.add(f"{self.prefix}/n", 0))
+        out: List[Dict[str, Any]] = []
+        while self._read < n:
+            seq = self._read + 1
+            raw = self.store.get(f"{self.prefix}/m/{seq}", timeout=timeout)
+            out.append(json.loads(raw if isinstance(raw, str) else raw.decode()))  # noqa: PTA104 (host-side serving loop, never traced)
+            try:
+                self.store.delete_key(f"{self.prefix}/m/{seq}")
+            except OSError:
+                pass  # GC is best-effort; the counter already moved on
+            self._read = seq  # noqa: PTA104 (host-side, never traced)
+        return out
+
+
+class Heartbeat:
+    """The one-key beat a replica subprocess publishes and the parent
+    sweeps. ``beat()`` overwrites; ``read()`` parses; staleness is judged
+    by the PARENT's monotonic clock against the last time the beat counter
+    moved (see ProcReplica), never by comparing wall clocks."""
+
+    def __init__(self, store, ns: str, rid: int):
+        self.store = store
+        self.key = hb_key(ns, rid)
+        self._n = 0
+
+    def beat(self, **extra: Any) -> None:
+        self._n += 1
+        doc = {"n": self._n, "ts": time.time()}
+        doc.update(extra)
+        self.store.set(self.key, json.dumps(doc))
+
+    def read(self, timeout: float = 0.05) -> Optional[Dict[str, Any]]:
+        """The latest published beat, or None when the replica has not
+        beaten yet (still importing/booting)."""
+        try:
+            raw = self.store.get(self.key, timeout=timeout)
+        except (TimeoutError, OSError):
+            return None
+        try:
+            return json.loads(raw if isinstance(raw, str) else raw.decode())
+        except (ValueError, UnicodeDecodeError):
+            return None

@@ -181,6 +181,9 @@ FLEET_COUNTERS: Tuple[str, ...] = (
     "fleet.requeues", "fleet.sheds", "fleet.deadline_hits",
     "fleet.replica_deaths", "fleet.scale_outs",
     "fleet.routed_affinity", "fleet.routed_load",
+    # cross-process tier (inference/procfleet.py): token chunks applied to
+    # the parent ledger from replica-subprocess stream messages
+    "fleet.stream_chunks",
 )
 
 # Kernel-registry selection series (paddle_tpu.ops.registry): one

@@ -34,6 +34,16 @@ Injection points:
   mid-stream replica death (drain + requeue); ``replica_slow_ms(rid)``
   reads ``FLAGS_chaos_replica_slow_ms`` ('MS' or 'R:MS') as per-tick
   injected latency (a straggler the heartbeat tracker must catch).
+- **real-process replica faults** (the cross-process fleet):
+  ``replica_sigkill_due(rid, tick)`` is True exactly once when
+  ``FLAGS_chaos_replica_sigkill_at`` ('R:K') names replica R and the
+  parent has harvested K of its tick messages — the ProcServingFleet
+  supervisor answers True by sending the child a real SIGKILL (no
+  exception, no cleanup: the kill -9 the requeue ledger must survive);
+  ``replica_hang_due_ms(rid)`` reads ``FLAGS_chaos_replica_hang_ms``
+  ('MS' or 'R:MS') exactly once as a heartbeat blackout — the child stays
+  alive but stops beating for MS milliseconds (a zombie only the parent's
+  stale-beat sweep can catch, since the process never exits).
 """
 from __future__ import annotations
 
@@ -153,6 +163,56 @@ def replica_kill_due(replica_id, tick) -> bool:
     _fired.add(key)
     _emit_inject(kind="replica_kill", replica=replica_id, tick=int(tick))
     return True
+
+
+def replica_sigkill_due(replica_id, tick) -> bool:
+    """True — exactly once per (replica, process) — when
+    ``FLAGS_chaos_replica_sigkill_at`` ('R:K') names ``replica_id`` and the
+    parent supervisor has harvested at least K of its tick messages. The
+    cross-process fleet answers True with a real ``SIGKILL`` to the child:
+    no exception path, no drain — the process is simply gone, which is the
+    failure the exactly-once requeue ledger exists for."""
+    if not enabled():
+        return False
+    spec = flag("FLAGS_chaos_replica_sigkill_at")
+    if not spec:
+        return False
+    rid, _, at = spec.partition(":")
+    if str(replica_id) != rid or int(tick) < int(at or 0):
+        return False
+    key = ("replica_sigkill", str(replica_id))
+    if key in _fired:
+        return False
+    _fired.add(key)
+    _emit_inject(kind="replica_sigkill", replica=replica_id, tick=int(tick))
+    return True
+
+
+def replica_hang_due_ms(replica_id) -> float:
+    """Heartbeat blackout in milliseconds — nonzero exactly once per
+    (replica, process) — when ``FLAGS_chaos_replica_hang_ms`` ('MS' for
+    every replica, 'R:MS' for one) names ``replica_id``. The subprocess
+    replica answers a nonzero return by suppressing its heartbeat
+    publications for that long WITHOUT exiting: process liveness stays
+    green, only the stale-beat sweep can tell it's wedged."""
+    if not enabled():
+        return 0.0
+    spec = flag("FLAGS_chaos_replica_hang_ms")
+    if not spec:
+        return 0.0
+    rid, sep, ms = spec.partition(":")
+    if sep:
+        if str(replica_id) != rid:
+            return 0.0
+        ms = float(ms)
+    else:
+        ms = float(rid)
+    key = ("replica_hang", str(replica_id))
+    if ms <= 0 or key in _fired:
+        return 0.0
+    _fired.add(key)
+    _emit_inject(kind="replica_hang", replica=replica_id, hang_ms=ms)
+    return ms
 
 
 def replica_slow_ms(replica_id) -> float:

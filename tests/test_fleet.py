@@ -115,6 +115,35 @@ class TestKillRequeue:
             fleet.kill_replica(0)
         assert fid in ei.value.lost
 
+    def test_cascade_death_during_requeue_keeps_full_lost_accounting(
+            self, model, monkeypatch):
+        """Regression: the survivor dies WHILE absorbing requeued work —
+        _on_replica_death re-enters mid-drain. The single-pass requeue
+        raised a FleetDrainedError accounting only the nested replica's
+        in-flight set, silently dropping the first victim's remaining
+        fids; the re-entrant drain must report every lost fid once."""
+        fleet = ServingFleet(model, replicas=2, **KW)
+        fids = [fleet.submit(p, max_new_tokens=4, seed=i, replica=i % 2)
+                for i, p in enumerate(_prompts(4))]
+        orig_place = fleet._place
+        fired = []
+
+        def cascade_place(freq, rid, reason, deadline_s="unset"):
+            orig_place(freq, rid, reason, deadline_s=deadline_s)
+            if not fired and reason.startswith("requeue"):
+                fired.append(rid)
+                fleet._on_replica_death(
+                    fleet.replicas[rid],
+                    RuntimeError("cascade: survivor died absorbing requeue"))
+
+        monkeypatch.setattr(fleet, "_place", cascade_place)
+        with pytest.raises(FleetDrainedError) as ei:
+            fleet.kill_replica(0)
+        # every in-flight fid is accounted lost, exactly once
+        assert sorted(ei.value.lost) == sorted(fids)
+        assert fleet.stats()["alive"] == []
+        assert not fleet._draining and not fleet._requeue_backlog
+
 
 # ------------------------------------------------------------- routing
 class TestRouting:
