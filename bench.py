@@ -144,6 +144,43 @@ def _measure(flash_flat: bool):
         extras["steps_per_sec_fused_guarded"] = round(guarded_sps, 3)
         extras["guard_overhead_pct"] = round(
             100.0 * (1.0 - guarded_sps / base_sps), 2)
+        # dispatch-sanitizer overhead (FLAGS_sanitize runtime guards:
+        # transfer_guard scope + recompile-churn sentinel + donated-state
+        # sweep) on the same fused microbench, same symmetric interleaved
+        # best-of protocol as the guard arm; budget is <2% of fused sps.
+        # host_transfers_per_step must be 0.0 — the hot path never syncs.
+        from paddle_tpu.analysis import sanitizer as _sanitizer
+        from paddle_tpu.observability.metrics import counters as _san_counters
+
+        paddle.seed(0)
+        model_s = GPTForPretraining(cfg)
+        opt_s = paddle.optimizer.AdamW(
+            learning_rate=1e-4, parameters=model_s.parameters())
+        step_s = TrainStep(model_s, opt_s, crit, amp_level=amp_level)
+        _sanitizer.reset()
+        prev_san = _REGISTRY.get("FLAGS_sanitize", False)
+        try:
+            _REGISTRY["FLAGS_sanitize"] = True
+            out = step_s.run_steps(stacked, k=K)  # warmup compile
+            float(np.asarray(out["loss"]._value)[-1])
+            _REGISTRY["FLAGS_sanitize"] = False
+            base2_dt, san_dt = [], []
+            ht0 = _san_counters().get("sanitizer.host_transfers", 0)
+            for _ in range(4):  # interleave: drift hits both sides equally
+                base2_dt.append(_time_fused(step))
+                _REGISTRY["FLAGS_sanitize"] = True
+                san_dt.append(_time_fused(step_s))
+                _REGISTRY["FLAGS_sanitize"] = False
+            san_steps = 4 * 8 * K  # rounds * reps * fused K
+            extras["host_transfers_per_step"] = round(
+                (_san_counters().get("sanitizer.host_transfers", 0) - ht0)
+                / san_steps, 4)
+            san_sps = K / min(san_dt)
+            extras["steps_per_sec_fused_sanitized"] = round(san_sps, 3)
+            extras["sanitize_overhead_pct"] = round(
+                100.0 * (1.0 - san_sps / (K / min(base2_dt))), 2)
+        finally:
+            _REGISTRY["FLAGS_sanitize"] = prev_san
     from paddle_tpu.observability.metrics import counters as _counters
 
     stab = _counters()

@@ -16,7 +16,11 @@ The optimizing passes are XLA's job in this design; this package keeps the
 - the lowered SPMD program — the post-GSPMD HLO of a compiled-but-not-yet-
   dispatched executable (:mod:`analysis.spmd` + :mod:`analysis.hlo`,
   ``PTA2xx``): implicit all-gathers, spec-mismatch reshards, decode-loop
-  collectives, HBM-budget overruns, cross-rank schedule divergence.
+  collectives, HBM-budget overruns, cross-rank schedule divergence, and
+- dispatch hygiene (:mod:`analysis.hygiene`, ``PTA3xx``): host syncs in
+  traced code, recompile hazards, donation aliasing, nondeterminism and
+  unbounded host-state growth — statically, with a runtime counterpart
+  (:mod:`analysis.sanitizer`) behind ``FLAGS_sanitize``.
 
 Entry points:
   ``Program.analyze(fetch_list)``          — run the IR passes
@@ -27,6 +31,8 @@ Entry points:
   ``paddle.jit.to_static(fn, lint=True)``  — pre-flight AST lint
   ``python -m paddle_tpu.analysis <target>`` — CLI over files/modules/dirs
   ``python -m paddle_tpu.analysis --hlo dump.txt`` — CLI over HLO text
+  ``python -m paddle_tpu.analysis --hygiene <target>`` — PTA3xx passes
+  ``FLAGS_sanitize=1``                     — runtime dispatch sanitizer
 """
 from __future__ import annotations
 
@@ -45,6 +51,13 @@ from .diagnostics import (
     max_severity,
 )
 from .graph import RESERVED_FEEDS, DefUseGraph
+from .hygiene import (
+    HYGIENE_CODES,
+    check_file,
+    check_module,
+    check_path,
+    check_source,
+)
 from .passes import (
     AnalysisContext,
     analyze_program,
@@ -65,6 +78,7 @@ __all__ = [
     "AnalysisContext",
     "DefUseGraph",
     "Diagnostic",
+    "HYGIENE_CODES",
     "ProgramAnalysisError",
     "RESERVED_FEEDS",
     "SEVERITIES",
@@ -74,6 +88,10 @@ __all__ = [
     "analyze_hlo_text",
     "analyze_jit",
     "analyze_program",
+    "check_file",
+    "check_module",
+    "check_path",
+    "check_source",
     "format_report",
     "lint_file",
     "lint_function",

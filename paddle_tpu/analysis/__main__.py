@@ -1,9 +1,13 @@
 """CLI: ``python -m paddle_tpu.analysis <module-or-script-or-dir> ...``
 
-Two modes:
+Three modes:
 
 - default — the dy2static pre-flight linter over the targets' Python source
   (no target code is imported or executed — modules resolve via find_spec);
+- ``--hygiene`` — the dispatch-hygiene analyzer (PTA3xx) over the same
+  Python-source targets: host syncs in traced code, recompile hazards,
+  donation aliasing, nondeterminism in traced/seed paths, and unbounded
+  host-state growth on serving tick loops;
 - ``--hlo`` — the SPMD sharding analyzer (PTA2xx) over lowered-program HLO
   text files (``Compiled.as_text()`` dumps, ``XLA_FLAGS=--xla_dump_to``
   output): implicit all-gathers and spec-mismatch reshards with bytes-moved
@@ -23,6 +27,26 @@ from typing import List
 
 from .ast_lint import lint_path
 from .diagnostics import SEVERITIES, Diagnostic
+from .hygiene import HYGIENE_CODES
+
+_CODE_LISTING = """\
+diagnostic codes:
+  PTA0xx — Program IR passes (FLAGS_static_check / Executor pre-flight):
+    PTA001 dead op                    PTA005 baked dynamic dim [error]
+    PTA002 unused feed                PTA006 duplicate computation (CSE)
+    PTA003 implicit dtype promotion   PTA007 oversized closed-over constant
+    PTA004 f16/bf16 reduction (AMP hazard)
+  PTA1xx — dy2static source lint (default mode):
+    PTA100 syntax error [error]       PTA103 break/continue in try/with
+    PTA101 return inside a loop       PTA104 in-place mutation under if
+    PTA102 tuple-target for loop      PTA105 side effect under trace
+  PTA2xx — SPMD/HLO sharding passes (--hlo, FLAGS_shard_check):
+    PTA201 implicit full-gather       PTA204 per-device HBM over budget [error]
+    PTA202 spec-mismatch reshard      PTA205 collective-schedule divergence
+    PTA203 collective per decoded token (serving)
+    PTA206 large param fully replicated on a multi-device mesh
+  PTA3xx — dispatch hygiene (--hygiene, FLAGS_sanitize at runtime):
+""" + "".join(f"    {code} {text}\n" for code, text in sorted(HYGIENE_CODES.items()))
 
 
 def _analyze_hlo_file(path: str, args) -> tuple:
@@ -37,7 +61,7 @@ def _analyze_hlo_file(path: str, args) -> tuple:
     diags, collectives = _spmd.analyze_hlo_text(text, opts, label=path)
     floor = _hlo.entry_memory_lower_bound(text)
     if args.hbm_budget and floor > args.hbm_budget * (1 << 20):
-        diags.append(Diagnostic(
+        diags.append(Diagnostic(  # noqa: PTA104 (host-side CLI code)
             "PTA204", "error",
             f"per-device memory floor for {path} is ~{floor / (1 << 20):.1f} "
             f"MiB (entry parameters + largest result), over the --hbm-budget "
@@ -61,11 +85,19 @@ def main(argv: List[str] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m paddle_tpu.analysis",
         description="static analysis CLI: dy2static pre-flight lint over "
-                    "scripts/packages/modules (default), or the SPMD "
-                    "sharding analyzer over lowered HLO text (--hlo)")
+                    "scripts/packages/modules (default), the dispatch-"
+                    "hygiene analyzer (--hygiene), or the SPMD sharding "
+                    "analyzer over lowered HLO text (--hlo)",
+        epilog=_CODE_LISTING,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
     parser.add_argument("targets", nargs="+",
                         help=".py file, directory, or dotted module name; "
                              "with --hlo: HLO text file(s)")
+    parser.add_argument("--hygiene", action="store_true",
+                        help="run the PTA3xx dispatch-hygiene passes (host "
+                             "syncs in traced code, recompile hazards, "
+                             "donation aliasing, nondeterminism, unbounded "
+                             "host ledgers) instead of the dy2static lint")
     parser.add_argument("--hlo", action="store_true",
                         help="treat targets as lowered-program HLO text and "
                              "run the PTA2xx sharding passes")
@@ -84,6 +116,10 @@ def main(argv: List[str] = None) -> int:
                         help="emit diagnostics as a JSON array (with --hlo: "
                              "one report object per file)")
     args = parser.parse_args(argv)
+    if args.hygiene and args.hlo:
+        print("error: --hygiene and --hlo are mutually exclusive",  # noqa: PTA105 (host-side CLI code)
+              file=sys.stderr)
+        return 2
 
     def _as_dict(d: Diagnostic) -> dict:
         return {"code": d.code, "severity": d.severity, "message": d.message,
@@ -96,37 +132,40 @@ def main(argv: List[str] = None) -> int:
         try:
             if args.hlo:
                 d, rep = _analyze_hlo_file(target, args)
-                diags.extend(d)
-                rep["findings"] = [_as_dict(x) for x in d]
-                reports.append(rep)
+                diags.extend(d)  # noqa: PTA104 (host-side CLI code)
+                rep["findings"] = [_as_dict(x) for x in d]  # noqa: PTA104 (host-side CLI code)
+                reports.append(rep)  # noqa: PTA104 (host-side CLI code)
+            elif args.hygiene:
+                from .hygiene import check_path
+                diags.extend(check_path(target))  # noqa: PTA104 (host-side CLI code)
             else:
-                diags.extend(lint_path(target))
+                diags.extend(lint_path(target))  # noqa: PTA104 (host-side CLI code)
         except (OSError, ValueError) as e:
-            print(f"error: {target}: {e}", file=sys.stderr)
-            return 2
+            print(f"error: {target}: {e}", file=sys.stderr)  # noqa: PTA105 (host-side CLI code)
+            return 2  # noqa: PTA101 (host-side CLI code)
 
     floor = SEVERITIES.index(args.min_severity)
     shown = [d for d in diags if SEVERITIES.index(d.severity) >= floor]
     if args.as_json:
         if args.hlo:
-            print(json.dumps(reports if len(reports) != 1 else reports[0],
+            print(json.dumps(reports if len(reports) != 1 else reports[0],  # noqa: PTA105 (host-side CLI code)
                              indent=2))
         else:
-            print(json.dumps([_as_dict(d) for d in shown], indent=2))
+            print(json.dumps([_as_dict(d) for d in shown], indent=2))  # noqa: PTA105 (host-side CLI code)
     else:
         for d in shown:
-            print(d)
+            print(d)  # noqa: PTA105 (host-side CLI code)
         if args.hlo:
             for rep in reports:
                 sched = ", ".join(f"{k} x{n}" for k, n in
                                   sorted(rep["collectives"].items())) or "none"
-                print(f"{rep['file']}: {rep['collective_count']} collective(s) "
+                print(f"{rep['file']}: {rep['collective_count']} collective(s) "  # noqa: PTA105 (host-side CLI code)
                       f"[{sched}], ~{rep['reshard_bytes']:,} bytes moved/device"
                       f"/dispatch, memory floor {rep['memory_floor_bytes']:,} "
                       f"bytes, schedule {rep['fingerprint'][:16]}")
         counts = {s: sum(1 for d in diags if d.severity == s) for s in SEVERITIES}
         summary = ", ".join(f"{n} {s}" for s, n in counts.items() if n) or "clean"
-        print(f"checked {len(args.targets)} target(s): {summary}")
+        print(f"checked {len(args.targets)} target(s): {summary}")  # noqa: PTA105 (host-side CLI code)
 
     if any(d.severity == "error" for d in diags):
         return 1

@@ -11,6 +11,9 @@ Code space:
   PTA1xx — dy2static pre-flight AST lint (paddle_tpu.analysis.ast_lint)
   PTA2xx — SPMD sharding analyzer over lowered programs
            (paddle_tpu.analysis.spmd / analysis.hlo)
+  PTA3xx — dispatch-hygiene AST passes: host syncs, recompile hazards,
+           donation aliasing, nondeterminism, unbounded host ledgers
+           (paddle_tpu.analysis.hygiene; runtime half: analysis.sanitizer)
 """
 from __future__ import annotations
 

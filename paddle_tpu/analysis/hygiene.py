@@ -1,0 +1,762 @@
+"""Dispatch-hygiene analyzer: the PTA3xx static AST passes.
+
+Third analysis family, after the Program IR passes (PTA0xx) and the
+dy2static pre-flight lint (PTA1xx): these passes look for the *dispatch
+hygiene* bug classes that bit this repo live — host syncs on traced hot
+paths, silent recompile churn, donated-buffer aliasing (the PR-10 bug),
+nondeterminism on the bitwise-replay contract, and per-request host state
+that grows without bound in serving tick loops. Purely source-level (same
+discipline as :mod:`.ast_lint`: nothing is imported or executed); the
+runtime counterpart lives in :mod:`.sanitizer` behind ``FLAGS_sanitize``.
+
+Codes:
+  PTA301 host sync in traced code (.item()/bool()/int()/float()/
+         np.asarray on traced values, print in traced/scan/step bodies)
+  PTA302 recompile hazard: data-derived Python value flowing into a
+         shape/slice position — every new value compiles a new program
+  PTA303 donation-aliasing hazard: a state-leaf reference held across a
+         donated dispatch (reuse crashes on the deleted buffer)
+  PTA304 nondeterminism in a traced or seed-derivation path (time.*,
+         random.*, os.urandom, unordered-set iteration)
+  PTA305 unbounded host-state growth in a serving/fleet tick loop
+         (append-without-GC on a per-request ledger)
+
+A function is *traced* when it is decorated ``@to_static``/``@jit``/
+``@checkpoint`` (or a ``partial`` thereof), referenced by name in a call
+to ``jax.jit``/``lax.scan``/``lax.cond``/``vmap``/``grad``/``shard_map``/
+``pallas_call``/``scan_steps``/…, or nested inside a traced function.
+``# noqa: PTA3xx`` on the flagged line suppresses a finding (bare
+``# noqa`` suppresses all) — same opt-out as the PTA1xx lint.
+"""
+from __future__ import annotations
+
+import ast
+import importlib.util
+import os
+from typing import Dict, List, Optional, Set
+
+from .ast_lint import _noqa_lines
+from .diagnostics import Diagnostic
+
+__all__ = ["HYGIENE_CODES", "check_source", "check_file", "check_module",
+           "check_path"]
+
+#: registered code -> one-line finding (CLI help + README drift guard)
+HYGIENE_CODES = {
+    "PTA301": "host sync in traced code (.item()/bool()/int()/float()/"
+              "np.asarray on traced values, print under trace)",
+    "PTA302": "recompile hazard: data-derived Python value flows into a "
+              "shape/slice position",
+    "PTA303": "donation-aliasing hazard: state leaf held across a donated "
+              "dispatch",
+    "PTA304": "nondeterminism in a traced or seed-derivation path",
+    "PTA305": "unbounded host-state growth in a serving tick loop",
+}
+
+# calls whose function-name arguments become traced bodies
+_TRACE_CALLS = {
+    "jit", "scan", "while_loop", "fori_loop", "cond", "switch", "vmap",
+    "pmap", "grad", "value_and_grad", "shard_map", "pallas_call",
+    "checkpoint", "remat", "scan_steps", "to_static", "custom_vjp",
+    "custom_jvp",
+}
+_TRACED_DECORATORS = {"jit", "to_static", "checkpoint", "remat",
+                      "custom_vjp", "custom_jvp"}
+# attribute accesses that yield static (non-traced) values
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size"}
+# calls that never return traced values
+_HOST_FUNCS = {"len", "range", "enumerate", "zip", "isinstance", "getattr",
+               "hasattr", "type", "id", "repr", "str", "format"}
+# device->host sync methods
+_SYNC_METHODS = {"item", "tolist", "numpy"}
+# constructors/ops with a shape-position first argument (PTA302 sinks)
+_SHAPE_FNS = {"reshape", "zeros", "ones", "full", "empty", "arange",
+              "linspace", "tile", "broadcast_to"}
+# dispatch-like calls that donate state buffers (PTA303)
+_DISPATCH_CALLS = {"run_steps", "decode_step", "prefill_step", "prefill",
+                   "_dispatch", "step"}
+# names whose terminal marks a state tree (PTA303 alias sources)
+_STATE_NAMES = {"state", "_state"}
+# methods that make a class a serving/tick loop owner (PTA305 roots)
+_TICK_METHODS = {"step", "tick", "run", "serve", "poll", "loop", "drain",
+                 "submit", "harvest", "run_steps"}
+# container growth / shrink vocabulary (PTA305)
+_GROW_METHODS = {"append", "add", "extend", "appendleft", "setdefault"}
+_SHRINK_METHODS = {"pop", "popitem", "popleft", "clear", "remove", "discard",
+                   "difference_update"}
+# nondeterminism vocabulary (PTA304)
+_TIME_FNS = {"time", "time_ns", "monotonic", "monotonic_ns", "perf_counter",
+             "perf_counter_ns"}
+_RANDOM_FNS = {"random", "randint", "randrange", "choice", "choices",
+               "shuffle", "uniform", "sample", "getrandbits", "gauss",
+               "normalvariate", "randbytes"}
+
+
+def _terminal(node) -> Optional[str]:
+    """Last component of a Name/Attribute chain (``jax.lax.scan`` ->
+    ``scan``), or None for computed callees."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _dotted(node) -> Optional[str]:
+    """Full dotted path when the chain is Names/Attributes only."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)  # noqa: PTA104 (host-side analyzer code)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)  # noqa: PTA104 (host-side analyzer code)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _self_attr(node) -> Optional[str]:
+    """``self.X`` -> ``X`` (else None)."""
+    if (isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _ordered_stmts(body) -> List[ast.stmt]:
+    """Statements of a function body flattened in source order, descending
+    into compound statements but NOT into nested function/class scopes."""
+    out: List[ast.stmt] = []
+
+    def _flat(stmts):
+        for s in stmts:
+            out.append(s)  # noqa: PTA104 (host-side analyzer code)
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef)):
+                continue
+            for field in ("body", "orelse", "finalbody"):
+                _flat(getattr(s, field, []) or [])
+            for h in getattr(s, "handlers", []) or []:
+                _flat(h.body)
+
+    _flat(body)
+    return out
+
+
+def _exprs_of(stmt) -> List[ast.expr]:
+    """The expressions belonging to one statement (not its nested block
+    bodies — those are separate statements in the ordered walk)."""
+    out = []
+    for field, value in ast.iter_fields(stmt):  # noqa: PTA102 (host-side analyzer code)
+        if field in ("body", "orelse", "finalbody", "handlers"):
+            continue
+        if isinstance(value, ast.expr):
+            out.append(value)  # noqa: PTA104 (host-side analyzer code)
+        elif isinstance(value, list):
+            out.extend(v for v in value if isinstance(v, ast.expr))  # noqa: PTA104 (host-side analyzer code)
+    return out
+
+
+def _walk_no_scopes(node):
+    """ast.walk that does not descend into nested function/class scopes."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        yield n
+        for child in ast.iter_child_nodes(n):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef, ast.Lambda)):
+                continue
+            stack.append(child)  # noqa: PTA104 (host-side analyzer code)
+
+
+class _Emitter:
+    def __init__(self, filename: str, offset: int):
+        self.diags: List[Diagnostic] = []
+        self.filename = filename
+        self.offset = offset
+
+    def emit(self, code: str, node, message: str, hint: str = "",
+             severity: str = "warning"):
+        self.diags.append(Diagnostic(
+            code, severity, message, hint=hint, file=self.filename,
+            line=(node.lineno + self.offset) if hasattr(node, "lineno") else None,
+            col=getattr(node, "col_offset", None)))
+
+
+# =====================================================================
+# PTA301 + PTA304: traced-function passes
+# =====================================================================
+
+class _TracedBodyPass:
+    """Host-sync (PTA301) and nondeterminism (PTA304) inside ONE traced
+    function body. Taint = values derived from the function's parameters
+    (the traced operands); static derivations (``.shape``/``len``) are
+    exempt so shape math never false-positives."""
+
+    def __init__(self, em: _Emitter, fdef, check_determinism_only=False):
+        self.em = em
+        self.fdef = fdef
+        self.determinism_only = check_determinism_only
+        args = fdef.args
+        names = [a.arg for a in (args.posonlyargs + args.args + args.kwonlyargs)]
+        if args.vararg:
+            names.append(args.vararg.arg)  # noqa: PTA104 (host-side analyzer code)
+        if args.kwarg:
+            names.append(args.kwarg.arg)  # noqa: PTA104 (host-side analyzer code)
+        self.taint: Set[str] = {n for n in names if n not in ("self", "cls")}
+
+    # ----------------------------------------------------------- taint
+    def _tainted(self, node) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self.taint
+        if isinstance(node, ast.Attribute):
+            return node.attr not in _STATIC_ATTRS and self._tainted(node.value)
+        if isinstance(node, ast.Subscript):
+            return self._tainted(node.value)
+        if isinstance(node, ast.BinOp):
+            return self._tainted(node.left) or self._tainted(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self._tainted(node.operand)
+        if isinstance(node, ast.Compare):
+            return (self._tainted(node.left)
+                    or any(self._tainted(c) for c in node.comparators))
+        if isinstance(node, ast.BoolOp):
+            return any(self._tainted(v) for v in node.values)
+        if isinstance(node, ast.IfExp):
+            return self._tainted(node.body) or self._tainted(node.orelse)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return any(self._tainted(e) for e in node.elts)
+        if isinstance(node, ast.Starred):
+            return self._tainted(node.value)
+        if isinstance(node, ast.Call):
+            t = _terminal(node.func)
+            if t in _HOST_FUNCS or t in _SYNC_METHODS:
+                return False
+            if isinstance(node.func, ast.Attribute) and self._tainted(node.func.value):
+                return True
+            return any(self._tainted(a) for a in node.args) or any(
+                self._tainted(kw.value) for kw in node.keywords)
+        return False
+
+    # ------------------------------------------------------------- run
+    def run(self):
+        for stmt in _ordered_stmts(self.fdef.body):
+            for expr in _exprs_of(stmt):
+                for sub in _walk_no_scopes(expr):
+                    if isinstance(sub, ast.Call):
+                        if not self.determinism_only:
+                            self._check_sync(sub)
+                        self._check_entropy(sub)
+            if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                self._check_set_iteration(stmt)
+            self._propagate(stmt)
+
+    def _propagate(self, stmt):
+        if isinstance(stmt, ast.Assign):
+            tainted = self._tainted(stmt.value)
+            for tgt in stmt.targets:
+                if isinstance(tgt, ast.Name):
+                    if tainted:
+                        self.taint.add(tgt.id)  # noqa: PTA104 (host-side analyzer code)
+                    else:
+                        self.taint.discard(tgt.id)  # noqa: PTA104 (host-side analyzer code)
+        elif isinstance(stmt, ast.AugAssign) and isinstance(stmt.target, ast.Name):
+            if self._tainted(stmt.value):
+                self.taint.add(stmt.target.id)  # noqa: PTA104 (host-side analyzer code)
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None \
+                and isinstance(stmt.target, ast.Name):
+            if self._tainted(stmt.value):
+                self.taint.add(stmt.target.id)  # noqa: PTA104 (host-side analyzer code)
+            else:
+                self.taint.discard(stmt.target.id)  # noqa: PTA104 (host-side analyzer code)
+
+    # --------------------------------------------------------- PTA301
+    def _check_sync(self, call: ast.Call):
+        fn = self.fdef.name
+        if isinstance(call.func, ast.Name) and call.func.id == "print":
+            self.em.emit(
+                "PTA301", call,
+                f"print() inside traced function {fn!r}: runs once at trace "
+                "time with abstract values and forces a host round-trip if "
+                "the value is materialized",
+                hint="use jax.debug.print, or fetch and print outside the "
+                     "traced body")
+            return
+        t = _terminal(call.func)
+        if (t in _SYNC_METHODS and isinstance(call.func, ast.Attribute)
+                and self._tainted(call.func.value)):
+            self.em.emit(
+                "PTA301", call,
+                f".{t}() on a traced value inside {fn!r}: a device->host "
+                "sync per dispatch — the hot path serializes on it",
+                hint="keep the value on-device (lax.cond/where) or read it "
+                     "back once outside the traced body")
+        elif (isinstance(call.func, ast.Name)
+              and call.func.id in ("bool", "int", "float")
+              and call.args and self._tainted(call.args[0])):
+            self.em.emit(
+                "PTA301", call,
+                f"{call.func.id}() on a traced value inside {fn!r}: forces "
+                "concretization — TracerBoolConversionError at trace time or "
+                "a silent host sync",
+                hint="branch with lax.cond / jnp.where instead of a Python "
+                     "conversion")
+        elif (t in ("asarray", "array")
+              and isinstance(call.func, ast.Attribute)
+              and _terminal(call.func.value) in ("np", "numpy")
+              and any(self._tainted(a) for a in call.args)):
+            self.em.emit(
+                "PTA301", call,
+                f"np.{t}() on a traced value inside {fn!r}: device->host "
+                "materialization in the traced body",
+                hint="use jnp instead of np inside traced code")
+
+    # --------------------------------------------------------- PTA304
+    def _check_entropy(self, call: ast.Call):
+        fn = self.fdef.name
+        dotted = _dotted(call.func) or ""
+        parts = dotted.split(".")
+        t = parts[-1] if parts else ""
+        base = parts[-2] if len(parts) > 1 else ""
+        if base == "time" and t in _TIME_FNS:
+            self.em.emit(
+                "PTA304", call,
+                f"time.{t}() in {fn!r}: wall-clock entropy in a "
+                "traced/seed-derivation path breaks bitwise replay",
+                hint="derive timestamps outside and pass them in, or fold a "
+                     "deterministic counter")
+        elif base == "random" and t in _RANDOM_FNS:
+            if len(parts) >= 3 and parts[-3] in ("np", "numpy"):
+                self.em.emit(
+                    "PTA304", call,
+                    f"np.random.{t}() in {fn!r}: the legacy global numpy "
+                    "RNG is process-order-dependent state",
+                    hint="use np.random.default_rng(seed) or "
+                         "framework.random")
+            else:
+                self.em.emit(
+                    "PTA304", call,
+                    f"random.{t}() in {fn!r}: the global Python RNG breaks "
+                    "the bitwise-replay contract",
+                    hint="fold a paddle.seed-derived key instead")
+        elif dotted == "np.random.default_rng" or dotted == "numpy.random.default_rng":
+            if not call.args and not call.keywords:
+                self.em.emit(
+                    "PTA304", call,
+                    f"np.random.default_rng() with no seed in {fn!r}: "
+                    "OS-entropy seeding, different every run",
+                    hint="pass an explicit seed")
+        elif dotted == "os.urandom" or base == "secrets" or dotted in (
+                "uuid.uuid1", "uuid.uuid4"):
+            self.em.emit(
+                "PTA304", call,
+                f"{dotted}() in {fn!r}: OS entropy in a "
+                "traced/seed-derivation path",
+                hint="derive ids/keys from the run seed "
+                     "(framework.random / trace.new_trace_id)")
+
+    def _check_set_iteration(self, stmt):
+        it = stmt.iter
+        is_set = isinstance(it, ast.Set) or (
+            isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
+            and it.func.id in ("set", "frozenset"))
+        if is_set:
+            self.em.emit(
+                "PTA304", stmt,
+                f"iteration over an unordered set in {self.fdef.name!r}: "
+                "element order is hash-seed-dependent, so derived values "
+                "differ across processes",
+                hint="iterate sorted(...) for a deterministic order")
+
+
+# =====================================================================
+# PTA302: recompile hazard (host functions)
+# =====================================================================
+
+class _RecompilePass:
+    """Data-derived Python values (``.item()``/``.tolist()`` readbacks and
+    arithmetic thereof) flowing into shape/slice positions: every new value
+    is a new signature, so the dispatch compiles per VALUE. Quantization
+    (``//``, ``%``, ``>>`` — the bucketing fix) breaks the taint."""
+
+    def __init__(self, em: _Emitter, fdef):
+        self.em = em
+        self.fdef = fdef
+        self.taint: Set[str] = set()
+
+    def _seed_expr(self, node) -> bool:
+        """An expression that reads array DATA back as a Python value."""
+        if isinstance(node, ast.Call):
+            t = _terminal(node.func)
+            if t in ("item", "tolist"):
+                return True
+            if (isinstance(node.func, ast.Name) and t in ("int", "float")
+                    and node.args):
+                return self._seed_expr(node.args[0]) or self._tainted(node.args[0])
+            if t in ("asarray", "array") and isinstance(node.func, ast.Attribute) \
+                    and _terminal(node.func.value) in ("np", "numpy"):
+                return True  # int(np.asarray(x)) — the readback chain
+        return False
+
+    def _tainted(self, node) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self.taint
+        if isinstance(node, ast.BinOp):
+            if isinstance(node.op, (ast.FloorDiv, ast.Mod, ast.RShift)):
+                return False  # quantized to a bucket: churn bounded
+            return self._tainted(node.left) or self._tainted(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self._tainted(node.operand)
+        if isinstance(node, ast.Call):
+            t = _terminal(node.func)
+            if isinstance(node.func, ast.Name) and t in ("int", "float"):
+                return any(self._tainted(a) or self._seed_expr(a)
+                           for a in node.args)
+            return False  # helper calls assumed to normalize/bucket
+        if isinstance(node, ast.IfExp):
+            return self._tainted(node.body) or self._tainted(node.orelse)
+        return False
+
+    def run(self):
+        for stmt in _ordered_stmts(self.fdef.body):
+            for expr in _exprs_of(stmt):
+                for sub in _walk_no_scopes(expr):
+                    self._check_sinks(sub)
+            if isinstance(stmt, ast.Assign):
+                tainted = self._seed_expr(stmt.value) or self._tainted(stmt.value)
+                for tgt in stmt.targets:
+                    if isinstance(tgt, ast.Name):
+                        if tainted:
+                            self.taint.add(tgt.id)  # noqa: PTA104 (host-side analyzer code)
+                        else:
+                            self.taint.discard(tgt.id)  # noqa: PTA104 (host-side analyzer code)
+            elif isinstance(stmt, ast.AugAssign) and isinstance(stmt.target, ast.Name):
+                if self._seed_expr(stmt.value) or self._tainted(stmt.value):
+                    self.taint.add(stmt.target.id)  # noqa: PTA104 (host-side analyzer code)
+
+    def _check_sinks(self, node):
+        fn = self.fdef.name
+        if (isinstance(node, ast.Subscript) and isinstance(node.ctx, ast.Load)
+                and isinstance(node.slice, ast.Slice)):
+            for bound in (node.slice.lower, node.slice.upper):
+                if bound is not None and (self._tainted(bound)
+                                          or self._seed_expr(bound)):
+                    self.em.emit(
+                        "PTA302", node,
+                        f"data-derived slice bound in {fn!r}: the sliced "
+                        "extent changes per value, so every dispatch "
+                        "compiles a fresh program",
+                        hint="pad/bucket to a fixed set of extents "
+                             "(round up with // bucket * bucket)")
+                    return  # noqa: PTA101 (host-side analyzer code)
+        elif isinstance(node, ast.Call) and _terminal(node.func) in _SHAPE_FNS:
+            candidates = list(node.args)
+            candidates += [kw.value for kw in node.keywords
+                           if kw.arg == "shape"]
+            for arg in candidates:
+                vals = arg.elts if isinstance(arg, (ast.Tuple, ast.List)) else [arg]
+                if any(self._tainted(v) or self._seed_expr(v) for v in vals):
+                    self.em.emit(
+                        "PTA302", node,
+                        f"data-derived value in a shape position "
+                        f"({_terminal(node.func)}) in {fn!r}: a new shape "
+                        "per value means a new XLA compile per dispatch",
+                        hint="bucket the extent to a fixed ladder before it "
+                             "reaches the shape")
+                    return  # noqa: PTA101 (host-side analyzer code)
+
+
+# =====================================================================
+# PTA303: donation-aliasing hazard
+# =====================================================================
+
+class _DonationAliasPass:
+    """A reference into a state tree (``x = self.state[...]``/``state[...]``)
+    taken BEFORE a donating dispatch and used AFTER it: the dispatch donated
+    the underlying buffer, so the held leaf is deleted — the PR-10 bug."""
+
+    def __init__(self, em: _Emitter, fdef):
+        self.em = em
+        self.fdef = fdef
+
+    @staticmethod
+    def _state_subscript(node) -> bool:
+        """RHS reads a leaf out of something called ``state``."""
+        for sub in _walk_no_scopes(node):
+            if isinstance(sub, ast.Subscript):
+                base = sub.value
+                while isinstance(base, ast.Subscript):
+                    base = base.value
+                t = _terminal(base)
+                if t in _STATE_NAMES:
+                    return True  # noqa: PTA101 (host-side analyzer code)
+        return False
+
+    @staticmethod
+    def _is_dispatch(node) -> bool:
+        if not isinstance(node, ast.Call):
+            return False
+        t = _terminal(node.func)
+        return t in _DISPATCH_CALLS
+
+    def run(self):
+        stmts = _ordered_stmts(self.fdef.body)
+        aliases: Dict[str, int] = {}        # name -> line the leaf was taken
+        dispatch_lines: List[int] = []
+        events = []                          # (line, kind, payload)
+        for stmt in stmts:
+            if isinstance(stmt, ast.Assign) and self._state_subscript(stmt.value):
+                for tgt in stmt.targets:
+                    if isinstance(tgt, ast.Name):
+                        events.append((stmt.lineno, "alias", tgt.id))  # noqa: PTA104 (host-side analyzer code)
+            elif isinstance(stmt, ast.Assign):
+                for tgt in stmt.targets:
+                    if isinstance(tgt, ast.Name):
+                        events.append((stmt.lineno, "rebind", tgt.id))  # noqa: PTA104 (host-side analyzer code)
+            for expr in _exprs_of(stmt):
+                for sub in _walk_no_scopes(expr):
+                    if self._is_dispatch(sub):
+                        events.append((sub.lineno, "dispatch", None))  # noqa: PTA104 (host-side analyzer code)
+                    elif isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load):
+                        events.append((sub.lineno, "use", (sub.id, sub)))  # noqa: PTA104 (host-side analyzer code)
+        events.sort(key=lambda e: e[0])
+        flagged: Set[str] = set()
+        for line, kind, payload in events:  # noqa: PTA102 (host-side analyzer code)
+            if kind == "alias":
+                aliases[payload] = line  # noqa: PTA104 (host-side analyzer code)
+            elif kind == "rebind":
+                aliases.pop(payload, None)  # noqa: PTA104 (host-side analyzer code)
+            elif kind == "dispatch":
+                dispatch_lines.append(line)  # noqa: PTA104 (host-side analyzer code)
+            elif kind == "use":
+                name, node = payload
+                taken = aliases.get(name)
+                if taken is None or name in flagged:
+                    continue
+                if any(taken < d < line for d in dispatch_lines):
+                    flagged.add(name)  # noqa: PTA104 (host-side analyzer code)
+                    self.em.emit(
+                        "PTA303", node,
+                        f"state leaf {name!r} (taken at line "
+                        f"{taken + self.em.offset}) used after a donated "
+                        f"dispatch in {self.fdef.name!r}: the dispatch "
+                        "donated its buffer, so this reference is deleted",
+                        hint="re-read the leaf from the post-dispatch state "
+                             "(donation moves, it does not copy)")
+
+
+# =====================================================================
+# PTA305: unbounded host-state growth
+# =====================================================================
+
+class _LedgerGrowthPass:
+    """Per-class: a ``self.<container>`` that GROWS in a method reachable
+    from a serving-tick entry point (step/run/submit/…) and never shrinks
+    anywhere in the class — the per-request ledger leak."""
+
+    def __init__(self, em: _Emitter, cdef: ast.ClassDef):
+        self.em = em
+        self.cdef = cdef
+        self.methods: Dict[str, ast.FunctionDef] = {
+            n.name: n for n in cdef.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+
+    def _reachable_from_ticks(self) -> Set[str]:
+        roots = [n for n in self.methods if n in _TICK_METHODS]
+        seen: Set[str] = set(roots)
+        queue = list(roots)
+        while queue:
+            m = queue.pop()
+            for sub in ast.walk(self.methods[m]):
+                if isinstance(sub, ast.Call):
+                    callee = _self_attr(sub.func)
+                    if callee in self.methods and callee not in seen:
+                        seen.add(callee)  # noqa: PTA104 (host-side analyzer code)
+                        queue.append(callee)  # noqa: PTA104 (host-side analyzer code)
+        return seen
+
+    def _growth_sites(self, fdef):
+        """(attr, node, how) growth sites on self.<attr> in one method."""
+        for sub in ast.walk(fdef):
+            if isinstance(sub, ast.Assign):
+                for tgt in sub.targets:
+                    if isinstance(tgt, ast.Subscript):
+                        attr = _self_attr(tgt.value)
+                        if attr is not None:
+                            yield attr, sub, "setitem"
+            elif isinstance(sub, ast.Call) and isinstance(sub.func, ast.Attribute):
+                if sub.func.attr in _GROW_METHODS:
+                    attr = _self_attr(sub.func.value)
+                    if attr is not None:
+                        yield attr, sub, sub.func.attr
+
+    def _shrink_attrs(self) -> Set[str]:
+        out: Set[str] = set()
+        for name, fdef in self.methods.items():  # noqa: PTA102 (host-side analyzer code)
+            for sub in ast.walk(fdef):
+                if isinstance(sub, ast.Call) and isinstance(sub.func, ast.Attribute):
+                    if sub.func.attr in _SHRINK_METHODS:
+                        attr = _self_attr(sub.func.value)
+                        if attr is not None:
+                            out.add(attr)  # noqa: PTA104 (host-side analyzer code)
+                elif isinstance(sub, ast.Delete):
+                    for tgt in sub.targets:
+                        if isinstance(tgt, ast.Subscript):
+                            attr = _self_attr(tgt.value)
+                            if attr is not None:
+                                out.add(attr)  # noqa: PTA104 (host-side analyzer code)
+                        else:
+                            attr = _self_attr(tgt)
+                            if attr is not None:
+                                out.add(attr)  # noqa: PTA104 (host-side analyzer code)
+                elif isinstance(sub, ast.Assign) and name != "__init__":
+                    for tgt in sub.targets:
+                        attr = _self_attr(tgt)
+                        if attr is not None:
+                            out.add(attr)  # whole-container rebind = reset  # noqa: PTA104 (host-side analyzer code)
+        return out
+
+    def run(self):
+        reachable = self._reachable_from_ticks()
+        if not reachable:
+            return
+        shrinks = self._shrink_attrs()
+        flagged: Set[str] = set()
+        for mname in sorted(reachable):
+            for attr, node, how in self._growth_sites(self.methods[mname]):  # noqa: PTA102 (host-side analyzer code)
+                if attr in shrinks or attr in flagged:
+                    continue
+                flagged.add(attr)  # noqa: PTA104 (host-side analyzer code)
+                self.em.emit(
+                    "PTA305", node,
+                    f"self.{attr} grows ({how}) in "
+                    f"{self.cdef.name}.{mname}() — reachable from a serving "
+                    "tick loop — and never shrinks anywhere in the class: "
+                    "per-request host state leaks for the process lifetime",
+                    hint="GC delivered entries past a keep-last-k bound "
+                         "(see the fleet ledger GC)")
+
+
+# =====================================================================
+# frontends (mirror ast_lint)
+# =====================================================================
+
+def _collect_traced_names(tree) -> Set[str]:
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _terminal(node.func) in _TRACE_CALLS:
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(arg, ast.Name):
+                    names.add(arg.id)  # noqa: PTA104 (host-side analyzer code)
+    return names
+
+
+def _is_traced_def(fdef, traced_names: Set[str]) -> bool:
+    if fdef.name in traced_names:
+        return True
+    for dec in fdef.decorator_list:
+        if isinstance(dec, ast.Call):
+            t = _terminal(dec.func)
+            if t in _TRACED_DECORATORS:
+                return True  # noqa: PTA101 (host-side analyzer code)
+            if t == "partial" and any(
+                    _terminal(a) in _TRACED_DECORATORS for a in dec.args):
+                return True  # noqa: PTA101 (host-side analyzer code)
+        elif _terminal(dec) in _TRACED_DECORATORS:
+            return True  # noqa: PTA101 (host-side analyzer code)
+    return False
+
+
+def _seedish(fdef) -> bool:
+    name = fdef.name.lower()
+    return any(k in name for k in ("seed", "rng", "random"))
+
+
+def check_source(src: str, filename: str = "<source>",
+                 offset: int = 0) -> List[Diagnostic]:
+    """Run every PTA3xx pass over one source blob. ``# noqa`` handling,
+    sorting and the parse-failure code (PTA100) match :func:`.ast_lint.
+    lint_source`."""
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [Diagnostic("PTA100", "error", f"source does not parse: {e.msg}",
+                           file=filename, line=(e.lineno or 0) + offset,
+                           col=e.offset)]
+    em = _Emitter(filename, offset)
+    traced_names = _collect_traced_names(tree)
+
+    def visit(node, in_traced: bool):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                traced = in_traced or _is_traced_def(child, traced_names)
+                if traced:
+                    _TracedBodyPass(em, child).run()
+                elif _seedish(child):
+                    _TracedBodyPass(em, child,
+                                    check_determinism_only=True).run()
+                _RecompilePass(em, child).run()
+                _DonationAliasPass(em, child).run()
+                visit(child, traced)
+            elif isinstance(child, ast.ClassDef):
+                _LedgerGrowthPass(em, child).run()
+                visit(child, in_traced)
+            else:
+                visit(child, in_traced)
+
+    visit(tree, False)
+    diags = em.diags
+    noqa = _noqa_lines(src)
+    if noqa:
+        def suppressed(d: Diagnostic) -> bool:
+            if d.line is None:
+                return False
+            codes = noqa.get(d.line - offset)
+            if codes is None and (d.line - offset) not in noqa:
+                return False
+            return codes is None or d.code in codes
+
+        diags = [d for d in diags if not suppressed(d)]
+    diags.sort(key=lambda d: (d.line or 0, d.col or 0, d.code))
+    return diags
+
+
+def check_file(path: str) -> List[Diagnostic]:
+    with open(path, "r", encoding="utf-8") as f:
+        diags = check_source(f.read(), filename=path)
+    # observability: pre-declared counters + a run-log event per dirty file
+    # (the `observability report` hygiene section aggregates these)
+    from ..observability import runlog as _runlog
+    from ..observability.metrics import counter_inc as _counter_inc
+
+    _counter_inc("hygiene.files_checked")
+    if diags:
+        _counter_inc("hygiene.findings", len(diags))
+        _runlog.emit("hygiene", file=path, findings=len(diags),
+                     codes=sorted({d.code for d in diags}))
+    return diags
+
+
+def check_module(name: str) -> List[Diagnostic]:
+    """Analyze a module by dotted name WITHOUT importing (find_spec only)."""
+    try:
+        spec = importlib.util.find_spec(name)
+    except (ImportError, ValueError):
+        spec = None
+    if spec is None or not spec.origin or not spec.origin.endswith(".py"):
+        raise ValueError(f"cannot locate Python source for module {name!r}")
+    return check_file(spec.origin)
+
+
+def check_path(target: str) -> List[Diagnostic]:
+    """Analyze a .py file, every .py under a directory, or a dotted module."""
+    if os.path.isdir(target):
+        diags: List[Diagnostic] = []
+        for root, _dirs, files in os.walk(target):  # noqa: PTA102 (host-side analyzer code)
+            for f in sorted(files):
+                if f.endswith(".py"):
+                    diags.extend(check_file(os.path.join(root, f)))  # noqa: PTA104 (host-side analyzer code)
+        return diags
+    if os.path.isfile(target) or target.endswith(".py"):
+        return check_file(target)
+    return check_module(target)

@@ -1,0 +1,336 @@
+"""Runtime dispatch sanitizer (``FLAGS_sanitize``) — the dynamic half of
+the PTA3xx dispatch-hygiene family (:mod:`.hygiene` is the static half).
+
+The three bug classes that actually bit this repo live — and that no static
+pass can prove absent — get runtime guards on every hot-path dispatch:
+
+- **implicit host transfers** (:func:`transfer_scope`): the compiled-
+  executable call runs under ``jax.transfer_guard("disallow")``, so a
+  device->host readback (``float(arr)``, ``np.asarray`` on a device array)
+  or an un-staged host->device upload smuggled into the dispatch raises
+  with the offending op named instead of silently serializing the hot
+  path. Intended transfers (feeding a numpy batch, reading results back)
+  stay OUTSIDE the scope — callers make them explicit first.
+- **recompile churn** (:func:`note_compile`): every ``_dispatch`` site
+  records the signatures it compiled per logical callsite; more than
+  ``FLAGS_sanitize_max_recompiles`` distinct signatures raises/warns a
+  structured :class:`RecompileChurnError` naming the diffing aval — the
+  machine-checked form of the few-compiled-programs invariant the tests
+  pin by hand-written counter asserts.
+- **donated-state aliasing** (:func:`check_state` / :func:`poison`):
+  dispatching with a donated-and-deleted state leaf raises a structured
+  :class:`StaleStateError` naming the leaf path *before* XLA's opaque
+  deleted-buffer crash — the PR-10 bug class, extending the Executor's
+  ``StaleHandleError`` story to TrainStep/DecodeEngine donated leaves.
+- **host-ledger growth** (:func:`note_ledger`): the runtime counterpart of
+  the PTA305 static pass — a per-request ledger on a serving tick loop
+  growing past its configured bound warns (raises under strict).
+
+Every trip emits a ``sanitizer`` run-log event and bumps a pre-declared
+``sanitizer.*`` counter; the whole module is a no-op when ``FLAGS_sanitize``
+is off (one dict lookup per dispatch).
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+import warnings
+from typing import Any, Dict, List, Tuple
+
+from ..framework.flags import flag
+from ..observability import runlog as _runlog
+from ..observability.metrics import counter_inc
+
+__all__ = [
+    "enabled", "strict", "RecompileChurnError", "StaleStateError",
+    "LedgerGrowthError", "transfer_scope", "note_compile", "check_state",
+    "poison", "sweep_tensors", "note_ledger", "reset", "stats",
+]
+
+
+def enabled() -> bool:
+    return bool(flag("FLAGS_sanitize"))
+
+
+def strict() -> bool:
+    return bool(flag("FLAGS_sanitize_strict"))
+
+
+# =====================================================================
+# structured errors
+# =====================================================================
+
+class RecompileChurnError(RuntimeError):
+    """One logical dispatch callsite compiled more distinct signatures than
+    ``FLAGS_sanitize_max_recompiles`` — the shape/dtype of some argument is
+    churning per call, so every dispatch pays a fresh XLA compile.
+    ``diff`` names the aval that changed between the last two signatures."""
+
+    def __init__(self, component: str, callsite: str, count: int,
+                 limit: int, diff: str):
+        self.component = component
+        self.callsite = callsite
+        self.count = int(count)
+        self.limit = int(limit)
+        self.diff = diff
+        super().__init__(
+            f"recompile churn at {component}[{callsite}]: {count} distinct "
+            f"signatures compiled (> FLAGS_sanitize_max_recompiles={limit}); "
+            f"{diff}. Pad/bucket the churning argument or lift it out of the "
+            f"traced signature.")
+
+
+class StaleStateError(RuntimeError):
+    """A donated state leaf was reused after its buffer was deleted. The
+    structured pre-flight form of XLA's deleted-buffer crash: ``leaf``
+    names the offending tree path so the aliasing bug is one grep away."""
+
+    def __init__(self, component: str, leaf: str, label: str = ""):
+        self.component = component
+        self.leaf = leaf
+        self.label = label
+        where = f"{component}[{label}]" if label else component
+        super().__init__(
+            f"stale donated state at {where}: leaf {leaf!r} references a "
+            f"deleted (donated) buffer. The dispatch donated this leaf and "
+            f"the live value moved to the returned state — refresh the held "
+            f"reference instead of reusing the donated one.")
+
+
+class LedgerGrowthError(RuntimeError):
+    """A per-request host ledger on a serving tick loop grew past its
+    configured bound — the runtime form of the PTA305 static finding."""
+
+    def __init__(self, component: str, ledger: str, size: int, bound: int):
+        self.component = component
+        self.ledger = ledger
+        self.size = int(size)
+        self.bound = int(bound)
+        super().__init__(
+            f"unbounded host-state growth at {component}.{ledger}: "
+            f"{size} entries > bound {bound}. Delivered requests must be "
+            f"GC'd past keep-last-k or the serving process leaks per-request "
+            f"state forever.")
+
+
+# =====================================================================
+# transfer guard
+# =====================================================================
+
+@contextlib.contextmanager
+def transfer_scope(label: str):
+    """Scope ``jax.transfer_guard("disallow")`` around one hot-path
+    dispatch. Implicit device<->host transfers inside raise (jax names the
+    offending transfer); explicit ``jax.device_put``/``device_get`` stay
+    allowed. No-op when the sanitizer is off or this jax build has no
+    transfer guard."""
+    if not enabled():
+        yield
+        return
+    import jax
+
+    try:
+        guard = jax.transfer_guard("disallow")
+    except Exception:  # older jax: no guard — sanitizer degrades gracefully
+        yield
+        return
+    try:
+        with guard:
+            yield
+    except Exception as exc:
+        if "transfer" in str(exc).lower():
+            counter_inc("sanitizer.host_transfers")
+            _runlog.emit("sanitizer", kind="host_transfer", label=label,
+                         error=f"{type(exc).__name__}: {exc}")
+        raise
+
+
+def explicit_device(tree):
+    """Make the intended host->device upload of ``tree``'s numpy leaves
+    explicit (``jnp.asarray``) so the dispatch itself runs transfer-clean
+    under :func:`transfer_scope`. Device arrays pass through untouched."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    def _put(leaf):
+        if isinstance(leaf, (np.ndarray, np.generic, int, float, bool)):
+            return jnp.asarray(leaf)
+        return leaf
+
+    return jax.tree_util.tree_map(_put, tree)
+
+
+# =====================================================================
+# recompile-churn sentinel
+# =====================================================================
+
+# (component, callsite) -> ordered list of distinct signatures compiled
+_SIGS: Dict[Tuple[str, str], List[Any]] = {}
+_LOCK = threading.Lock()
+
+
+def _describe(v: Any) -> str:
+    if (isinstance(v, tuple) and len(v) == 2 and isinstance(v[0], tuple)
+            and isinstance(v[1], str)):
+        shape, dtype = v
+        return f"{dtype}[{','.join(str(d) for d in shape)}]"
+    return repr(v)
+
+
+def _diff_sigs(prev: Any, cur: Any) -> str:
+    """Name the first aval that differs between two signatures — the
+    churning argument the error message must point at."""
+    pt = prev if isinstance(prev, tuple) else (prev,)
+    ct = cur if isinstance(cur, tuple) else (cur,)
+    for i in range(max(len(pt), len(ct))):
+        a = pt[i] if i < len(pt) else "<absent>"
+        b = ct[i] if i < len(ct) else "<absent>"
+        if a != b:
+            return (f"diffing aval: arg {i} changed "  # noqa: PTA101 (host-side sanitizer code)
+                    f"{_describe(a)} -> {_describe(b)}")
+    return "diffing aval: signature count differs but no leaf diff found"
+
+
+def note_compile(component: str, callsite: str, sig: Any) -> None:
+    """Record one fresh compile at a logical dispatch callsite. Callers
+    invoke this ONLY on a specialization-cache miss; over
+    ``FLAGS_sanitize_max_recompiles`` distinct signatures the sentinel
+    warns (raises under ``FLAGS_sanitize_strict``) with the diffing aval
+    named."""
+    if not enabled():
+        return
+    key = (component, str(callsite))
+    with _LOCK:
+        sigs = _SIGS.setdefault(key, [])
+        if sig in sigs:
+            return
+        sigs.append(sig)
+        count = len(sigs)
+        prev = sigs[-2] if count > 1 else None
+    counter_inc("sanitizer.compiles_seen")
+    limit = int(flag("FLAGS_sanitize_max_recompiles"))
+    if limit <= 0 or count <= limit:
+        return
+    diff = _diff_sigs(prev, sig)
+    err = RecompileChurnError(component, str(callsite), count, limit, diff)
+    counter_inc("sanitizer.recompile_churn")
+    _runlog.emit("sanitizer", kind="recompile_churn", component=component,
+                 callsite=str(callsite), signatures=count, limit=limit,
+                 diff=diff)
+    if strict():
+        raise err
+    warnings.warn(str(err), RuntimeWarning, stacklevel=3)
+
+
+# =====================================================================
+# donated-state poisoning
+# =====================================================================
+
+def check_state(component: str, tree, label: str = "") -> None:
+    """Raise :class:`StaleStateError` naming the leaf path if any leaf of
+    ``tree`` references a deleted (donated) buffer — BEFORE the dispatch
+    hands it to XLA and crashes with an opaque deleted-buffer error."""
+    if not enabled():
+        return
+    import jax
+
+    for path, leaf in jax.tree_util.tree_leaves_with_path(tree):  # noqa: PTA102 (host-side sanitizer code)
+        deleted = getattr(leaf, "is_deleted", None)
+        if deleted is not None and deleted():
+            counter_inc("sanitizer.stale_state")
+            name = jax.tree_util.keystr(path)
+            _runlog.emit("sanitizer", kind="stale_state", component=component,
+                         leaf=name, label=label)
+            raise StaleStateError(component, name, label)
+
+
+class _PoisonedArray:
+    """Replacement for a Tensor ``_value`` whose buffer a dispatch donated:
+    ANY use raises the structured :class:`StaleStateError` instead of an
+    XLA deleted-buffer crash (the same protocol as the Executor's
+    ``_StaleArray``, extended to TrainStep/DecodeEngine donated leaves)."""
+
+    __slots__ = ("_err",)
+
+    def __init__(self, err: StaleStateError):
+        object.__setattr__(self, "_err", err)
+
+    def _raise(self, *a, **k):
+        raise object.__getattribute__(self, "_err")
+
+    def __getattr__(self, name):
+        self._raise()
+
+    __array__ = __repr__ = __len__ = __iter__ = __bool__ = _raise
+    __add__ = __radd__ = __mul__ = __rmul__ = __getitem__ = _raise
+
+
+def poison(component: str, leaf_name: str, label: str = "") -> _PoisonedArray:
+    return _PoisonedArray(StaleStateError(component, leaf_name, label))
+
+
+def sweep_tensors(component: str, named_tensors, label: str = "") -> int:
+    """After a donating dispatch: replace every Tensor ``_value`` that now
+    references a deleted buffer with a poison that raises a structured
+    :class:`StaleStateError` on any use. ``named_tensors`` yields
+    ``(name, tensor)``. Returns the number of leaves poisoned."""
+    if not enabled():
+        return 0
+    n = 0
+    for name, t in named_tensors:  # noqa: PTA102 (host-side sanitizer code)
+        v = getattr(t, "_value", None)
+        if isinstance(v, _PoisonedArray):  # swept on an earlier dispatch
+            continue
+        deleted = getattr(v, "is_deleted", None)
+        if deleted is not None and deleted():
+            t._value = poison(component, name, label)  # noqa: PTA104 (host-side sanitizer code)
+            n += 1
+    if n:
+        counter_inc("sanitizer.leaves_poisoned", n)
+        _runlog.emit("sanitizer", kind="poisoned", component=component,
+                     leaves=n, label=label)
+    return n
+
+
+# =====================================================================
+# host-ledger growth sentinel (runtime PTA305)
+# =====================================================================
+
+_LEDGER_WARNED: set = set()
+
+
+def note_ledger(component: str, ledger: str, size: int, bound: int) -> None:
+    """Runtime PTA305: a per-request host ledger on a serving tick loop
+    exceeding ``bound`` entries warns once per ledger (raises under
+    ``FLAGS_sanitize_strict``). Fleet/scheduler keep-last-k GC keeps
+    bounded ledgers far below this."""
+    if not enabled() or bound <= 0 or size <= bound:
+        return
+    counter_inc("sanitizer.ledger_growth")
+    key = (component, ledger)
+    err = LedgerGrowthError(component, ledger, size, bound)
+    if key not in _LEDGER_WARNED:
+        _LEDGER_WARNED.add(key)  # noqa: PTA104 (host-side sanitizer code)
+        _runlog.emit("sanitizer", kind="ledger_growth", component=component,
+                     ledger=ledger, size=int(size), bound=int(bound))
+    if strict():
+        raise err
+    warnings.warn(str(err), RuntimeWarning, stacklevel=3)
+
+
+# =====================================================================
+# bookkeeping
+# =====================================================================
+
+def reset() -> None:
+    """Drop all per-callsite signature history and ledger warn state
+    (tests; a fresh serving process starts clean by construction)."""
+    with _LOCK:
+        _SIGS.clear()
+    _LEDGER_WARNED.clear()
+
+
+def stats() -> Dict[str, Any]:
+    with _LOCK:
+        return {f"{c}[{s}]": len(v) for (c, s), v in _SIGS.items()}

@@ -79,7 +79,7 @@ class _Interceptor(threading.Thread):
                     for j, o in enumerate(outs):
                         self.outbox.put(pickle.dumps((_DATA, (seq, j), o)))
         except Exception as e:  # surfaced by Carrier.wait
-            self.errors.append((self.node.name, e))
+            self.errors.append((self.node.name, e))  # noqa: PTA305 (fault ledger of a bounded carrier run, drained when the run ends)
             if self.outbox is not None:
                 self.outbox.put(pickle.dumps((_STOP, -1, None)))
 

@@ -87,6 +87,11 @@ def _apply_compile_cache_dir(path):
 
 on_flag_set("FLAGS_compile_cache_dir", _apply_compile_cache_dir)
 
+# Dispatch-hygiene runtime sanitizer (paddle_tpu/analysis/sanitizer.py).
+define_flag("FLAGS_sanitize", False, "runtime dispatch sanitizer: jax.transfer_guard('disallow') scoped around every hot-path dispatch (TrainStep, Executor.run, DecodeEngine — implicit device<->host transfers raise with the offending op named), a recompile-churn sentinel at every _dispatch site (> FLAGS_sanitize_max_recompiles signatures per logical callsite => RecompileChurnError naming the diffing aval), donated-state poisoning (reusing a donated TrainStep/DecodeEngine state leaf raises a structured StaleStateError instead of an XLA deleted-buffer crash), and a host-ledger growth sentinel on the serving-fleet tick")
+define_flag("FLAGS_sanitize_max_recompiles", 8, "recompile-churn threshold: one logical dispatch callsite compiling more than this many distinct signatures trips the sentinel (warn by default, raise under FLAGS_sanitize_strict)")
+define_flag("FLAGS_sanitize_strict", False, "escalate warn-only sanitizer findings (recompile churn, ledger growth) to raises; transfer-guard and stale-state violations always raise")
+
 # Observability spine (paddle_tpu/observability/).
 define_flag("FLAGS_monitor", True, "always-on runtime telemetry: step/compile/checkpoint run-log events, timeline spans and span histograms (spans become no-ops when off)")
 define_flag("FLAGS_run_log_dir", "", "directory for the structured run log (JSONL, one run-<pid>.jsonl per process); empty keeps events only in the in-memory ring")

@@ -116,7 +116,7 @@ class ModelAverage(Optimizer):
         for p in self._params:
             if p.stop_gradient:
                 continue
-            self._sum[id(p)] = self._sum.get(id(p), jnp.zeros_like(p._value)) + p._value
+            self._sum[id(p)] = self._sum.get(id(p), jnp.zeros_like(p._value)) + p._value  # noqa: PTA305 (keyed by param identity — bounded by model size, not request count)
         self._count += 1
         self._step_count += 1
 

@@ -290,11 +290,11 @@ class Predictor:
             with _span("infer.compile"):
                 compiled, info = _introspect.aot_compile(self._jit, tuple(vals))
             entry = compiled if compiled is not None else self._jit
-            self._compiled[sig] = entry
+            self._compiled[sig] = entry  # noqa: PTA305 (compile cache keyed by bucketed signature — bounded by the shape ladder + recompile-churn sentinel)
             counter_inc("infer.compiles")
             info["label"] = label
             info["kind"] = "predictor"
-            self._specializations.append(info)
+            self._specializations.append(info)  # noqa: PTA305 (one entry per compiled signature — bounded by the shape ladder + recompile-churn sentinel)
             _runlog.emit("compile", component="infer", label=label,
                          seconds=info.get("compile_seconds"),
                          flops=info.get("flops"),

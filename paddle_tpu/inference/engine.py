@@ -46,6 +46,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..analysis import sanitizer as _sanitizer
+
 __all__ = ["DecodeEngine", "default_buckets"]
 
 
@@ -366,10 +368,17 @@ class DecodeEngine:
         compile is counted/logged — the TrainStep._dispatch idiom. With
         ``FLAGS_compile_cache_dir`` set, executables round-trip through the
         on-disk AOT cache: a restarted engine loads instead of compiling."""
+        if _sanitizer.enabled():
+            # pre-flight: the decode/prefill programs donate the KV cache
+            # and slot-state buffers — holding one across a dispatch is the
+            # PR-10 aliasing bug; a deleted leaf raises a structured
+            # StaleStateError naming its path instead of crashing in XLA
+            _sanitizer.check_state("decode_engine", args, label=which)
         sig = (which,) + tuple(
             (tuple(l.shape), str(l.dtype)) for l in jax.tree_util.tree_leaves(args))
         entry = self._compiled.get(sig)
         if entry is None:
+            _sanitizer.note_compile("decode_engine", which, sig[1:])
             from ..observability import introspect as _introspect
             from ..observability import runlog as _runlog
             from ..observability import span as _span
@@ -419,12 +428,14 @@ class DecodeEngine:
                              peak_bytes=info.get("peak_bytes"))
         try:
             try:
-                return entry(*args)
+                with _sanitizer.transfer_scope(f"infer.{which}"):
+                    return entry(*args)
             except (TypeError, ValueError):
                 if entry is jitfn:
                     raise
                 self._compiled[sig] = jitfn  # AOT aval drift: jit path forever
-                return jitfn(*args)
+                with _sanitizer.transfer_scope(f"infer.{which}"):
+                    return jitfn(*args)
         except Exception as exc:
             # unhandled dispatch fault (aval drift already fell back above):
             # leave a flight-recorder dump, then let the fault propagate

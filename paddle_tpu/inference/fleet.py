@@ -50,6 +50,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from ..analysis import sanitizer as _sanitizer
 from ..observability import exporter as _exporter
 from ..observability import flightrec as _flightrec
 from ..observability import runlog as _runlog
@@ -136,12 +137,13 @@ class EngineReplica:
     reads (tick count, last tick duration, heartbeat timestamp)."""
 
     def __init__(self, rid: int, model, engine_kwargs: Dict[str, Any],
-                 on_beat=None):
+                 on_beat=None, keep_finished: int = 256):
         from .engine import DecodeEngine
 
         self.rid = int(rid)
         self.engine = DecodeEngine(model, **engine_kwargs)
-        self.scheduler = ContinuousBatchingScheduler(self.engine)
+        self.scheduler = ContinuousBatchingScheduler(self.engine,
+                                                     keep_finished=keep_finished)
         self.alive = True
         self.death_reason: Optional[str] = None
         self.ticks = 0                # scheduler ticks served
@@ -210,14 +212,18 @@ class ServingFleet:
 
     def __init__(self, model, replicas: int = 2, *,
                  max_queue_depth: int = 64, heartbeat_timeout: float = 0.0,
-                 store=None, affinity_load_slack: int = 2, **engine_kwargs):
+                 store=None, affinity_load_slack: int = 2,
+                 keep_finished: int = 256, **engine_kwargs):
         if replicas < 1:
             raise ValueError(f"replicas must be >= 1, got {replicas}")
         if max_queue_depth < 1:
             raise ValueError(f"max_queue_depth must be >= 1, got {max_queue_depth}")
+        if keep_finished < 1:
+            raise ValueError(f"keep_finished must be >= 1, got {keep_finished}")
         self.model = model
         self.engine_kwargs = dict(engine_kwargs)
         self.max_queue_depth = int(max_queue_depth)
+        self.keep_finished = int(keep_finished)
         self.heartbeat_timeout = float(heartbeat_timeout)
         self.router = Router(chunk=engine_kwargs.get("prefill_chunk"),
                              affinity_load_slack=affinity_load_slack)
@@ -227,7 +233,11 @@ class ServingFleet:
 
             self._store = store if isinstance(store, RetryingStore) else RetryingStore(store)  # noqa: PTA104 (host-side serving loop, never traced)
         self.replicas: Dict[int, EngineReplica] = {}
+        # the fleet ledger: delivered (terminal) requests are GC'd past
+        # keep-last-k each tick — in-flight entries are never evicted, so
+        # exactly-once + kill/requeue accounting is untouched
         self.requests: Dict[int, FleetRequest] = {}
+        self.finished_total = 0       # completions ever, across ledger GC
         self._inflight: Dict[int, Dict[int, int]] = {}  # rid -> {local rid: fid}
         self._next_fid = 0
         self._next_rid = 0
@@ -260,7 +270,8 @@ class ServingFleet:
         rid = self._next_rid
         self._next_rid += 1
         rep = EngineReplica(rid, self.model, self.engine_kwargs,
-                            on_beat=self._beat if self._store is not None else None)
+                            on_beat=self._beat if self._store is not None else None,
+                            keep_finished=self.keep_finished)
         self.replicas[rid] = rep
         self._inflight[rid] = {}
         if self._store is not None:
@@ -417,7 +428,30 @@ class ServingFleet:
                 self._on_replica_death(rep, TimeoutError(
                     f"heartbeat lost: tick took {rep.last_tick_seconds:.3f}s "
                     f"> timeout {self.heartbeat_timeout:g}s"))
+        self._gc_ledger(protect={r.fid for r in done})
+        if _sanitizer.enabled():
+            # runtime PTA305: post-GC the ledger is keep-last-k + in-flight;
+            # anything past twice that means the GC stopped working
+            _sanitizer.note_ledger(
+                "fleet", "requests", len(self.requests),
+                bound=2 * self.keep_finished + self.max_queue_depth)
         return done
+
+    _TERMINAL = ("finished", "cancelled", "deadline_exceeded")
+
+    def _gc_ledger(self, protect=()) -> None:
+        """Keep-last-k GC of delivered requests: evict the OLDEST terminal
+        entries past ``keep_finished`` (fids are monotonic, so dict order is
+        submission order). In-flight entries are never touched — requeue and
+        exactly-once delivery read the ledger only for live fids — and THIS
+        tick's completions are protected so :meth:`step`'s return is always
+        harvestable before eviction."""
+        protect = set(protect)
+        terminal = [fid for fid, r in self.requests.items()
+                    if r.status in self._TERMINAL and fid not in protect]
+        overflow = len(terminal) - self.keep_finished
+        for fid in terminal[:max(0, overflow)]:
+            del self.requests[fid]
 
     def _harvest(self, rep: EngineReplica, finished, done: List[FleetRequest]):
         inflight = self._inflight[rep.rid]
@@ -434,6 +468,7 @@ class ServingFleet:
             if r.first_token_ts is not None:
                 freq.first_token_ts = r.first_token_ts  # noqa: PTA104 (host-side serving loop, never traced)
             rep.completed += 1  # noqa: PTA104 (host-side serving loop, never traced)
+            self.finished_total += 1  # noqa: PTA104 (host-side serving loop)
             counter_inc("fleet.requests_completed")
             observe("fleet.latency_seconds", freq.total_seconds)
             _runlog.emit("fleet", kind="finished", component="fleet",
@@ -530,17 +565,23 @@ class ServingFleet:
 
     def run(self, max_ticks: Optional[int] = None) -> Dict[int, FleetRequest]:
         """Drive :meth:`step` until every alive replica drains (or
-        ``max_ticks``); returns ``{fid: FleetRequest}`` for completions."""
+        ``max_ticks``); returns ``{fid: FleetRequest}`` for every completion
+        of the run — accumulated across ticks, so requests the keep-last-k
+        ledger GC has since evicted are still returned."""
+        done = {fid: r for fid, r in self.requests.items()
+                if r.status == "finished"}
         ticks = 0
         while any(rep.scheduler.queue or rep.scheduler.prefilling
                   or rep.scheduler.running
                   for rep in self._alive().values()):
-            self.step()
+            for r in self.step():
+                done[r.fid] = r  # noqa: PTA104 (host-side serving loop)
             ticks += 1
             if max_ticks is not None and ticks >= max_ticks:
                 break
-        return {fid: r for fid, r in self.requests.items()
-                if r.status == "finished"}
+        done.update({fid: r for fid, r in self.requests.items()
+                     if r.status == "finished"})
+        return done
 
     # ------------------------------------------------------------- summary
     def stats(self) -> dict:
@@ -552,6 +593,7 @@ class ServingFleet:
             "requests": len(self.requests),
             "finished": sum(1 for r in self.requests.values()
                             if r.status == "finished"),
+            "finished_total": self.finished_total,
             "requeues": self.requeues,
             "queue_depth": self.queue_depth(),
             "router": self.router.stats(),

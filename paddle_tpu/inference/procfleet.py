@@ -61,6 +61,7 @@ from typing import Any, Dict, List, Optional, Set
 
 import numpy as np
 
+from ..analysis import sanitizer as _sanitizer
 from ..framework.flags import flag
 from ..observability import flightrec as _flightrec
 from ..observability import runlog as _runlog
@@ -81,6 +82,7 @@ _FLAG_FORWARD = (
     "FLAGS_compile_cache_dir", "FLAGS_run_log_dir", "FLAGS_monitor",
     "FLAGS_trace", "FLAGS_flightrec_events", "FLAGS_chaos",
     "FLAGS_chaos_replica_hang_ms", "FLAGS_chaos_replica_slow_ms",
+    "FLAGS_sanitize", "FLAGS_sanitize_strict", "FLAGS_sanitize_max_recompiles",
 )
 
 _TERMINAL = ("finished", "cancelled", "deadline_exceeded")
@@ -356,10 +358,14 @@ class TokenStream:
         self.fleet = fleet
         self.fid = fid
         self.delivered = 0  # tokens yielded so far == the chunk cursor
+        # hold the FleetRequest OBJECT, not a ledger lookup: the object is
+        # stable across requeues, and the keep-last-k ledger GC must never
+        # be able to break a live stream
+        self._freq = fleet.requests[fid]
 
     @property
     def request(self) -> FleetRequest:
-        return self.fleet.requests[self.fid]
+        return self._freq
 
     def __iter__(self):
         while True:
@@ -404,11 +410,13 @@ class ProcServingFleet:
                  ns: Optional[str] = None, boot_timeout: float = 120.0,
                  beat_interval: float = 0.05, poll_s: float = 0.002,
                  affinity_load_slack: int = 2, spawn: bool = True,
-                 **engine_kwargs):
+                 keep_finished: int = 256, **engine_kwargs):
         if replicas < 1:
             raise ValueError(f"replicas must be >= 1, got {replicas}")
         if max_queue_depth < 1:
             raise ValueError(f"max_queue_depth must be >= 1, got {max_queue_depth}")
+        if keep_finished < 1:
+            raise ValueError(f"keep_finished must be >= 1, got {keep_finished}")
         if model_config is None:
             self.model_config: Dict[str, Any] = {}  # noqa: PTA104 (host-side, never traced)
         elif isinstance(model_config, dict):
@@ -445,9 +453,13 @@ class ProcServingFleet:
             ns = f"{os.getpid():x}-{_ns_counter[0]}"
         self.ns = ns
 
+        self.keep_finished = int(keep_finished)
         self.replicas: Dict[int, ProcReplica] = {}
+        # terminal entries are GC'd past keep-last-k each tick (in-flight
+        # never evicted; live TokenStreams hold the request object)
         self.requests: Dict[int, FleetRequest] = {}
         self._chunks: Dict[int, int] = {}       # fid -> chunk seq applied
+        self.finished_total = 0                 # completions ever, across GC
         self._next_fid = 0
         self._next_rid = 0
         self.requeues = 0
@@ -695,7 +707,27 @@ class ProcServingFleet:
                 continue  # noqa: PTA103 (host-side serving loop, never traced)
             self._sweep_beat(rep, done)
         gauge_set("fleet.queue_depth", self.queue_depth())
+        self._gc_ledger(protect={r.fid for r in done})
+        if _sanitizer.enabled():
+            # runtime PTA305: post-GC the ledger is keep-last-k + in-flight
+            _sanitizer.note_ledger(
+                "procfleet", "requests", len(self.requests),
+                bound=2 * self.keep_finished + self.max_queue_depth)
         return done
+
+    def _gc_ledger(self, protect=()) -> None:
+        """Keep-last-k GC of delivered requests (and their chunk cursors):
+        evict the OLDEST terminal entries past ``keep_finished``. In-flight
+        entries are untouched — requeue/exactly-once accounting reads the
+        ledger only for live fids — and this tick's completions are
+        protected so :meth:`step`'s return is harvested before eviction."""
+        protect = set(protect)
+        terminal = [fid for fid, r in self.requests.items()
+                    if r.status in _TERMINAL and fid not in protect]
+        overflow = len(terminal) - self.keep_finished
+        for fid in terminal[:max(0, overflow)]:
+            del self.requests[fid]
+            self._chunks.pop(fid, None)  # noqa: PTA104 (host-side serving loop)
 
     def _sweep_beat(self, rep: ProcReplica, done: List[FleetRequest]) -> None:
         doc = rep.hb.read(timeout=0.02)
@@ -791,6 +823,7 @@ class ProcServingFleet:
         if freq.first_token_ts is None:
             freq.first_token_ts = freq.finished_ts  # noqa: PTA104 (host-side serving loop, never traced)
         rep.completed += 1  # noqa: PTA104 (host-side serving loop, never traced)
+        self.finished_total += 1
         counter_inc("fleet.requests_completed")
         observe("fleet.latency_seconds", freq.total_seconds)
         _runlog.emit("fleet", kind="finished", component="procfleet", id=fid,
@@ -890,19 +923,25 @@ class ProcServingFleet:
             timeout_s: Optional[float] = None) -> Dict[int, FleetRequest]:
         """Drive :meth:`step` until every accepted request reaches a
         terminal status (or ``max_ticks``/``timeout_s``); returns
-        ``{fid: FleetRequest}`` for completions."""
+        ``{fid: FleetRequest}`` for every completion of the run —
+        accumulated across ticks, so requests the keep-last-k ledger GC has
+        since evicted are still returned."""
+        done = {fid: r for fid, r in self.requests.items()
+                if r.status == "finished"}
         ticks = 0
         deadline = None if timeout_s is None else time.monotonic() + timeout_s
         while self._outstanding() and self._alive():
-            self.step()
+            for r in self.step():
+                done[r.fid] = r  # noqa: PTA104 (host-side serving loop)
             ticks += 1
             if max_ticks is not None and ticks >= max_ticks:
                 break
             if deadline is not None and time.monotonic() > deadline:
                 break
             time.sleep(self.poll_s)
-        return {fid: r for fid, r in self.requests.items()
-                if r.status == "finished"}
+        done.update({fid: r for fid, r in self.requests.items()
+                     if r.status == "finished"})
+        return done
 
     # ------------------------------------------------------------ teardown
     def shutdown(self, grace: float = 5.0) -> None:
@@ -951,6 +990,7 @@ class ProcServingFleet:
             "requests": len(self.requests),
             "finished": sum(1 for r in self.requests.values()
                             if r.status == "finished"),
+            "finished_total": self.finished_total,
             "requeues": self.requeues,
             "queue_depth": self.queue_depth(),
             "router": self.router.stats(),

@@ -123,12 +123,20 @@ class ContinuousBatchingScheduler:
     one prefill dispatch per in-flight admission + one decode dispatch) or
     :meth:`run` (until drained)."""
 
-    def __init__(self, engine):
+    def __init__(self, engine, keep_finished: int = 256):
+        if keep_finished < 1:
+            raise ValueError(f"keep_finished must be >= 1, got {keep_finished}")
         self.engine = engine
+        self.keep_finished = int(keep_finished)
         self.queue: deque = deque()
         self.prefilling: Dict[int, Request] = {}  # slot -> mid-prefill request
         self._jobs: Dict[int, object] = {}        # slot -> engine _PrefillJob
         self.running: Dict[int, Request] = {}     # slot -> decoding request
+        # terminal ledgers: delivered requests are GC'd past keep-last-k
+        # (insertion order = completion order) so a long-lived serving loop
+        # doesn't accrete per-request host state forever. In-flight requests
+        # are never evicted — exactly-once delivery happens through the
+        # step() return value before its tick's GC can touch an entry.
         self.finished: Dict[int, Request] = {}    # rid -> request
         self.cancelled: Dict[int, Request] = {}   # rid -> cancelled/expired
         self._next_rid = 0
@@ -240,16 +248,16 @@ class ContinuousBatchingScheduler:
         while self.queue and free:
             r = self.queue.popleft()
             slot = free.pop(0)
-            r.slot = slot
-            r.bucket = self.engine.bucket_for(len(r.prompt))
+            r.slot = slot  # noqa: PTA104 (host-side serving loop)
+            r.bucket = self.engine.bucket_for(len(r.prompt))  # noqa: PTA104 (host-side serving loop)
             r.status = "prefilling"  # noqa: PTA104 (host-side serving loop, never traced)
-            r.admitted_ts = time.perf_counter()
+            r.admitted_ts = time.perf_counter()  # noqa: PTA104 (host-side serving loop)
             job = self.engine.begin_prefill(
                 r.prompt, slot, max_new_tokens=r.max_new_tokens,
                 eos_token_id=r.eos_token_id, seed=r.seed)
-            r.prefix_tokens = job.reused_tokens
-            self.prefilling[slot] = r
-            self._jobs[slot] = job
+            r.prefix_tokens = job.reused_tokens  # noqa: PTA104 (host-side serving loop)
+            self.prefilling[slot] = r  # noqa: PTA104 (host-side serving loop)
+            self._jobs[slot] = job  # noqa: PTA104 (host-side serving loop)
             gauge_set("serving.queue_depth", len(self.queue))
 
     def _prefill_tick(self) -> None:
@@ -269,18 +277,18 @@ class ContinuousBatchingScheduler:
             t0 = time.perf_counter()
             done = self.engine.prefill_step(job)
             dt = time.perf_counter() - t0
-            r.prefill_chunks += 1
+            r.prefill_chunks += 1  # noqa: PTA104 (host-side serving loop)
             if r.trace_id is not None:
                 _trace.span_event("serving.prefill_chunk", trace_id=r.trace_id,
                                   seconds=dt, id=r.rid, slot=slot,
                                   chunk=r.prefill_chunks, done=bool(done))
             if decode_waiting:
-                r.stall_seconds += dt
+                r.stall_seconds += dt  # noqa: PTA104 (host-side serving loop)
                 observe("serving.prefill_stall_seconds", dt)
             if not done:
                 continue
-            r.first_token_ts = time.perf_counter()
-            r.tokens.append(job.first)
+            r.first_token_ts = time.perf_counter()  # noqa: PTA104 (host-side serving loop)
+            r.tokens.append(job.first)  # noqa: PTA104 (host-side serving loop)
             del self.prefilling[slot], self._jobs[slot]
             counter_inc("serving.requests_admitted")
             observe("serving.ttft_seconds", r.ttft_seconds)
@@ -293,7 +301,7 @@ class ContinuousBatchingScheduler:
                          stall_seconds=r.stall_seconds, trace=r.trace_id)
             if job.more:
                 r.status = "running"  # noqa: PTA104 (host-side serving loop, never traced)
-                self.running[slot] = r
+                self.running[slot] = r  # noqa: PTA104 (host-side serving loop)
             else:
                 self._finish(r)
 
@@ -325,6 +333,7 @@ class ContinuousBatchingScheduler:
         at fuse depth D, drained in order). Returns requests finished this
         tick."""
         before = set(self.finished)
+        before_cancelled = set(self.cancelled)
         self._expire_deadlines()
         self._admit()
         self._prefill_tick()
@@ -344,21 +353,46 @@ class ContinuousBatchingScheduler:
             toks = np.atleast_2d(toks)
             emitted = np.atleast_2d(emitted)
             for d in range(toks.shape[0]):
-                for slot, r in self.running.items():
+                for slot, r in self.running.items():  # noqa: PTA102 (host-side serving loop)
                     if emitted[d, slot]:
-                        r.tokens.append(int(toks[d, slot]))
-            for slot, r in list(self.running.items()):
+                        r.tokens.append(int(toks[d, slot]))  # noqa: PTA104 (host-side serving loop)
+            for slot, r in list(self.running.items()):  # noqa: PTA102 (host-side serving loop)
                 if not active[slot]:
                     self._finish(r)
-        return [self.finished[rid] for rid in self.finished if rid not in before]
+        done = [self.finished[rid] for rid in self.finished if rid not in before]
+        fresh = ({rid for rid in self.finished if rid not in before}
+                 | {rid for rid in self.cancelled if rid not in before_cancelled})
+        self._gc_ledgers(protect=fresh)
+        return done
+
+    def _gc_ledgers(self, protect=()) -> None:
+        """Keep-last-k GC of the terminal ledgers: evict the OLDEST entries
+        past ``keep_finished`` (dict insertion order is completion order).
+        ``protect`` holds THIS tick's rids — never evicted, so the caller of
+        :meth:`step` (the fleet's harvest) always sees them, even when a
+        mass deadline expiry terminates more than k requests in one tick."""
+        protect = set(protect)
+        overflow = len(self.finished) - self.keep_finished
+        for rid in [r for r in self.finished
+                    if r not in protect][:max(0, overflow)]:
+            del self.finished[rid]
+        overflow = len(self.cancelled) - self.keep_finished
+        for rid in [r for r in self.cancelled
+                    if r not in protect][:max(0, overflow)]:
+            del self.cancelled[rid]
 
     def run(self, max_steps: Optional[int] = None) -> Dict[int, Request]:
         """Drive :meth:`step` until queue and slots drain (or ``max_steps``
-        ticks); returns ``{rid: Request}`` for everything finished."""
+        ticks); returns ``{rid: Request}`` for everything finished during
+        the run — accumulated across ticks, so completions the keep-last-k
+        ledger GC has since evicted are still returned."""
+        done: Dict[int, Request] = dict(self.finished)
         steps = 0
         while self.queue or self.prefilling or self.running:
-            self.step()
+            for r in self.step():
+                done[r.rid] = r  # noqa: PTA104 (host-side serving loop)
             steps += 1
             if max_steps is not None and steps >= max_steps:
                 break
-        return dict(self.finished)
+        done.update(self.finished)
+        return done

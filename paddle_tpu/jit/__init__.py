@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..analysis import sanitizer as _sanitizer
 from ..framework import random as _random
 from ..framework.autograd import no_grad
 from ..framework.core import Tensor, _wrap_value, unwrap
@@ -345,10 +346,19 @@ class TrainStep:
         only source of cost_analysis/memory_analysis — is retained for the
         run log and :meth:`explain`. Falls back to the plain jitted call
         whenever AOT is unavailable; dispatch never breaks for telemetry."""
+        if _sanitizer.enabled():
+            # pre-flight: a donated-and-deleted state leaf raises a
+            # structured StaleStateError naming the leaf path, instead of
+            # XLA's opaque deleted-buffer crash mid-dispatch; numpy batch
+            # leaves become explicit device uploads so the dispatch itself
+            # runs transfer-clean under the guard below
+            _sanitizer.check_state("train_step", self.state, label=which)
+            batch = _sanitizer.explicit_device(batch)
         sig = (which,) + tuple((tuple(l.shape), str(l.dtype))
                                for l in jax.tree_util.tree_leaves(batch))
         entry = self._compiled.get(sig)
         if entry is None:
+            _sanitizer.note_compile("train_step", which, sig[1:])
             from ..observability import introspect as _introspect
             from ..observability import runlog as _runlog
             from ..observability import span as _span
@@ -390,7 +400,7 @@ class TrainStep:
                     counter_inc("train_step.aot_cache_stores")
             info["label"] = label
             info["kind"] = which
-            self._specializations.append(info)
+            self._specializations.append(info)  # noqa: PTA305 (one entry per compiled signature — bounded by the recompile-churn sentinel under FLAGS_sanitize)
             _runlog.emit("compile", component="train_step", label=label,
                          seconds=info.get("compile_seconds"),
                          cached=bool(info.get("from_disk_cache")),
@@ -399,14 +409,28 @@ class TrainStep:
                          peak_bytes=info.get("peak_bytes"))
         try:
             try:
-                return entry(self.state, batch)
+                with _sanitizer.transfer_scope(f"train_step.{which}"):
+                    out = entry(self.state, batch)
             except (TypeError, ValueError):
                 if entry is jitfn:
                     raise
                 # AOT executables validate avals strictly; on drift fall back to
                 # the jitted path permanently for this signature
                 self._compiled[sig] = jitfn
-                return jitfn(self.state, batch)
+                with _sanitizer.transfer_scope(f"train_step.{which}"):
+                    out = jitfn(self.state, batch)
+            if _sanitizer.enabled():
+                import itertools
+
+                # the dispatch donated the old state; eager model Tensors
+                # still referencing those buffers get poisoned so any later
+                # use raises StaleStateError instead of crashing in XLA
+                _sanitizer.sweep_tensors(
+                    "train_step",
+                    itertools.chain(self.model.named_parameters(),
+                                    self.model.named_buffers()),
+                    label=which)
+            return out
         except Exception as exc:
             # unhandled dispatch fault (aval drift already fell back above):
             # leave a flight-recorder dump, then let the fault propagate

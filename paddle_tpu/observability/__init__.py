@@ -69,7 +69,8 @@ for _name in (
     "checkpoint.saves", "checkpoint.restores",
     "profiler.steps",
 ) + metrics.SERVING_COUNTERS + metrics.FLEET_COUNTERS + metrics.KERNEL_COUNTERS \
-        + metrics.ANALYSIS_COUNTERS + metrics.PLANNER_COUNTERS \
+        + metrics.ANALYSIS_COUNTERS + metrics.HYGIENE_COUNTERS \
+        + metrics.PLANNER_COUNTERS \
         + metrics.RECSYS_COUNTERS + metrics.OBS_COUNTERS:
     metrics.declare_counter(_name)
 del _name

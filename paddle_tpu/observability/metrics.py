@@ -206,6 +206,20 @@ ANALYSIS_COUNTERS: Tuple[str, ...] = (
     "analysis.errors", "analysis.collectives",
 )
 
+# Dispatch-hygiene family (paddle_tpu.analysis.hygiene + sanitizer):
+# hygiene.* counts the static CLI/self-check surface (files walked,
+# PTA3xx findings emitted); sanitizer.* counts the runtime guards behind
+# FLAGS_sanitize — host transfers caught by the transfer guard, distinct
+# signatures seen by the recompile-churn sentinel (and sentinel trips),
+# stale donated-state detections, leaves poisoned after a donating
+# dispatch, and host-ledger growth-sentinel trips.
+HYGIENE_COUNTERS: Tuple[str, ...] = (
+    "hygiene.files_checked", "hygiene.findings",
+    "sanitizer.host_transfers", "sanitizer.compiles_seen",
+    "sanitizer.recompile_churn", "sanitizer.stale_state",
+    "sanitizer.leaves_poisoned", "sanitizer.ledger_growth",
+)
+
 # Auto-parallel planner + checkpoint converter + AOT training-executable
 # cache (distributed/planner.py, distributed/converter.py,
 # introspect.aot_compile cache_scope): evaluations counts candidate

@@ -275,6 +275,7 @@ class Executor:
 
     def run(self, program: Optional[Program] = None, feed: Optional[Dict[str, Any]] = None,
             fetch_list: Optional[List] = None, return_numpy: bool = True):
+        from ..analysis import sanitizer as _sanitizer
         from ..framework.flags import flag as _flag
         from ..observability import span as _span
         from ..profiler import counter_inc
@@ -319,7 +320,7 @@ class Executor:
             if used_feeds is None:  # computed once per program version
                 used_feeds = {n for op in prog.ops for kind, ref in op.inputs
                               if kind == "sym" for n in [ref.name] if n in prog.feeds}
-                self._feed_use[use_key] = used_feeds
+                self._feed_use[use_key] = used_feeds  # noqa: PTA305 (keyed by (program, fetch) — bounded by program count, not request count)
             if missing & used_feeds:
                 raise ValueError(f"missing feeds: {sorted(missing & used_feeds)}")
 
@@ -335,6 +336,14 @@ class Executor:
             plan = self._cache.get(key)
             if plan is None:
                 counter_inc("executor.cache_misses")
+                # recompile-churn sentinel: the callsite is the logical
+                # (program, fetch) pair — feed shapes churning per run at a
+                # fixed callsite is the pay-a-compile-every-step bug
+                _sanitizer.note_compile(
+                    "executor",
+                    f"prog{prog.id}.v{prog.version}"
+                    f"/{','.join(n or '_' for n in fetch_names)}",
+                    feed_sig)
                 if _flag("FLAGS_static_check"):
                     # pre-flight the program once per compiled specialization:
                     # warnings surface through the warnings module, error-severity
@@ -430,10 +439,17 @@ class Executor:
                     raise
         if plan.shard_error is not None:
             raise plan.shard_error
+        if _sanitizer.enabled() and donate:
+            # donated params/opt-state reused after a prior donating run
+            # raise a structured StaleStateError here, not an opaque XLA
+            # deleted-buffer crash mid-dispatch
+            _sanitizer.check_state("executor", (param_vals, state),
+                                   label=plan.label)
         with _span("executor.dispatch"):
             try:
-                fetched, buf_updates, new_params, new_state, finite = (
-                    plan.compiled if plan.compiled is not None else plan.fn)(*run_args)
+                with _sanitizer.transfer_scope(f"executor.{plan.label}"):
+                    fetched, buf_updates, new_params, new_state, finite = (
+                        plan.compiled if plan.compiled is not None else plan.fn)(*run_args)
             except (TypeError, ValueError):
                 if plan.compiled is None:
                     raise
@@ -441,7 +457,8 @@ class Executor:
                 # (weak types, device placement) fall back to the jit path
                 # permanently for this plan
                 plan.compiled = None
-                fetched, buf_updates, new_params, new_state, finite = plan.fn(*run_args)
+                with _sanitizer.transfer_scope(f"executor.{plan.label}"):
+                    fetched, buf_updates, new_params, new_state, finite = plan.fn(*run_args)
         if plan.check and finite:
             # FLAGS_check_nan_inf: the all-finite flags were computed inside
             # the compiled program; this host sync reads len(finite) booleans

@@ -27,7 +27,9 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # package-wide PTA10x finding ceiling for the whole-package self-check
 # (test_self_check_whole_package_ast_lint): the measured count when the
 # check landed. Raising it requires vetting the new findings first.
-PACKAGE_LINT_CEILING = 1100
+# Ratcheted 1100 -> 1030 after the dispatch-hygiene PR annotated the
+# host-side serving/analyzer/report files (measured 1005 + slack).
+PACKAGE_LINT_CEILING = 1030
 
 
 def _codes(diags):
