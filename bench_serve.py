@@ -39,7 +39,15 @@ Prints ONE JSON line on the bench.py schema: {"metric", "value", "unit",
    ``FLAGS_chaos_replica_sigkill_at`` delivering a real ``kill -9`` to one
    replica mid-stream (bitwise exactly-once asserted), streaming
    ``stream_ttft_p50_ms`` (first token chunk across the process boundary),
-   and ``child_compiles`` pinning the warm AOT boot (0 == no recompiles).
+   and ``child_compiles`` pinning the warm AOT boot (0 == no recompiles);
+6. **spec phase** (own ``BENCH_BUDGET_SPEC`` budget, own subprocess): the
+   round-3 raw-speed pair — speculative decoding
+   (``spec_decode_tokens_per_sec`` at the oracle-draft acceptance ceiling
+   and with a genuinely small draft, ``spec_acceptance_rate``,
+   ``decode_dispatches_per_token``; both arms assert bitwise parity with
+   the plain engine) and the int8 KV cache (``kv_bytes_per_slot`` int8 vs
+   f32, the shrink ratio, and ``max_concurrent_slots`` under a notional
+   64 MiB KV budget — the concurrency the quantization buys).
 
 Like bench.py, the process NEVER hangs into the driver's timeout and never
 exits non-zero: the default backend is probed in a throwaway child first and
@@ -542,7 +550,103 @@ def _measure_procfleet():
     }
 
 
+def _measure_spec():
+    """The round-3 raw-speed phase: speculative decoding (oracle self-draft
+    — the acceptance-rate ceiling — plus a genuinely small draft) and the
+    int8 KV cache. Reports ``spec_decode_tokens_per_sec`` vs the plain
+    per-token engine, the measured ``spec_acceptance_rate`` and
+    ``decode_dispatches_per_token`` amortization, and the KV-cache byte
+    story: ``kv_bytes_per_slot`` int8 vs f32, the shrink ratio, and
+    ``max_concurrent_slots`` — how many slots a notional 64 MiB KV budget
+    admits under each representation (the capacity the quantization buys).
+    The spec arms assert bitwise parity with the plain engine in-band."""
+    import jax
+
+    if os.environ.get("BENCH_FORCE_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+
+    import paddle_tpu as paddle
+    from paddle_tpu import profiler
+    from paddle_tpu.inference import DecodeEngine
+    from paddle_tpu.models.gpt import GPTConfig, GPTForPretraining
+
+    d0 = jax.devices()[0]
+    on_tpu = d0.platform in ("tpu", "axon") or "TPU" in getattr(d0, "device_kind", "")
+    if on_tpu:
+        cfg = GPTConfig(vocab_size=50304, hidden_size=1024, num_layers=16,
+                        num_heads=16, max_seq_len=1024)
+        dcfg = GPTConfig(vocab_size=50304, hidden_size=256, num_layers=2,
+                         num_heads=4, max_seq_len=1024)
+        slots, max_seq, decode_tokens, spec_k = 8, 1024, 128, 4
+        buckets = (64,)
+    else:
+        cfg = GPTConfig.tiny()
+        dcfg = GPTConfig(vocab_size=512, hidden_size=32, num_layers=1,
+                         num_heads=2, max_seq_len=128)
+        slots, max_seq, decode_tokens, spec_k = 4, 128, 48, 4
+        buckets = (16,)
+
+    paddle.seed(0)
+    model = GPTForPretraining(cfg)
+    model.eval()
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(0, cfg.vocab_size, (slots, buckets[0] - 2)).astype("int32")
+    kw = dict(max_batch_slots=slots, max_seq_len=max_seq, prefill_buckets=buckets)
+
+    def timed_tps(eng):
+        eng.generate(prompt, max_new_tokens=2)   # compile + warm
+        t0 = time.perf_counter()
+        out = eng.generate(prompt, max_new_tokens=decode_tokens)
+        dt = time.perf_counter() - t0
+        return slots * decode_tokens / dt, out
+
+    plain = DecodeEngine(model, **kw)
+    plain_tps, want = timed_tps(plain)
+
+    # oracle self-draft: acceptance ~1.0 — the amortization ceiling (a real
+    # deployment's distilled draft lands between this and the small-draft arm)
+    profiler.reset_counters("infer.")
+    oracle = DecodeEngine(model, draft=model, spec_k=spec_k, **kw)
+    oracle_tps, got = timed_tps(oracle)
+    assert np.array_equal(got, want), "oracle spec arm diverged from plain engine"
+    c = profiler.counters("infer.")
+    disp_per_tok = (int(c.get("infer.decode_dispatches", 0)) - 1) / max(
+        1, int(c.get("infer.tokens", 0)) - slots)  # minus the warm-up generate
+    oracle_acc = oracle.spec_stats()["acceptance_rate"]
+
+    # small independent draft: real draft-forward cost at its (random-init,
+    # near-zero) acceptance — the throughput floor of the mechanism
+    small = DecodeEngine(model, draft=dcfg, spec_k=spec_k, draft_seed=1, **kw)
+    small_tps, got = timed_tps(small)
+    assert np.array_equal(got, want), "small-draft spec arm diverged from plain engine"
+    small_acc = small.spec_stats()["acceptance_rate"]
+
+    # --- int8 KV cache: per-slot bytes and the capacity they buy ----------
+    i8 = DecodeEngine(model, kv_dtype="int8", **kw)
+    i8.generate(prompt, max_new_tokens=2)
+    f32_slot, i8_slot = plain.kv_bytes_per_slot(), i8.kv_bytes_per_slot()
+    kv_budget = 64 * 1024 * 1024  # notional per-chip KV budget for capacity math
+    return {
+        "spec_k": spec_k,
+        "decode_tokens_per_sec_plain": round(plain_tps, 1),
+        "spec_decode_tokens_per_sec": round(oracle_tps, 1),
+        "spec_decode_tokens_per_sec_small_draft": round(small_tps, 1),
+        "spec_speedup_oracle": round(oracle_tps / plain_tps, 2) if plain_tps else None,
+        "spec_acceptance_rate": round(oracle_acc, 4),
+        "spec_acceptance_rate_small_draft": round(small_acc, 4),
+        "decode_dispatches_per_token": round(disp_per_tok, 4),
+        "kv_bytes_per_slot": i8_slot,
+        "kv_bytes_per_slot_f32": f32_slot,
+        "kv_shrink": round(f32_slot / i8_slot, 2) if i8_slot else None,
+        "max_concurrent_slots": int(kv_budget // i8_slot) if i8_slot else None,
+        "max_concurrent_slots_f32": int(kv_budget // f32_slot) if f32_slot else None,
+    }
+
+
 def main():
+    if os.environ.get("BENCH_ONE") == "spec":
+        print(json.dumps(_measure_spec()))
+        return
     if os.environ.get("BENCH_ONE") == "fleet":
         print(json.dumps(_measure_fleet()))
         return
@@ -558,10 +662,12 @@ def main():
     budget = float(os.environ.get("BENCH_BUDGET_SERVE", 420))
     budget_fleet = float(os.environ.get("BENCH_BUDGET_FLEET", 300))
     budget_procfleet = float(os.environ.get("BENCH_BUDGET_PROCFLEET", 300))
+    budget_spec = float(os.environ.get("BENCH_BUDGET_SPEC", 300))
     verdict = _probe_default_backend(timeout=75.0)
     extras = None
     fleet_info = None
     procfleet_info = None
+    spec_info = None
     error = None
     fallback = None
     if verdict is None:
@@ -569,6 +675,11 @@ def main():
             extras = _measure()
         except Exception as exc:
             error = f"{type(exc).__name__}: {exc}"
+        try:
+            spec_info = _measure_spec()
+        except Exception as exc:
+            spec_info = {"status": "error",
+                         "error": f"{type(exc).__name__}: {exc}"}
         try:
             fleet_info = _measure_fleet()
         except Exception as exc:
@@ -605,6 +716,14 @@ def main():
                 extras = _child(force_cpu=True)
             except Exception as exc:
                 error = fallback or f"{type(exc).__name__}"
+        # spec-decode + int8-KV phase (round 3): own budget, own child
+        try:
+            spec_info = _child(force_cpu=(verdict is not True),
+                               which="spec", timeout=budget_spec)
+        except subprocess.TimeoutExpired:
+            spec_info = {"status": "timeout", "budget_seconds": budget_spec}
+        except Exception as exc:
+            spec_info = {"status": "error", "error": f"{type(exc).__name__}"}
         # fleet phase: own budget, own child, graceful degradation — a
         # timeout or crash leaves a structured status in the JSON, rc 0
         try:
@@ -632,7 +751,7 @@ def main():
                           "unit": "requests/sec", "vs_baseline": None,
                           "requests_per_sec": None, "latency_p50_ms": None,
                           "latency_p99_ms": None, "fleet": fleet_info,
-                          "procfleet": procfleet_info,
+                          "procfleet": procfleet_info, "spec": spec_info,
                           "error": error or "bench_error"}))
         return
 
@@ -667,6 +786,8 @@ def main():
     out = {"metric": "gpt_serving_throughput", "value": extras["value"],
            "unit": "requests/sec", "vs_baseline": round(vs, 4)}
     out.update({k: v for k, v in extras.items() if k not in ("value",)})
+    if spec_info is not None:
+        out["spec"] = spec_info
     if fleet_info is not None:
         out["fleet"] = fleet_info
     if procfleet_info is not None:

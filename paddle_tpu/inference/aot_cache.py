@@ -49,8 +49,9 @@ def cache_dir(scope: str = "serving") -> Optional[Path]:
 
 def make_key(kind: str, sig: Any, fingerprint: str) -> str:
     """Stable content key for one compiled specialization: the program kind
-    (prefill / decode / decode_xD / chunk / ...), the argument avals, the
-    engine's config fingerprint (model dims, sampling config, dtypes — the
+    (prefill / decode / decode_xD / spec_decode / chunk / ...), the argument
+    avals, the engine's config fingerprint (model dims, sampling config,
+    dtypes, kv-cache dtype, and the speculative draft config + spec_k — the
     host scalars baked into the trace), and the jax version + backend the
     executable was built for."""
     import jax

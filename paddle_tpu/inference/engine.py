@@ -130,7 +130,20 @@ class DecodeEngine:
       programs for ALL prompt lengths; long prompts interleave with decode);
     - ``prefix_cache_mb=M`` — prefix KV reuse over chunk-aligned prompt
       prefixes (requires ``prefill_chunk``), LRU-evicted under an M-MiB
-      device-byte budget.
+      device-byte budget;
+    - ``draft=<GPTConfig | dict | model>`` — speculative decoding: a small
+      draft model proposes ``spec_k`` tokens per step and ONE wide target
+      forward verifies them (accept-longest-prefix + bonus token in-graph),
+      so one dispatch emits up to ``spec_k+1`` tokens. Greedy accepted
+      tokens are bitwise-identical to the non-speculative path; a
+      config/dict draft is built from ``draft_seed`` so every replica holds
+      the same weights. Requires ``fuse=1``;
+    - ``kv_dtype="int8"`` — the K/V cache stores int8 payloads with
+      per-head per-row abs_max f32 scale planes (~``4*dh/(dh+4)``x smaller
+      than f32); dequant folds into the attention matmuls and prefix-cache
+      segments stay quantized end-to-end. Decode tokens can differ from the
+      f32 cache within quantization tolerance (the engine family itself
+      stays bitwise-reproducible run to run).
 
     Sampling config (``do_sample``/``temperature``/``top_k``/``top_p``) is
     compiled into the programs; per-request randomness comes from each
@@ -142,8 +155,10 @@ class DecodeEngine:
                  prefill_buckets: Optional[Sequence[int]] = None, do_sample: bool = False,
                  temperature: float = 1.0, top_k: int = 0, top_p: float = 1.0,
                  int8: bool = False, donate: bool = True, fuse: int = 1,
-                 prefill_chunk: Optional[int] = None, prefix_cache_mb: float = 0.0):
-        from ..models.gpt import GPTBlockStack
+                 prefill_chunk: Optional[int] = None, prefix_cache_mb: float = 0.0,
+                 draft=None, spec_k: int = 4, draft_seed: int = 0,
+                 kv_dtype: Optional[str] = None):
+        from ..models.gpt import GPTBlockStack, GPTConfig, _kv_zeros
 
         if not isinstance(model.gpt.layers, GPTBlockStack):
             raise NotImplementedError("DecodeEngine requires the stacked trunk (GPTConfig(stacked=True))")
@@ -166,36 +181,104 @@ class DecodeEngine:
         self._chunk = int(prefill_chunk) if prefill_chunk else None
         if self._chunk is not None and not (1 <= self._chunk <= S):
             raise ValueError(f"prefill_chunk {prefill_chunk} must be in [1, max_seq_len={S}]")
+        self._kv_dtype = None if kv_dtype is None else str(kv_dtype)
+        if self._kv_dtype not in (None, "int8"):
+            raise ValueError(f"kv_dtype must be None or 'int8', got {kv_dtype!r}")
+
+        # --- draft model for speculative decoding ------------------------
+        draft_model = None
+        if draft is not None:
+            if int(spec_k) < 1:
+                raise ValueError(f"spec_k must be >= 1, got {spec_k}")
+            if self.fuse != 1:
+                raise ValueError("draft= requires fuse=1 (a speculative dispatch "
+                                 "already emits up to spec_k+1 tokens)")
+            if isinstance(draft, dict):
+                draft = GPTConfig(**draft)
+            if isinstance(draft, GPTConfig):
+                # build the draft's random init under a pinned RNG stream so
+                # every engine (and every fleet replica) with the same
+                # draft_seed holds bitwise-identical draft weights — fleet
+                # requeue after a replica kill must re-accept the same runs
+                from ..framework import random as _fwrng
+                from ..models.gpt import GPTForPretraining
+
+                state = _fwrng.get_rng_state()
+                _fwrng.seed(int(draft_seed))
+                try:
+                    draft_model = GPTForPretraining(draft)
+                finally:
+                    _fwrng.set_rng_state(state)
+            else:
+                draft_model = draft
+            if not isinstance(draft_model.gpt.layers, GPTBlockStack):
+                raise NotImplementedError("draft model requires the stacked trunk")
+            dcfg = draft_model.gpt.cfg
+            if dcfg.vocab_size != cfg.vocab_size:
+                raise ValueError(f"draft vocab {dcfg.vocab_size} != target vocab {cfg.vocab_size}")
+            if dcfg.max_seq_len < S:
+                raise ValueError(f"draft positional table {dcfg.max_seq_len} < max_seq_len {S}")
+            self.draft_cfg = dcfg  # noqa: PTA104 (host-side serving state)
+        else:
+            self.draft_cfg = None  # noqa: PTA104 (host-side serving state)
+        self.spec_k = int(spec_k) if draft is not None else 0
+        self.draft_seed = int(draft_seed)
+
+        def pack_stack(order, params):
+            # per-layer × per-output-channel abs_max scales on the
+            # [L, in, out]-stacked trunk weight (channel_wise_abs_max
+            # over the stack) — int8 constants land in the compiled
+            # programs, dequant folds into the matmul
+            from .. import quantization as Q
+
+            quant = {"qkv_w", "out_w", "ffn1_w", "ffn2_w"}
+            packed = []
+            for name, w in zip(order, params):
+                if name in quant:
+                    q, s = Q.quant_abs_max(np.asarray(w), channel_axis=(0, 2))
+                    packed.append({"q": jnp.asarray(q), "s": jnp.asarray(s)})
+                else:
+                    packed.append(w)
+            return tuple(packed)
 
         stacked, wte, wpe, fnw, fnb = model._decode_params()
         params, self._idx = stacked
         self._stack_dts = tuple(w.dtype for w in params)  # dequant targets
         if int8:
-            from .. import quantization as Q
-
-            order = model.gpt.layers._order
-            quant = {"qkv_w", "out_w", "ffn1_w", "ffn2_w"}
-            packed = []
-            for name, w in zip(order, params):
-                if name in quant:
-                    # per-layer × per-output-channel abs_max scales on the
-                    # [L, in, out]-stacked trunk weight (channel_wise_abs_max
-                    # over the stack) — int8 constants land in the compiled
-                    # programs, dequant folds into the matmul
-                    q, s = Q.quant_abs_max(np.asarray(w), channel_axis=(0, 2))
-                    packed.append({"q": jnp.asarray(q), "s": jnp.asarray(s)})
-                else:
-                    packed.append(w)
-            params = tuple(packed)
+            params = pack_stack(model.gpt.layers._order, params)
         self._params = {"stack": params, "wte": wte, "wpe": wpe, "fnw": fnw, "fnb": fnb}
+
+        self._dparams = None
+        if draft_model is not None:
+            dstacked, dwte, dwpe, dfnw, dfnb = draft_model._decode_params()
+            dparams, self._didx = dstacked  # noqa: PTA104 (host-side serving state)
+            self._draft_dts = tuple(w.dtype for w in dparams)  # noqa: PTA104 (host-side serving state)
+            if int8:
+                dparams = pack_stack(draft_model.gpt.layers._order, dparams)
+            self._dparams = {"stack": dparams, "wte": dwte, "wpe": dwpe,  # noqa: PTA104 (host-side serving state)
+                             "fnw": dfnw, "fnb": dfnb}
 
         L = cfg.num_layers
         H = cfg.num_heads
         dh = cfg.hidden_size // cfg.num_heads
         dt = wte.dtype
-        self._shape = (L, B, H, S, dh)
-        self._ck = jnp.zeros((L, B, H, S, dh), dt)
-        self._cv = jnp.zeros((L, B, H, S, dh), dt)
+        # the cache carries spec_k slack rows past max_seq_len so the
+        # (spec_k+1)-wide speculative window write near the sequence limit
+        # never clamps back over committed rows; slack rows are never
+        # attendable by an emitted token (q_pos < max_seq_len always)
+        cache_S = S + self.spec_k
+        self._shape = (L, B, H, cache_S, dh)
+        self._ck = _kv_zeros((L, B, H, cache_S, dh), dt, self._kv_dtype)
+        self._cv = _kv_zeros((L, B, H, cache_S, dh), dt, self._kv_dtype)
+        if draft_model is not None:
+            dcfg = self.draft_cfg
+            dL, dH = dcfg.num_layers, dcfg.num_heads
+            ddh = dcfg.hidden_size // dcfg.num_heads
+            # the draft cache is small — keep it in the compute dtype
+            self._dck = jnp.zeros((dL, B, dH, cache_S, ddh), dwte.dtype)  # noqa: PTA104 (host-side serving state)
+            self._dcv = jnp.zeros((dL, B, dH, cache_S, ddh), dwte.dtype)  # noqa: PTA104 (host-side serving state)
+        else:
+            self._dck = self._dcv = None  # noqa: PTA104 (host-side serving state)
         self._pos = jnp.zeros((B,), jnp.int32)
         self._tok = jnp.zeros((B,), jnp.int32)
         self._active = jnp.zeros((B,), bool)
@@ -205,6 +288,8 @@ class DecodeEngine:
         self._eos = np.full((B,), -1, np.int32)
         self._limit = np.zeros((B,), np.int32)
         self._seed = np.zeros((B,), np.int32)
+        self._spec_drafted = 0
+        self._spec_accepted = 0
 
         self.prefix_cache = None
         if prefix_cache_mb and float(prefix_cache_mb) > 0:
@@ -213,33 +298,52 @@ class DecodeEngine:
                                  "entries are chunk-aligned KV segments)")
             from .prefix_cache import PrefixCache
 
-            entry_bytes = 2 * L * H * self._chunk * dh * jnp.dtype(dt).itemsize
+            if self._kv_dtype == "int8":
+                # int8 payload + one f32 scale per (layer, head, row)
+                entry_bytes = 2 * L * H * self._chunk * (dh + 4)
+            else:
+                entry_bytes = 2 * L * H * self._chunk * dh * jnp.dtype(dt).itemsize
             self.prefix_cache = PrefixCache(self._chunk,
                                             int(float(prefix_cache_mb) * (1 << 20)),
                                             entry_bytes)
 
         # host scalars baked into the traced programs — part of the disk
         # cache key so a restarted engine only reuses executables compiled
-        # for the exact same specialization
+        # for the exact same specialization (kv dtype and the draft config
+        # change every traced program, so both fold in)
+        dfp = None
+        if self.draft_cfg is not None:
+            dcfg = self.draft_cfg
+            dfp = (dcfg.vocab_size, dcfg.hidden_size, dcfg.num_layers,
+                   dcfg.num_heads, dcfg.ffn_hidden_size, dcfg.max_seq_len,
+                   self.spec_k)
         self._fingerprint = repr((
             (cfg.vocab_size, cfg.hidden_size, cfg.num_layers, cfg.num_heads,
              cfg.ffn_hidden_size, cfg.max_seq_len),
             self._sample, self.int8, self._donate, S, B, self._chunk,
-            tuple(str(d) for d in self._stack_dts), str(dt)))
+            tuple(str(d) for d in self._stack_dts), str(dt),
+            self._kv_dtype, dfp))
 
         self._build()
         self._fused_jits: Dict[int, Any] = {}
         self._compiled: Dict[tuple, Any] = {}
         self._specializations: List[dict] = []
+        from ..observability.metrics import gauge_set
+        gauge_set("infer.kv_bytes_per_slot", self.kv_bytes_per_slot())
 
     # ------------------------------------------------------------ programs
     def _build(self):
         from ..models.gpt import (
             _cache_forward,
             _chunk_prefill_forward,
+            _filtered_logits,
+            _kv_zeros,
+            _kvc_copy,
+            _kvc_slice,
             _select_token,
             _select_token_rows,
             _slot_decode_forward,
+            _slot_window_forward,
         )
 
         cfg = self.cfg
@@ -249,12 +353,26 @@ class DecodeEngine:
         dh = cfg.hidden_size // num_heads
         do_sample, temperature, top_k, top_p = self._sample
         idx = self._idx
+        kvdt = self._kv_dtype
+        spec_k = self.spec_k
+        has_draft = self._dparams is not None
+        if has_draft:
+            dcfg = self.draft_cfg
+            draft_heads = dcfg.num_heads
+            dL, dH = dcfg.num_layers, dcfg.num_heads
+            ddh = dcfg.hidden_size // dcfg.num_heads
+            didx = self._didx
+            ddts = self._draft_dts
 
         dts = self._stack_dts
 
         def unpack(p):
             return ((tuple(_dequant(e, dt) for e, dt in zip(p["stack"], dts)), idx),
                     p["wte"], p["wpe"], p["fnw"], p["fnb"])
+
+        def unpack_draft(dp):
+            return ((tuple(_dequant(e, dt) for e, dt in zip(dp["stack"], ddts)), didx),
+                    dp["wte"], dp["wpe"], dp["fnw"], dp["fnb"])
 
         def admit_state(pos, tok, active, first, length, slot, eos, limit):
             """Shared tail of every first-token program: the in-graph
@@ -267,29 +385,72 @@ class DecodeEngine:
             active = dus(active, more[None], (slot,))
             return pos, tok, active, more
 
-        def prefill_fn(p, ck, cv, pos, tok, active, ids, length, slot, eos, limit, seed):
+        def prefill_core(p, ck, cv, pos, tok, active, ids, length, slot, eos, limit, seed):
             stacked, wte, wpe, fnw, fnb = unpack(p)
             P = ids.shape[1]
-            sk = jnp.zeros((L, 1, H, P, dh), wte.dtype)
-            sv = jnp.zeros((L, 1, H, P, dh), wte.dtype)
+            # the bucketed scratch carries the SAME representation as the big
+            # cache (int8 pack under kv_dtype), so bucketed prefill attends
+            # exactly the rows a chunked prefill would — the bitwise basis
+            # of the bucketed-vs-chunked parity pin survives quantization
+            sk = _kv_zeros((L, 1, H, P, dh), wte.dtype, kvdt)
+            sv = _kv_zeros((L, 1, H, P, dh), wte.dtype, kvdt)
             logits, sk, sv = _cache_forward(stacked, wte, wpe, fnw, fnb, ids, sk, sv,
                                             jnp.int32(0), num_heads=num_heads)
-            ck = jax.lax.dynamic_update_slice(ck, sk, (0, slot, 0, 0, 0))
-            cv = jax.lax.dynamic_update_slice(cv, sv, (0, slot, 0, 0, 0))
+            ck = _kvc_copy(ck, sk, (0, slot, 0, 0, 0))
+            cv = _kvc_copy(cv, sv, (0, slot, 0, 0, 0))
             last = jax.lax.dynamic_slice(logits, (0, length - 1, 0), (1, 1, logits.shape[2]))[:, 0]
             key = jax.random.fold_in(jax.random.key(seed), length - 1)
             first = _select_token(last.astype(jnp.float32), key, do_sample, temperature, top_k, top_p)[0]
             pos, tok, active, more = admit_state(pos, tok, active, first, length, slot, eos, limit)
             return ck, cv, pos, tok, active, first, more
 
-        def chunk_fn(p, ck, cv, ids, slot, start):
+        def draft_prefill(dp, dck, dcv, ids, slot):
+            # draft prefill rides the SAME dispatch as the target prefill
+            # (one donated program, two trunks; XLA dead-code-eliminates the
+            # draft logits) so admission cost stays one dispatch per bucket
+            dstacked, dwte, dwpe, dfnw, dfnb = unpack_draft(dp)
+            P = ids.shape[1]
+            dsk = jnp.zeros((dL, 1, dH, P, ddh), dwte.dtype)
+            dsv = jnp.zeros((dL, 1, dH, P, ddh), dwte.dtype)
+            _, dsk, dsv = _cache_forward(dstacked, dwte, dwpe, dfnw, dfnb, ids, dsk, dsv,
+                                         jnp.int32(0), num_heads=draft_heads)
+            dck = jax.lax.dynamic_update_slice(dck, dsk, (0, slot, 0, 0, 0))
+            dcv = jax.lax.dynamic_update_slice(dcv, dsv, (0, slot, 0, 0, 0))
+            return dck, dcv
+
+        def draft_chunk(dp, dck, dcv, ids, slot, start):
+            dstacked, dwte, dwpe, dfnw, dfnb = unpack_draft(dp)
+            _, dck, dcv = _chunk_prefill_forward(dstacked, dwte, dwpe, dfnw, dfnb, ids,
+                                                 dck, dcv, slot, start,
+                                                 num_heads=draft_heads)
+            return dck, dcv
+
+        if has_draft:
+            def prefill_fn(p, dp, ck, cv, dck, dcv, pos, tok, active, ids, length,
+                           slot, eos, limit, seed):
+                ck, cv, pos, tok, active, first, more = prefill_core(
+                    p, ck, cv, pos, tok, active, ids, length, slot, eos, limit, seed)
+                dck, dcv = draft_prefill(dp, dck, dcv, ids, slot)
+                return ck, cv, dck, dcv, pos, tok, active, first, more
+        else:
+            prefill_fn = prefill_core
+
+        def chunk_core(p, ck, cv, ids, slot, start):
             stacked, wte, wpe, fnw, fnb = unpack(p)
             _, ck, cv = _chunk_prefill_forward(stacked, wte, wpe, fnw, fnb, ids, ck, cv,
                                                slot, start, num_heads=num_heads)
             return ck, cv
 
-        def chunk_final_fn(p, ck, cv, pos, tok, active, ids, slot, start, last_row,
-                           length, eos, limit, seed):
+        if has_draft:
+            def chunk_fn(p, dp, ck, cv, dck, dcv, ids, slot, start):
+                ck, cv = chunk_core(p, ck, cv, ids, slot, start)
+                dck, dcv = draft_chunk(dp, dck, dcv, ids, slot, start)
+                return ck, cv, dck, dcv
+        else:
+            chunk_fn = chunk_core
+
+        def chunk_final_core(p, ck, cv, pos, tok, active, ids, slot, start, last_row,
+                             length, eos, limit, seed):
             stacked, wte, wpe, fnw, fnb = unpack(p)
             logits, ck, cv = _chunk_prefill_forward(stacked, wte, wpe, fnw, fnb, ids, ck, cv,
                                                     slot, start, num_heads=num_heads,
@@ -299,21 +460,135 @@ class DecodeEngine:
             pos, tok, active, more = admit_state(pos, tok, active, first, length, slot, eos, limit)
             return ck, cv, pos, tok, active, first, more
 
+        if has_draft:
+            def chunk_final_fn(p, dp, ck, cv, dck, dcv, pos, tok, active, ids, slot,
+                               start, last_row, length, eos, limit, seed):
+                ck, cv, pos, tok, active, first, more = chunk_final_core(
+                    p, ck, cv, pos, tok, active, ids, slot, start, last_row,
+                    length, eos, limit, seed)
+                dck, dcv = draft_chunk(dp, dck, dcv, ids, slot, start)
+                return ck, cv, dck, dcv, pos, tok, active, first, more
+        else:
+            chunk_final_fn = chunk_final_core
+
         def insert_fn(ck, cv, seg_k, seg_v, slot, start):
             # prefix-cache hit: copy a cached chunk's KV into the slot's
             # lanes — the whole "prefill" of the shared portion is this one
-            # dynamic_update_slice program
-            ck = jax.lax.dynamic_update_slice(ck, seg_k, (0, slot, 0, start, 0))
-            cv = jax.lax.dynamic_update_slice(cv, seg_v, (0, slot, 0, start, 0))
+            # dynamic_update_slice program. Under kv_dtype the segment is the
+            # stored int8 pack and both planes copy verbatim: a cache hit
+            # never round-trips through f32 in HBM.
+            ck = _kvc_copy(ck, seg_k, (0, slot, 0, start, 0))
+            cv = _kvc_copy(cv, seg_v, (0, slot, 0, start, 0))
             return ck, cv
 
         chunk = self._chunk
 
         def extract_fn(ck, cv, slot, start):
             size = (L, 1, H, chunk if chunk else 1, dh)
-            seg_k = jax.lax.dynamic_slice(ck, (0, slot, 0, start, 0), size)
-            seg_v = jax.lax.dynamic_slice(cv, (0, slot, 0, start, 0), size)
+            seg_k = _kvc_slice(ck, (0, slot, 0, start, 0), size)
+            seg_v = _kvc_slice(cv, (0, slot, 0, start, 0), size)
             return seg_k, seg_v
+
+        def spec_fn(p, dp, ck, cv, dck, dcv, pos, tok, active, eos_v, limit_v, seed_v):
+            """ONE speculative dispatch: spec_k+1 chained draft forwards on
+            the draft cache propose a window, ONE (spec_k+1)-wide target
+            forward verifies it, and the accept-longest-prefix + bonus-token
+            ledger runs in-graph. Rejected-tail KV is left stale past the
+            rolled-back position — harmless under write-before-attend (the
+            next window overwrites those rows before any emitted row can
+            attend them)."""
+            stacked, wte, wpe, fnw, fnb = unpack(p)
+            dstacked, dwte, dwpe, dfnw, dfnb = unpack_draft(dp)
+            K = spec_k
+            # --- draft scan: iteration i consumes the token at pos+i and
+            # writes its draft KV there; iterations 0..K-1 yield proposals
+            # d_1..d_K, iteration K only backfills the last proposal's KV so
+            # the all-accepted case leaves no draft-cache hole
+            props, dfilt = [], []
+            dtok = tok
+            for i in range(K + 1):  # noqa: PTA104 (static unroll, host loop bound)
+                dpos = pos + jnp.int32(i)
+                dlogits, dck, dcv = _slot_decode_forward(
+                    dstacked, dwte, dwpe, dfnw, dfnb, dtok, dck, dcv, dpos,
+                    num_heads=draft_heads, active=active)
+                if i < K:
+                    if do_sample:
+                        fl = _filtered_logits(dlogits.astype(jnp.float32),
+                                              temperature, top_k, top_p)
+                        dkeys = jax.vmap(lambda s, q: jax.random.fold_in(
+                            jax.random.fold_in(jax.random.key(s), q), 3))(seed_v, dpos)
+                        nd = jax.vmap(jax.random.categorical)(dkeys, fl).astype(jnp.int32)
+                        dfilt.append(fl)  # noqa: PTA104 (host-side serving state)
+                    else:
+                        nd = jnp.argmax(dlogits.astype(jnp.float32), axis=-1).astype(jnp.int32)
+                    nd = jnp.where(active, nd, dtok)  # free slots hold
+                    props.append(nd)  # noqa: PTA104 (host-side serving state)
+                    dtok = nd
+            # --- target verification: one (K+1)-wide window forward over
+            # [tok, d_1..d_K] at per-slot positions pos..pos+K
+            ids = jnp.stack([tok] + props, axis=1)
+            vlogits, ck, cv = _slot_window_forward(
+                stacked, wte, wpe, fnw, fnb, ids, ck, cv, pos,
+                num_heads=num_heads, active=active)
+            # --- per-row outcome: row j scores the token at position
+            # pos+j+1. Greedy: argmax + equality accept (bitwise = sequential
+            # decode, since per-row width-W math equals the s=1 math).
+            # Sampled: residual resampling over the SAME filtered
+            # distribution _select_token samples from.
+            outs, accs = [], []
+            for j in range(K + 1):  # noqa: PTA104 (static unroll, host loop bound)
+                lg = vlogits[:, j].astype(jnp.float32)
+                if not do_sample:
+                    sel = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+                    outs.append(sel)  # noqa: PTA104 (host-side serving state)
+                    if j < K:
+                        accs.append(sel == props[j])  # noqa: PTA104 (host-side serving state)
+                    continue
+                flp = _filtered_logits(lg, temperature, top_k, top_p)
+                kj = jax.vmap(lambda s, q: jax.random.fold_in(jax.random.key(s), q))(
+                    seed_v, pos + jnp.int32(j))
+                if j < K:
+                    P_ = jax.nn.softmax(flp, axis=-1)
+                    Q_ = jax.nn.softmax(dfilt[j], axis=-1)
+                    d = props[j]
+                    pd = jnp.take_along_axis(P_, d[:, None], axis=-1)[:, 0]
+                    qd = jnp.take_along_axis(Q_, d[:, None], axis=-1)[:, 0]
+                    u = jax.vmap(lambda k: jax.random.uniform(jax.random.fold_in(k, 1)))(kj)
+                    acc = u * qd <= pd
+                    res = jnp.maximum(P_ - Q_, 0.0)
+                    has = jnp.sum(res, axis=-1, keepdims=True) > 0
+                    rlog = jnp.where(res > 0, jnp.log(jnp.where(res > 0, res, 1.0)), -jnp.inf)
+                    rlog = jnp.where(has, rlog, flp)  # P==Q residual: fall back to target
+                    corr = jax.vmap(lambda k, lg2: jax.random.categorical(
+                        jax.random.fold_in(k, 2), lg2))(kj, rlog).astype(jnp.int32)
+                    outs.append(jnp.where(acc, d, corr))  # noqa: PTA104 (host-side serving state)
+                    accs.append(acc)  # noqa: PTA104 (host-side serving state)
+                else:
+                    # bonus row: a direct draw from the target distribution
+                    # with the position's own key — the all-accepted case
+                    # samples exactly what sequential decode would
+                    bonus = jax.vmap(jax.random.categorical)(kj, flp).astype(jnp.int32)
+                    outs.append(bonus)  # noqa: PTA104 (host-side serving state)
+            # --- emission ledger: accept-longest-prefix, eos/limit stops
+            # mid-window, rejected tail rolls the slot position back simply
+            # by not advancing it
+            win = jnp.ones_like(active)
+            act_s, pos_s, tok_s = active, pos, tok
+            toks_rows, emit_rows = [], []
+            for j in range(K + 1):  # noqa: PTA104 (static unroll, host loop bound)
+                emit = act_s & win
+                row = jnp.where(emit, outs[j], tok_s)
+                tok_s = row
+                pos_s = pos_s + emit.astype(jnp.int32)
+                hit_eos = (eos_v >= 0) & (row == eos_v)
+                live = ~hit_eos & (pos_s + 1 < limit_v)
+                act_s = jnp.where(emit, act_s & live, act_s)
+                toks_rows.append(row)  # noqa: PTA104 (host-side serving state)
+                emit_rows.append(emit)  # noqa: PTA104 (host-side serving state)
+                if j < K:
+                    win = win & accs[j]
+            return (ck, cv, dck, dcv, pos_s, tok_s, act_s,
+                    jnp.stack(toks_rows), jnp.stack(emit_rows))
 
         def decode_body(consts, carry, _x):
             # ONE decode iteration — the scan body of the fused program and
@@ -341,10 +616,20 @@ class DecodeEngine:
                                      (ck, cv, pos, tok, active), None)
             return carry
 
-        donate = (1, 2, 3, 4, 5) if self._donate else ()
-        donate_cache = (1, 2) if self._donate else ()
+        if has_draft:
+            # state args shift by one (draft params at arg 1) and both cache
+            # pairs donate; the draft weights thread through like the target's
+            donate = (2, 3, 4, 5, 6, 7, 8) if self._donate else ()
+            donate_cache = (2, 3, 4, 5) if self._donate else ()
+            self._spec_jit = jax.jit(spec_fn, donate_argnums=donate)  # noqa: PTA104 (host-side serving state)
+            self._draft_chunk_jit = jax.jit(  # noqa: PTA104 (host-side serving state)
+                draft_chunk, donate_argnums=(1, 2) if self._donate else ())
+        else:
+            donate = (1, 2, 3, 4, 5) if self._donate else ()
+            donate_cache = (1, 2) if self._donate else ()
+            self._spec_jit = self._draft_chunk_jit = None  # noqa: PTA104 (host-side serving state)
         self._prefill_jit = jax.jit(prefill_fn, donate_argnums=donate)
-        self._decode_jit = jax.jit(decode_fn, donate_argnums=donate)
+        self._decode_jit = jax.jit(decode_fn, donate_argnums=(1, 2, 3, 4, 5) if self._donate else ())
         self._chunk_jit = jax.jit(chunk_fn, donate_argnums=donate_cache)
         self._chunk_final_jit = jax.jit(chunk_final_fn, donate_argnums=donate)
         self._insert_jit = jax.jit(insert_fn, donate_argnums=(0, 1) if self._donate else ())
@@ -499,6 +784,17 @@ class DecodeEngine:
                     (self._ck, self._cv, seg_k, seg_v, jnp.int32(slot),
                      jnp.int32(i * self._chunk)))
                 counter_inc("infer.prefix_insert_dispatches")
+            if matched and self._dparams is not None:
+                # the prefix cache holds TARGET KV only; backfill the draft
+                # cache for the matched prefix with cheap draft-only chunk
+                # forwards (ascending — each chunk attends the earlier ones)
+                for i in range(len(matched)):
+                    ids = prompt[i * self._chunk:(i + 1) * self._chunk][None]
+                    self._dck, self._dcv = self._dispatch(  # noqa: PTA104 (host-side serving state)
+                        "draft_chunk", self._draft_chunk_jit,
+                        (self._dparams, self._dck, self._dcv, jnp.asarray(ids),
+                         jnp.int32(slot), jnp.int32(i * self._chunk)),
+                        label=f"draft_chunk/C{self._chunk}")
             job.next_pos = job.reused_tokens = len(matched) * self._chunk
             counter_inc("serving.prefix_hits" if matched else "serving.prefix_misses")
             counter_inc("serving.prefix_tokens_reused", job.reused_tokens)
@@ -517,30 +813,44 @@ class DecodeEngine:
         if job.done:
             return True
         n, slot = job.n, job.slot
+        spec = self._dparams is not None
         if self._chunk is None:
             P = self.bucket_for(n)
             ids = np.zeros((1, P), np.int32)
             ids[0, :n] = job.prompt
+            state = ((self._params, self._dparams, self._ck, self._cv, self._dck, self._dcv)
+                     if spec else (self._params, self._ck, self._cv))
             with _span("infer.prefill"):
                 out = self._dispatch(
                     "prefill", self._prefill_jit,
-                    (self._params, self._ck, self._cv, self._pos, self._tok, self._active,
-                     jnp.asarray(ids), jnp.int32(n), jnp.int32(slot), jnp.int32(job.eos),
-                     jnp.int32(job.limit), jnp.int32(job.seed)),
+                    state + (self._pos, self._tok, self._active,
+                             jnp.asarray(ids), jnp.int32(n), jnp.int32(slot), jnp.int32(job.eos),
+                             jnp.int32(job.limit), jnp.int32(job.seed)),
                     label=f"prefill/P{P}")
-            self._ck, self._cv, self._pos, self._tok, self._active, first, more = out
+            if spec:
+                self._ck, self._cv, self._dck, self._dcv = out[:4]  # noqa: PTA104 (host-side serving state)
+                out = out[4:]
+            else:
+                self._ck, self._cv = out[:2]  # noqa: PTA104 (host-side serving state)
+                out = out[2:]
+            self._pos, self._tok, self._active, first, more = out  # noqa: PTA104 (host-side serving state)
             job.next_pos = n
         else:
             C = self._chunk
             if job.next_pos + C < n:
                 # intermediate chunk: KV writes only, no logits work
                 ids = job.prompt[job.next_pos:job.next_pos + C][None]
+                state = ((self._params, self._dparams, self._ck, self._cv, self._dck, self._dcv)
+                         if spec else (self._params, self._ck, self._cv))
                 with _span("infer.prefill_chunk"):
-                    self._ck, self._cv = self._dispatch(
+                    out = self._dispatch(
                         "prefill_chunk", self._chunk_jit,
-                        (self._params, self._ck, self._cv, jnp.asarray(ids),
-                         jnp.int32(slot), jnp.int32(job.next_pos)),
+                        state + (jnp.asarray(ids), jnp.int32(slot), jnp.int32(job.next_pos)),
                         label=f"prefill_chunk/C{C}")
+                if spec:
+                    self._ck, self._cv, self._dck, self._dcv = out  # noqa: PTA104 (host-side serving state)
+                else:
+                    self._ck, self._cv = out  # noqa: PTA104 (host-side serving state)
                 job.next_pos += C
                 counter_inc("infer.prefill_chunk_dispatches")
                 return False
@@ -551,15 +861,23 @@ class DecodeEngine:
             w = job.next_pos if job.next_pos + C <= self.max_seq_len else n - C
             ids = np.zeros((1, C), np.int32)
             ids[0, :n - w] = job.prompt[w:n]
+            state = ((self._params, self._dparams, self._ck, self._cv, self._dck, self._dcv)
+                     if spec else (self._params, self._ck, self._cv))
             with _span("infer.prefill_chunk"):
                 out = self._dispatch(
                     "prefill_final", self._chunk_final_jit,
-                    (self._params, self._ck, self._cv, self._pos, self._tok, self._active,
-                     jnp.asarray(ids), jnp.int32(slot), jnp.int32(w),
-                     jnp.int32(n - 1 - w), jnp.int32(n), jnp.int32(job.eos),
-                     jnp.int32(job.limit), jnp.int32(job.seed)),
+                    state + (self._pos, self._tok, self._active,
+                             jnp.asarray(ids), jnp.int32(slot), jnp.int32(w),
+                             jnp.int32(n - 1 - w), jnp.int32(n), jnp.int32(job.eos),
+                             jnp.int32(job.limit), jnp.int32(job.seed)),
                     label=f"prefill_final/C{C}")
-            self._ck, self._cv, self._pos, self._tok, self._active, first, more = out
+            if spec:
+                self._ck, self._cv, self._dck, self._dcv = out[:4]  # noqa: PTA104 (host-side serving state)
+                out = out[4:]
+            else:
+                self._ck, self._cv = out[:2]  # noqa: PTA104 (host-side serving state)
+                out = out[2:]
+            self._pos, self._tok, self._active, first, more = out  # noqa: PTA104 (host-side serving state)
             job.next_pos = n
             counter_inc("infer.prefill_chunk_dispatches")
         job.first = int(first)
@@ -618,6 +936,37 @@ class DecodeEngine:
         depth = self.fuse if fuse is None else int(fuse)
         if depth < 1:
             raise ValueError(f"fuse depth must be >= 1, got {depth}")
+        if self._dparams is not None:
+            if depth != 1:
+                raise ValueError("speculative decode runs at fuse depth 1 (one "
+                                 "dispatch already emits up to spec_k+1 tokens)")
+            from ..observability.metrics import gauge_set
+
+            with _span("infer.spec_decode"):
+                out = self._dispatch(
+                    "spec_decode", self._spec_jit,
+                    (self._params, self._dparams, self._ck, self._cv, self._dck, self._dcv,
+                     self._pos, self._tok, self._active,
+                     jnp.asarray(self._eos), jnp.asarray(self._limit), jnp.asarray(self._seed)),
+                    label=f"spec_decode/K{self.spec_k}")
+            (self._ck, self._cv, self._dck, self._dcv,  # noqa: PTA104 (host-side serving state)
+             self._pos, self._tok, self._active, toks, emitted) = out  # noqa: PTA104 (host-side serving state)
+            toks = np.asarray(toks)
+            emitted = np.asarray(emitted)
+            self._active_np = np.array(self._active)  # noqa: PTA104 (host-side serving state)
+            n_active = int(emitted[0].sum())   # row 0 always emits per live slot
+            n_emitted = int(emitted.sum())
+            self._spec_drafted += self.spec_k * n_active  # noqa: PTA104 (host-side serving state)
+            self._spec_accepted += n_emitted - n_active  # noqa: PTA104 (host-side serving state)
+            counter_inc("infer.decode_dispatches")
+            counter_inc("infer.tokens", n_emitted)
+            counter_inc("infer.spec_draft_tokens", self.spec_k * n_active)
+            counter_inc("infer.spec_accepted_tokens", n_emitted - n_active)
+            if self._spec_drafted:
+                gauge_set("serving.spec_acceptance_rate",
+                          self._spec_accepted / self._spec_drafted)
+            observe("infer.tokens_per_decode_dispatch", float(n_emitted))
+            return toks, emitted, self._active_np.copy()
         if depth == 1:
             emitted = self._active_np.copy()
             with _span("infer.decode_step"):
@@ -730,5 +1079,31 @@ class DecodeEngine:
         return rows
 
     def cache_bytes(self) -> int:
-        """Device bytes held by the preallocated K/V cache."""
-        return 2 * int(np.prod(self._shape)) * self._ck.dtype.itemsize
+        """Device bytes held by the preallocated target K/V cache, summed
+        over the ACTUAL stored leaves — under ``kv_dtype="int8"`` that is
+        the int8 payload plus the f32 scale planes, not the compute dtype."""
+        leaves = jax.tree_util.tree_leaves((self._ck, self._cv))
+        return int(sum(l.size * jnp.dtype(l.dtype).itemsize for l in leaves))
+
+    def draft_cache_bytes(self) -> int:
+        """Device bytes held by the draft model's K/V cache (0 without a
+        draft)."""
+        if self._dck is None:
+            return 0
+        leaves = jax.tree_util.tree_leaves((self._dck, self._dcv))
+        return int(sum(l.size * jnp.dtype(l.dtype).itemsize for l in leaves))
+
+    def kv_bytes_per_slot(self) -> int:
+        """Per-request HBM cost of admission: the target cache's stored
+        bytes divided by the slot count (the ``infer.kv_bytes_per_slot``
+        gauge — sizing concurrent-slot capacity from this number stays
+        honest under int8 KV)."""
+        return self.cache_bytes() // self.max_batch_slots
+
+    def spec_stats(self) -> dict:
+        """Cumulative speculative-decoding counters: proposals drafted,
+        proposals accepted, and their ratio (0.0 before any decode)."""
+        drafted = self._spec_drafted
+        return {"spec_k": self.spec_k, "drafted": drafted,
+                "accepted": self._spec_accepted,
+                "acceptance_rate": (self._spec_accepted / drafted) if drafted else 0.0}

@@ -186,7 +186,10 @@ class ServingFleet:
     ``model`` and every ``engine_kwargs`` knob are shared by all replicas
     (identical engine fingerprints — so one warm ``FLAGS_compile_cache_dir``
     serves the whole fleet's program family, and a scale-out replica boots
-    at ``infer.compiles == 0``). ``max_queue_depth`` bounds the TOTAL queued
+    at ``infer.compiles == 0``). That pass-through covers the round-3 speed
+    knobs too: ``draft=``/``spec_k=`` (each replica builds the same draft
+    weights from ``draft_seed``, so a request requeued off a killed replica
+    re-accepts the same speculative runs bitwise) and ``kv_dtype="int8"``. ``max_queue_depth`` bounds the TOTAL queued
     (not-yet-admitted) requests across alive replicas; past it
     :meth:`submit` sheds with :class:`FleetOverloadError`.
 

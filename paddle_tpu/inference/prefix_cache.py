@@ -26,12 +26,29 @@ import numpy as np
 __all__ = ["PrefixCache"]
 
 
+def _seg_bytes(seg) -> Optional[int]:
+    """Actual stored bytes of one cache segment leaf-by-leaf: a plain array,
+    or an int8 pack ``{"q", "s"}`` (payload + scale planes). The budget math
+    must follow the STORED representation — under a quantized KV cache the
+    compute-dtype estimate overstates entries ~3-4x and would starve the
+    cache of capacity it really has. Returns None for a non-array payload
+    (callers fall back to their a-priori estimate)."""
+    if isinstance(seg, dict):
+        parts = [_seg_bytes(v) for v in seg.values()]
+        return None if any(p is None for p in parts) else sum(parts)
+    if not (hasattr(seg, "size") and hasattr(seg, "dtype")):
+        return None
+    return int(seg.size) * int(np.dtype(seg.dtype).itemsize)
+
+
 class PrefixCache:
     """LRU cache of chunk-aligned prompt-prefix KV segments.
 
     ``chunk`` is the token granularity (the engine's ``prefill_chunk``);
     ``budget_bytes`` caps the summed device bytes of the stored segments;
-    ``entry_bytes`` is the (fixed) size of one chunk's K+V segment.
+    ``entry_bytes`` is the caller's a-priori estimate of one chunk's K+V
+    segment (capacity planning before any entry exists) — admission and
+    eviction are accounted against each entry's ACTUAL stored bytes.
     """
 
     def __init__(self, chunk: int, budget_bytes: int, entry_bytes: int):
@@ -41,6 +58,8 @@ class PrefixCache:
         self.budget_bytes = int(budget_bytes)
         self.entry_bytes = int(entry_bytes)
         self._entries: "OrderedDict[bytes, Tuple]" = OrderedDict()
+        self._sizes: dict = {}
+        self._bytes = 0
         self.hits = 0
         self.misses = 0
         self.evictions = 0
@@ -80,27 +99,36 @@ class PrefixCache:
     def put(self, key: bytes, seg_k, seg_v) -> bool:
         """Insert one chunk segment under its chain key; evicts LRU entries
         until the byte budget holds. A segment that alone exceeds the budget
-        is not stored (the cache never over-commits device memory)."""
-        if self.entry_bytes > self.budget_bytes:
+        is not stored (the cache never over-commits device memory). Sizes
+        come from the segments actually handed in, so quantized (int8 pack)
+        and full-precision entries are both charged honestly."""
+        sk, sv = _seg_bytes(seg_k), _seg_bytes(seg_v)
+        size = self.entry_bytes if (sk is None or sv is None) else sk + sv
+        if size > self.budget_bytes:
             return False
         if key in self._entries:
             self._entries.move_to_end(key)
             return True
         self._entries[key] = (seg_k, seg_v)
-        while self.bytes_used() > self.budget_bytes:
-            self._entries.popitem(last=False)
+        self._sizes[key] = size
+        self._bytes += size
+        while self._bytes > self.budget_bytes:
+            old, _ = self._entries.popitem(last=False)
+            self._bytes -= self._sizes.pop(old)
             self.evictions += 1
         return key in self._entries
 
     # ------------------------------------------------------------- accounting
     def bytes_used(self) -> int:
-        return len(self._entries) * self.entry_bytes
+        return self._bytes
 
     def __len__(self) -> int:
         return len(self._entries)
 
     def clear(self) -> None:
         self._entries.clear()
+        self._sizes.clear()
+        self._bytes = 0
 
     def stats(self) -> dict:
         return {

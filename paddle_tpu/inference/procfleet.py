@@ -425,6 +425,18 @@ class ProcServingFleet:
             self.model_config = dict(vars(model_config))  # noqa: PTA104 (host-side, never traced)
         self.model_seed = int(model_seed)
         self.engine_kwargs = dict(engine_kwargs)
+        # the replica spec crosses a process boundary as JSON: a draft model
+        # config must travel as its constructor kwargs (each replica rebuilds
+        # it bitwise from draft_seed); a live model object cannot
+        draft = self.engine_kwargs.get("draft")
+        if draft is not None:
+            if hasattr(draft, "to_dict"):
+                self.engine_kwargs["draft"] = draft.to_dict()  # noqa: PTA104 (host-side serving loop)
+            elif not isinstance(draft, dict):
+                raise TypeError(
+                    "ProcServingFleet needs draft= as a GPTConfig or a dict of "
+                    "GPTConfig kwargs (replica subprocesses rebuild it from "
+                    "draft_seed); a model instance does not serialize")
         self.max_queue_depth = int(max_queue_depth)
         self.heartbeat_timeout = float(heartbeat_timeout)
         self.boot_timeout = float(boot_timeout)

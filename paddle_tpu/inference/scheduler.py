@@ -318,13 +318,20 @@ class ContinuousBatchingScheduler:
         counter_inc("serving.tokens_generated", len(r.tokens))
         observe("serving.latency_seconds", r.total_seconds)
         gauge_set("serving.active_slots", len(self.running))
+        extra = {}
+        if getattr(self.engine, "spec_k", 0):
+            stats = self.engine.spec_stats()
+            extra["spec_k"] = stats["spec_k"]  # noqa: PTA104 (host-side serving loop)
+            extra["spec_acceptance"] = stats["acceptance_rate"]  # noqa: PTA104 (host-side serving loop)
         _runlog.emit("request", id=r.rid, status="finished", component="serving",
                      prompt_tokens=len(r.prompt), new_tokens=len(r.tokens),
                      queue_seconds=r.queue_seconds, prefill_seconds=r.prefill_seconds,
                      decode_seconds=r.decode_seconds, total_seconds=r.total_seconds,
                      ttft_seconds=r.ttft_seconds, fuse=self.engine.fuse,
                      prefix_tokens=r.prefix_tokens, stall_seconds=r.stall_seconds,
-                     trace=r.trace_id)
+                     kv_bytes_per_slot=getattr(
+                         self.engine, "kv_bytes_per_slot", lambda: 0)(),
+                     trace=r.trace_id, **extra)
 
     def step(self) -> List[Request]:
         """One scheduler tick: admit queued requests into free slots, run

@@ -103,6 +103,25 @@ class GPTConfig:
         if moe_num_experts and moe_every < 1:
             raise ValueError(f"moe_every must be >= 1, got {moe_every}")
 
+    def to_dict(self):
+        """JSON-able constructor kwargs — the cross-process spelling of a
+        config (e.g. a speculative-decoding draft model shipped to
+        ProcServingFleet replicas over the subprocess spec)."""
+        return dict(
+            vocab_size=self.vocab_size,
+            hidden_size=self.hidden_size,
+            num_layers=self.num_layers,
+            num_heads=self.num_heads,
+            ffn_hidden_size=self.ffn_hidden_size,
+            max_seq_len=self.max_seq_len,
+            dropout=self.dropout,
+            attn_dropout=self.attn_dropout,
+            initializer_range=self.initializer_range,
+            use_flash=self.use_flash,
+            stacked=self.stacked,
+            recompute=self.recompute,
+        )
+
     @staticmethod
     def gpt3_1p3b(**kw):
         cfg = dict(vocab_size=50304, hidden_size=2048, num_layers=24, num_heads=16, max_seq_len=2048)
@@ -129,16 +148,24 @@ class GPTAttention(nn.Layer):
         self.out_proj = RowParallelLinear(cfg.hidden_size, cfg.hidden_size, weight_attr=init, input_is_parallel=True)
         self.attn_dropout = cfg.attn_dropout
 
-    def gen_cache(self, x, static=False, max_seq=None):
+    def gen_cache(self, x, static=False, max_seq=None, kv_dtype=None):
         from ..nn.layer.transformer import MultiHeadAttention
         from ..tensor.creation import zeros
 
         if static:
             # fixed-shape serving cache: preallocated [b, max_seq, h, d],
             # written in place at the carried position — decode keeps one
-            # set of shapes (and one compiled program) for the whole run
+            # set of shapes (and one compiled program) for the whole run.
+            # kv_dtype="int8" preallocates the quantized representation
+            # (int8 payload + f32 scale planes) instead of compute-dtype K/V.
             if max_seq is None:
                 raise ValueError("gen_cache(static=True) needs max_seq=")
+            if kv_dtype is not None:
+                if str(kv_dtype) != "int8":
+                    raise ValueError(f"kv_dtype must be None or 'int8', got {kv_dtype!r}")
+                qz = lambda: zeros([x.shape[0], int(max_seq), self.num_heads, self.head_dim], dtype="int8")  # noqa: E731
+                sz = lambda: zeros([x.shape[0], int(max_seq), self.num_heads], dtype="float32")  # noqa: E731
+                return MultiHeadAttention.QuantizedFixedCache(qz(), sz(), qz(), sz(), zeros([], dtype="int32"))
             empty = lambda: zeros([x.shape[0], int(max_seq), self.num_heads, self.head_dim], dtype=x.dtype)  # noqa: E731
             return MultiHeadAttention.FixedCache(empty(), empty(), zeros([], dtype="int32"))
         empty = lambda: zeros([x.shape[0], 0, self.num_heads, self.head_dim], dtype=x.dtype)
@@ -159,6 +186,21 @@ class GPTAttention(nn.Layer):
             out = F.scaled_dot_product_attention(q, kf, vf, attn_mask=mask, dropout_p=self.attn_dropout, training=self.training)
             out = M.reshape(out, [b, s, self.num_heads * self.head_dim])
             return self.out_proj(out), MultiHeadAttention.FixedCache(kf, vf, cache.pos + s)
+        if isinstance(cache, MultiHeadAttention.QuantizedFixedCache):
+            from ..nn.layer.transformer import (
+                _fixed_cache_mask,
+                _quant_cache_read,
+                _quant_cache_write,
+            )
+
+            qk, sk = _quant_cache_write(cache.qk, cache.sk, k, cache.pos)
+            qv, sv = _quant_cache_write(cache.qv, cache.sv, v, cache.pos)
+            kf = _quant_cache_read(qk, sk, q.dtype)
+            vf = _quant_cache_read(qv, sv, q.dtype)
+            mask = _fixed_cache_mask(cache.pos, s, kf.shape[1])
+            out = F.scaled_dot_product_attention(q, kf, vf, attn_mask=mask, dropout_p=self.attn_dropout, training=self.training)
+            out = M.reshape(out, [b, s, self.num_heads * self.head_dim])
+            return self.out_proj(out), MultiHeadAttention.QuantizedFixedCache(qk, sk, qv, sv, cache.pos + s)
         if cache is not None:
             if cache.k.shape[1] > 0:
                 k = M.concat([cache.k, k], axis=1)
@@ -202,8 +244,8 @@ class GPTBlock(nn.Layer):
             self.ffn2 = RowParallelLinear(cfg.ffn_hidden_size, cfg.hidden_size, weight_attr=init, input_is_parallel=True)  # noqa: PTA104 (host-side, never traced)
         self.dropout = nn.Dropout(cfg.dropout)
 
-    def gen_cache(self, x, static=False, max_seq=None):
-        return self.attn.gen_cache(x, static=static, max_seq=max_seq)
+    def gen_cache(self, x, static=False, max_seq=None, kv_dtype=None):
+        return self.attn.gen_cache(x, static=static, max_seq=max_seq, kv_dtype=kv_dtype)
 
     def forward(self, x, cache=None):
         if cache is not None:
@@ -425,6 +467,87 @@ class GPTBlockStack(nn.Layer):
         )
 
 
+# ------------------------------------------------------------- KV-cache packs
+# An engine KV cache is either a plain array (compute dtype) or an int8
+# pack ``{"q": int8 [..., S, dh], "s": f32 [..., S]}`` with one abs_max
+# scale per (layer, slot, head, position) vector — per-head, per-position
+# ("per-chunk along S" at chunk=1) granularity, so a row's round-trip error
+# is bounded by its own abs_max/127 and never bleeds across positions. The
+# helpers below keep every cache-touching forward representation-agnostic:
+# writes quantize, attends read a dequantized view whose scale multiply XLA
+# folds into the consuming matmul (the QuantizedLinear idiom on the cache).
+
+def _kv_quantize(u):
+    """``u [..., dh]`` → ``(q int8 [..., dh], s f32 [...])`` abs_max scales."""
+    f = u.astype(jnp.float32)
+    s = jnp.maximum(jnp.max(jnp.abs(f), axis=-1), 1e-8) / 127.0
+    q = jnp.clip(jnp.round(f / s[..., None]), -127, 127).astype(jnp.int8)
+    return q, s
+
+
+def _kv_dequant(pack, dt):
+    """Dequantized view of an int8 pack (folds into the consuming matmul)."""
+    return (pack["q"].astype(jnp.float32) * pack["s"][..., None]).astype(dt)
+
+
+def _kvc_read(c, dt):
+    """Attend view of a cache: dequantizes a pack, passes arrays through."""
+    return _kv_dequant(c, dt) if isinstance(c, dict) else c
+
+
+def _kvc_update(c, u, idx):
+    """In-place cache write of a compute-dtype update ``u`` at index tuple
+    ``idx`` (scale plane takes ``idx[:-1]``); quantizes iff ``c`` is a pack."""
+    if isinstance(c, dict):
+        q, s = _kv_quantize(u)
+        return {"q": jax.lax.dynamic_update_slice(c["q"], q, idx),
+                "s": jax.lax.dynamic_update_slice(c["s"], s, idx[:-1])}
+    return jax.lax.dynamic_update_slice(c, u, idx)
+
+
+def _kvc_copy(c, seg, idx):
+    """Copy an already-stored segment (same representation as ``c``) into the
+    cache at ``idx`` — the prefix-cache insert: a pack segment moves int8
+    payload + scale planes verbatim, never round-tripping through f32."""
+    if isinstance(c, dict):
+        return {"q": jax.lax.dynamic_update_slice(c["q"], seg["q"], idx),
+                "s": jax.lax.dynamic_update_slice(c["s"], seg["s"], idx[:-1])}
+    return jax.lax.dynamic_update_slice(c, seg, idx)
+
+
+def _kvc_slice(c, idx, size):
+    """Slice a segment out of the cache in its STORED representation (the
+    prefix-cache extract; pair with :func:`_kvc_copy` to re-insert)."""
+    if isinstance(c, dict):
+        return {"q": jax.lax.dynamic_slice(c["q"], idx, size),
+                "s": jax.lax.dynamic_slice(c["s"], idx[:-1], size[:-1])}
+    return jax.lax.dynamic_slice(c, idx, size)
+
+
+def _kv_layer(c, i):
+    """Layer ``i`` of a stacked [L, ...] cache (array or pack)."""
+    if isinstance(c, dict):
+        return {"q": c["q"][i], "s": c["s"][i]}
+    return c[i]
+
+
+def _kv_stack(xs):
+    """Re-stack per-layer caches (inverse of :func:`_kv_layer`)."""
+    if isinstance(xs[0], dict):
+        return {"q": jnp.stack([x["q"] for x in xs]),
+                "s": jnp.stack([x["s"] for x in xs])}
+    return jnp.stack(xs)
+
+
+def _kv_zeros(shape, dt, kv_dtype=None):
+    """A fresh cache buffer: ``shape`` is the payload shape ``[..., S, dh]``;
+    ``kv_dtype="int8"`` allocates the quantized pack instead of ``dt``."""
+    if kv_dtype == "int8":
+        return {"q": jnp.zeros(shape, jnp.int8),
+                "s": jnp.zeros(shape[:-1], jnp.float32)}
+    return jnp.zeros(shape, dt)
+
+
 def _cache_block(lp, h, ck, cv, start_pos, *, num_heads, epsilon=1e-5):
     """One decoder block with a fixed-size KV cache.
 
@@ -446,23 +569,25 @@ def _cache_block(lp, h, ck, cv, start_pos, *, num_heads, epsilon=1e-5):
         return (v - mean) / jnp.sqrt(var + epsilon) * w + bb
 
     b, s, d = h.shape
-    S = ck.shape[2]
+    S = (ck["q"] if isinstance(ck, dict) else ck).shape[2]
     hd = d // num_heads
     x1 = ln(h, n1w, n1b)
     qkv = (x1 @ qkvw + qkvb).reshape(b, s, 3, num_heads, hd)
     q = jnp.swapaxes(qkv[:, :, 0], 1, 2)  # [b, H, s, dh]
     k = jnp.swapaxes(qkv[:, :, 1], 1, 2)
     v = jnp.swapaxes(qkv[:, :, 2], 1, 2)
-    ck = jax.lax.dynamic_update_slice(ck, k, (0, 0, start_pos, 0))
-    cv = jax.lax.dynamic_update_slice(cv, v, (0, 0, start_pos, 0))
+    ck = _kvc_update(ck, k, (0, 0, start_pos, 0))
+    cv = _kvc_update(cv, v, (0, 0, start_pos, 0))
+    rk = _kvc_read(ck, h.dtype)
+    rv = _kvc_read(cv, h.dtype)
     scale = jnp.asarray(1.0 / (hd ** 0.5), q.dtype)
-    scores = jnp.einsum("bhqd,bhkd->bhqk", q * scale, ck,
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q * scale, rk,
                         preferred_element_type=jnp.float32)
     q_pos = start_pos + jax.lax.broadcasted_iota(jnp.int32, (s, S), 0)
     k_pos = jax.lax.broadcasted_iota(jnp.int32, (s, S), 1)
     scores = jnp.where((k_pos <= q_pos)[None, None], scores, -jnp.inf)
-    p = jax.nn.softmax(scores, axis=-1).astype(cv.dtype)
-    att = jnp.einsum("bhqk,bhkd->bhqd", p, cv, preferred_element_type=jnp.float32)
+    p = jax.nn.softmax(scores, axis=-1).astype(rv.dtype)
+    att = jnp.einsum("bhqk,bhkd->bhqd", p, rv, preferred_element_type=jnp.float32)
     att = jnp.swapaxes(att.astype(h.dtype), 1, 2).reshape(b, s, d)
     h = h + att @ ow + ob
     x2 = ln(h, n2w, n2b)
@@ -496,28 +621,34 @@ def _cache_forward(stacked, wte, wpe, fnw, fnb, ids, cache_k, cache_v, start_pos
     new_k, new_v = [], []
     for i in range(num_layers):
         lp = (tuple(p[i] for p in params), idx[i])
-        h, ck, cv = _cache_block(lp, h, cache_k[i], cache_v[i], start_pos, num_heads=num_heads)
-        new_k.append(mpc(ck, None, "mp"))  # noqa: PTA104 (static unroll, host loop bound)
-        new_v.append(mpc(cv, None, "mp"))  # noqa: PTA104 (static unroll, host loop bound)
+        h, ck, cv = _cache_block(lp, h, _kv_layer(cache_k, i), _kv_layer(cache_v, i),
+                                 start_pos, num_heads=num_heads)
+        # int8 packs skip the mp constraint (the serving engine never meshes)
+        new_k.append(ck if isinstance(ck, dict) else mpc(ck, None, "mp"))  # noqa: PTA104 (static unroll, host loop bound)
+        new_v.append(cv if isinstance(cv, dict) else mpc(cv, None, "mp"))  # noqa: PTA104 (static unroll, host loop bound)
     mean = jnp.mean(h, axis=-1, keepdims=True)
     var = jnp.var(h, axis=-1, keepdims=True)
     h = (h - mean) / jnp.sqrt(var + 1e-5) * fnw + fnb
     logits = mpc(jnp.einsum("bsd,vd->bsv", h, wte), None, None, "mp")
-    return logits, jnp.stack(new_k), jnp.stack(new_v)
+    return logits, _kv_stack(new_k), _kv_stack(new_v)
 
 
 def _slot_cache_block(lp, h, ck, cv, pos, *, num_heads, epsilon=1e-5, active=None):
     """One decoder block over PER-SLOT cache positions (continuous-batching
-    decode). ``h`` [b, 1, d] holds one token per batch slot; ``ck``/``cv``
-    [b, H, S, dh]; ``pos`` [b] int32 is each slot's write index. K/V are
-    written at ``pos[b]`` via a vmapped ``dynamic_update_slice`` (write
-    BEFORE attend, so a stale cache entry is always overwritten before it
-    can become visible) and attention masks keys beyond each slot's own
-    position — slots at different sequence depths share one compiled
-    program. ``active`` [b] bool gates the write per slot: an inactive
-    slot's cache stays bitwise untouched, so decode dispatches interleaved
-    with another slot's chunked prefill cannot clobber its freshly written
-    K/V at a stale ``pos``. Same math as :func:`_cache_block` at s=1.
+    decode). ``h`` [b, W, d] holds a W-token window per batch slot (W=1 for
+    plain decode, W=K+1 for the speculative verification forward); ``ck``/
+    ``cv`` [b, H, S, dh] (or int8 packs); ``pos`` [b] int32 is each slot's
+    write index for window row 0. The window's K/V are written at
+    ``pos[b]`` via a vmapped ``dynamic_update_slice`` (write BEFORE attend,
+    so a stale cache entry — including a speculative window's rejected
+    tail — is always overwritten before it can become visible) and row j
+    attends keys up to ``pos[b] + j`` — slots at different sequence depths
+    share one compiled program. ``active`` [b] bool gates the write per
+    slot: an inactive slot's cache stays bitwise untouched, so decode
+    dispatches interleaved with another slot's chunked prefill cannot
+    clobber its freshly written K/V at a stale ``pos``. Same per-row math
+    as :func:`_cache_block` at s=1 (the bitwise basis of both the chunked-
+    prefill and the greedy speculative-decoding pins).
     """
     (n1w, n1b, qkvw, qkvb, ow, ob, n2w, n2b, f1w, f1b, f2w, f2b), _ = lp
 
@@ -527,32 +658,42 @@ def _slot_cache_block(lp, h, ck, cv, pos, *, num_heads, epsilon=1e-5, active=Non
         return (v - mean) / jnp.sqrt(var + epsilon) * w + bb
 
     b, s, d = h.shape
-    S = ck.shape[2]
+    S = (ck["q"] if isinstance(ck, dict) else ck).shape[2]
     hd = d // num_heads
     x1 = ln(h, n1w, n1b)
     qkv = (x1 @ qkvw + qkvb).reshape(b, s, 3, num_heads, hd)
-    q = jnp.swapaxes(qkv[:, :, 0], 1, 2)  # [b, H, 1, dh]
+    q = jnp.swapaxes(qkv[:, :, 0], 1, 2)  # [b, H, W, dh]
     k = jnp.swapaxes(qkv[:, :, 1], 1, 2)
     v = jnp.swapaxes(qkv[:, :, 2], 1, 2)
     if active is None:
-        upd = jax.vmap(lambda c, u, p: jax.lax.dynamic_update_slice(c, u, (0, p, 0)))
-        ck = upd(ck, k, pos)
-        cv = upd(cv, v, pos)
+        ck = jax.vmap(lambda c, u, p: _kvc_update(c, u, (0, p, 0)))(ck, k, pos)
+        cv = jax.vmap(lambda c, u, p: _kvc_update(c, u, (0, p, 0)))(cv, v, pos)
     else:
         def upd(c, u, p, a):
+            if isinstance(c, dict):
+                uq, us = _kv_quantize(u)
+                cq = jax.lax.dynamic_slice(c["q"], (0, p, 0), uq.shape)
+                cs = jax.lax.dynamic_slice(c["s"], (0, p), us.shape)
+                return {"q": jax.lax.dynamic_update_slice(
+                            c["q"], jnp.where(a, uq, cq), (0, p, 0)),
+                        "s": jax.lax.dynamic_update_slice(
+                            c["s"], jnp.where(a, us, cs), (0, p))}
             cur = jax.lax.dynamic_slice(c, (0, p, 0), u.shape)
             return jax.lax.dynamic_update_slice(c, jnp.where(a, u, cur), (0, p, 0))
 
         ck = jax.vmap(upd)(ck, k, pos, active)
         cv = jax.vmap(upd)(cv, v, pos, active)
+    rk = _kvc_read(ck, h.dtype)
+    rv = _kvc_read(cv, h.dtype)
     scale = jnp.asarray(1.0 / (hd ** 0.5), q.dtype)
-    scores = jnp.einsum("bhqd,bhkd->bhqk", q * scale, ck,
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q * scale, rk,
                         preferred_element_type=jnp.float32)
-    k_pos = jax.lax.broadcasted_iota(jnp.int32, (b, S), 1)
-    visible = k_pos <= pos[:, None]  # [b, S]: each slot sees its own prefix
-    scores = jnp.where(visible[:, None, None, :], scores, -jnp.inf)
-    p = jax.nn.softmax(scores, axis=-1).astype(cv.dtype)
-    att = jnp.einsum("bhqk,bhkd->bhqd", p, cv, preferred_element_type=jnp.float32)
+    k_pos = jax.lax.broadcasted_iota(jnp.int32, (b, s, S), 2)
+    q_pos = pos[:, None, None] + jax.lax.broadcasted_iota(jnp.int32, (b, s, S), 1)
+    visible = k_pos <= q_pos  # [b, W, S]: row j sees its slot's prefix + itself
+    scores = jnp.where(visible[:, None], scores, -jnp.inf)
+    p = jax.nn.softmax(scores, axis=-1).astype(rv.dtype)
+    att = jnp.einsum("bhqk,bhkd->bhqd", p, rv, preferred_element_type=jnp.float32)
     att = jnp.swapaxes(att.astype(h.dtype), 1, 2).reshape(b, s, d)
     h = h + att @ ow + ob
     x2 = ln(h, n2w, n2b)
@@ -561,29 +702,53 @@ def _slot_cache_block(lp, h, ck, cv, pos, *, num_heads, epsilon=1e-5, active=Non
     return h, ck, cv
 
 
-def _slot_decode_forward(stacked, wte, wpe, fnw, fnb, tok, cache_k, cache_v, pos, *, num_heads, active=None):
-    """One-token trunk forward with per-slot positions: the decode-step
-    program of the serving engine. ``tok`` [b] int32 (last token per slot),
-    ``cache_k``/``cache_v`` [L, b, H, S, dh], ``pos`` [b] int32, ``active``
-    [b] bool (optional) gates cache writes per slot. Returns
-    (logits [b, V], cache_k, cache_v) — exactly one compiled program serves
-    every step of every request regardless of each slot's depth.
-    """
+def _slot_window_forward(stacked, wte, wpe, fnw, fnb, toks, cache_k, cache_v, pos, *, num_heads, active=None):
+    """W-token trunk forward with per-slot start positions: row j of
+    ``toks`` [b, W] runs at absolute position ``pos[b] + j`` against the
+    engine's big cache — the speculative-decoding verification program (the
+    target model scores the whole drafted window in ONE forward). Returns
+    (logits [b, W, V], cache_k, cache_v); per-row math identical to the
+    W=1 decode step, so greedy accepted tokens stay bitwise equal to
+    sequential decode."""
     params, idx = stacked
     num_layers = params[0].shape[0]
-    h = (jnp.take(wte, tok, axis=0) + jnp.take(wpe, pos, axis=0))[:, None, :]
+    b, W = toks.shape
+    rows = pos[:, None] + jnp.arange(W, dtype=jnp.int32)[None]
+    # a speculative window near the sequence limit can index past the
+    # positional table; clamp (those rows are never emitted — an unclamped
+    # jnp.take fills NaN, which the window's own KV writes would spread to
+    # later rows). No-op at W=1, where pos < max_seq_len always holds.
+    rows = jnp.minimum(rows, jnp.int32(wpe.shape[0] - 1))
+    h = jnp.take(wte, toks, axis=0) + jnp.take(wpe, rows, axis=0)
     h = h.astype(wte.dtype)
     new_k, new_v = [], []
     for i in range(num_layers):
         lp = (tuple(p[i] for p in params), idx[i])
-        h, ck, cv = _slot_cache_block(lp, h, cache_k[i], cache_v[i], pos, num_heads=num_heads, active=active)
+        h, ck, cv = _slot_cache_block(lp, h, _kv_layer(cache_k, i), _kv_layer(cache_v, i),
+                                      pos, num_heads=num_heads, active=active)
         new_k.append(ck)  # noqa: PTA104 (static unroll, host loop bound)
         new_v.append(cv)  # noqa: PTA104 (static unroll, host loop bound)
     mean = jnp.mean(h, axis=-1, keepdims=True)
     var = jnp.var(h, axis=-1, keepdims=True)
     h = (h - mean) / jnp.sqrt(var + 1e-5) * fnw + fnb
-    logits = jnp.einsum("bsd,vd->bsv", h, wte)[:, 0]
-    return logits, jnp.stack(new_k), jnp.stack(new_v)
+    logits = jnp.einsum("bsd,vd->bsv", h, wte)
+    return logits, _kv_stack(new_k), _kv_stack(new_v)
+
+
+def _slot_decode_forward(stacked, wte, wpe, fnw, fnb, tok, cache_k, cache_v, pos, *, num_heads, active=None):
+    """One-token trunk forward with per-slot positions: the decode-step
+    program of the serving engine. ``tok`` [b] int32 (last token per slot),
+    ``cache_k``/``cache_v`` [L, b, H, S, dh] (or int8 packs), ``pos`` [b]
+    int32, ``active`` [b] bool (optional) gates cache writes per slot.
+    Returns (logits [b, V], cache_k, cache_v) — exactly one compiled
+    program serves every step of every request regardless of each slot's
+    depth. The W=1 case of :func:`_slot_window_forward` (single shared
+    definition, so the speculative window stays bitwise-aligned with it).
+    """
+    logits, cache_k, cache_v = _slot_window_forward(
+        stacked, wte, wpe, fnw, fnb, tok[:, None], cache_k, cache_v, pos,
+        num_heads=num_heads, active=active)
+    return logits[:, 0], cache_k, cache_v
 
 
 def _chunk_prefill_block(lp, h, ck, cv, slot, start, *, num_heads, epsilon=1e-5):
@@ -607,18 +772,19 @@ def _chunk_prefill_block(lp, h, ck, cv, slot, start, *, num_heads, epsilon=1e-5)
         return (v - mean) / jnp.sqrt(var + epsilon) * w + bb
 
     _, s, d = h.shape
-    H = ck.shape[1]
-    S = ck.shape[2]
+    raw = ck["q"] if isinstance(ck, dict) else ck
+    H = raw.shape[1]
+    S = raw.shape[2]
     hd = d // num_heads
     x1 = ln(h, n1w, n1b)
     qkv = (x1 @ qkvw + qkvb).reshape(1, s, 3, num_heads, hd)
     q = jnp.swapaxes(qkv[:, :, 0], 1, 2)  # [1, H, C, dh]
     k = jnp.swapaxes(qkv[:, :, 1], 1, 2)
     v = jnp.swapaxes(qkv[:, :, 2], 1, 2)
-    ck = jax.lax.dynamic_update_slice(ck, k, (slot, 0, start, 0))
-    cv = jax.lax.dynamic_update_slice(cv, v, (slot, 0, start, 0))
-    rk = jax.lax.dynamic_slice(ck, (slot, 0, 0, 0), (1, H, S, hd))
-    rv = jax.lax.dynamic_slice(cv, (slot, 0, 0, 0), (1, H, S, hd))
+    ck = _kvc_update(ck, k, (slot, 0, start, 0))
+    cv = _kvc_update(cv, v, (slot, 0, start, 0))
+    rk = _kvc_read(_kvc_slice(ck, (slot, 0, 0, 0), (1, H, S, hd)), h.dtype)
+    rv = _kvc_read(_kvc_slice(cv, (slot, 0, 0, 0), (1, H, S, hd)), h.dtype)
     scale = jnp.asarray(1.0 / (hd ** 0.5), q.dtype)
     scores = jnp.einsum("bhqd,bhkd->bhqk", q * scale, rk,
                         preferred_element_type=jnp.float32)
@@ -654,11 +820,12 @@ def _chunk_prefill_forward(stacked, wte, wpe, fnw, fnb, ids, cache_k, cache_v,
     new_k, new_v = [], []
     for i in range(num_layers):
         lp = (tuple(p[i] for p in params), idx[i])
-        h, ck, cv = _chunk_prefill_block(lp, h, cache_k[i], cache_v[i], slot, start, num_heads=num_heads)
+        h, ck, cv = _chunk_prefill_block(lp, h, _kv_layer(cache_k, i), _kv_layer(cache_v, i),
+                                         slot, start, num_heads=num_heads)
         new_k.append(ck)  # noqa: PTA104 (static unroll, host loop bound)
         new_v.append(cv)  # noqa: PTA104 (static unroll, host loop bound)
-    cache_k = jnp.stack(new_k)
-    cache_v = jnp.stack(new_v)
+    cache_k = _kv_stack(new_k)
+    cache_v = _kv_stack(new_v)
     if last_row is None:
         return None, cache_k, cache_v
     hl = jax.lax.dynamic_slice(h, (0, last_row, 0), (1, 1, h.shape[2]))
@@ -669,10 +836,11 @@ def _chunk_prefill_forward(stacked, wte, wpe, fnw, fnb, ids, cache_k, cache_v,
     return logits, cache_k, cache_v
 
 
-def _select_token(logits, key, do_sample, temperature, top_k, top_p):
-    """Greedy or temperature/top-k/top-p sampling over [b, V] logits."""
-    if not do_sample:
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+def _filtered_logits(logits, temperature, top_k, top_p):
+    """Temperature/top-k/top-p filtered f32 logits over [b, V] — the exact
+    transform :func:`_select_token` samples from, factored out so
+    speculative decoding's residual-resampling acceptance test works on the
+    SAME filtered distribution the sequential sampler would draw from."""
     logits = logits.astype(jnp.float32) / jnp.maximum(temperature, 1e-6)
     if top_k and top_k > 0:
         k_eff = min(int(top_k), logits.shape[-1])  # top_k > vocab = keep all
@@ -684,6 +852,14 @@ def _select_token(logits, key, do_sample, temperature, top_k, top_p):
         keep = jnp.cumsum(probs, axis=-1) - probs < top_p  # always keep top-1
         threshold = jnp.min(jnp.where(keep, sl, jnp.inf), axis=-1, keepdims=True)
         logits = jnp.where(logits < threshold, -jnp.inf, logits)
+    return logits
+
+
+def _select_token(logits, key, do_sample, temperature, top_k, top_p):
+    """Greedy or temperature/top-k/top-p sampling over [b, V] logits."""
+    if not do_sample:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = _filtered_logits(logits, temperature, top_k, top_p)
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
 

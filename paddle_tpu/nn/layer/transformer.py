@@ -45,6 +45,42 @@ def _fixed_cache_write(cache, k_new, v_new):
     return k, v
 
 
+def _quant_cache_write(qbuf, sbuf, u_new, pos):
+    """Quantize ``u_new`` [b, s, h, d] to int8 with per-(b, s, h) abs_max
+    scales and write BOTH planes of a :class:`QuantizedFixedCache` buffer
+    pair at ``pos`` — the cache stores the quantized representation only
+    (int8 payload + f32 scale plane), never a full-precision copy."""
+    import jax.lax as lax
+    import jax.numpy as jnp
+
+    def scales(u):
+        f = u.astype(jnp.float32)
+        return jnp.maximum(jnp.max(jnp.abs(f), axis=-1), 1e-8) / 127.0
+
+    def wq(qb, u, p):
+        s = scales(u)
+        q = jnp.clip(jnp.round(u.astype(jnp.float32) / s[..., None]), -127, 127)
+        return lax.dynamic_update_slice(qb, q.astype(jnp.int8), (0, p, 0, 0))
+
+    def ws(sb, u, p):
+        return lax.dynamic_update_slice(sb, scales(u), (0, p, 0))
+
+    return (_op(wq, qbuf, u_new, pos, _name="kv_cache_update"),
+            _op(ws, sbuf, u_new, pos, _name="kv_cache_update"))
+
+
+def _quant_cache_read(qbuf, sbuf, dt):
+    """Dequantized [b, max_seq, h, d] view of a quantized cache plane pair
+    in compute dtype ``dt`` (XLA folds the scale multiply into the consuming
+    attention matmul — HBM only ever holds the int8 payload + scales)."""
+    import jax.numpy as jnp
+
+    def rd(q, s):
+        return (q.astype(jnp.float32) * s[..., None]).astype(jnp.dtype(dt))
+
+    return _op(rd, qbuf, sbuf, _name="kv_cache_dequant")
+
+
 def _fixed_cache_mask(pos, s, max_seq):
     """Bool [s, max_seq] attention mask for a FixedCache read: query row i
     (absolute position pos+i) sees keys at positions <= pos+i; preallocated
@@ -70,6 +106,12 @@ class MultiHeadAttention(Layer):
     # are constant for the whole decode, so exactly one compiled program
     # serves every step.
     FixedCache = collections.namedtuple("FixedCache", ["k", "v", "pos"])
+    # int8-quantized serving cache: same fixed-shape discipline as
+    # FixedCache but HBM holds int8 payloads (qk/qv) + per-(b, pos, h)
+    # f32 abs_max scale planes (sk/sv) — ~4x smaller at large head_dim;
+    # dequant happens on read, folded into the attention matmul.
+    QuantizedFixedCache = collections.namedtuple(
+        "QuantizedFixedCache", ["qk", "sk", "qv", "sv", "pos"])
 
     def __init__(self, embed_dim, num_heads, dropout=0.0, kdim=None, vdim=None, need_weights=False, weight_attr=None, bias_attr=None):
         super().__init__()
@@ -91,7 +133,8 @@ class MultiHeadAttention(Layer):
         v = M.reshape(self.v_proj(value), [b, -1, self.num_heads, self.head_dim])
         return k, v
 
-    def gen_cache(self, key, value=None, type=None, static=False, max_seq=None):
+    def gen_cache(self, key, value=None, type=None, static=False, max_seq=None,
+                  kv_dtype=None):
         """Parity: transformer.py:284. ``type=StaticCache`` precomputes the
         cross-attention K/V from ``key``/``value``; ``type=Cache`` (default)
         starts an empty incremental self-attention cache.
@@ -100,13 +143,22 @@ class MultiHeadAttention(Layer):
         preallocated ``[b, max_seq, h, d]`` zeros written in place at the
         carried position — every decode step keeps identical shapes, so the
         dygraph loop (or a jitted step over it) compiles exactly once
-        instead of once per sequence length."""
+        instead of once per sequence length. ``kv_dtype="int8"`` (static
+        only) starts a :class:`QuantizedFixedCache` — the buffers hold int8
+        payloads + f32 abs_max scale planes instead of compute-dtype K/V."""
         if static:
             if max_seq is None:
                 raise ValueError("gen_cache(static=True) needs max_seq=")
             b = key.shape[0]
             from ...tensor.creation import zeros
 
+            if kv_dtype is not None:
+                if str(kv_dtype) != "int8":
+                    raise ValueError(f"kv_dtype must be None or 'int8', got {kv_dtype!r}")
+                qz = lambda: zeros([b, int(max_seq), self.num_heads, self.head_dim], dtype="int8")  # noqa: E731
+                sz = lambda: zeros([b, int(max_seq), self.num_heads], dtype="float32")  # noqa: E731
+                return self.QuantizedFixedCache(qz(), sz(), qz(), sz(),
+                                                zeros([], dtype="int32"))
             dt = key.dtype
             empty = lambda: zeros([b, int(max_seq), self.num_heads, self.head_dim], dtype=dt)  # noqa: E731
             return self.FixedCache(empty(), empty(), zeros([], dtype="int32"))
@@ -140,6 +192,20 @@ class MultiHeadAttention(Layer):
             out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask, dropout_p=self.dropout, training=self.training)
             out = M.reshape(out, [b, -1, self.embed_dim])
             return self.out_proj(out), self.FixedCache(k, v, cache.pos + s)
+        elif isinstance(cache, self.QuantizedFixedCache):
+            # quantized static decode: quantize-on-write (both planes),
+            # dequantize-on-read into the attention matmul — the cache
+            # round-trips int8 end-to-end, never holding f32 K/V in HBM
+            k_new, v_new = self._proj_kv(key, value)
+            s = q.shape[1]
+            qk, sk = _quant_cache_write(cache.qk, cache.sk, k_new, cache.pos)
+            qv, sv = _quant_cache_write(cache.qv, cache.sv, v_new, cache.pos)
+            k = _quant_cache_read(qk, sk, q.dtype)
+            v = _quant_cache_read(qv, sv, q.dtype)
+            attn_mask = _fixed_cache_mask(cache.pos, s, k.shape[1])
+            out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask, dropout_p=self.dropout, training=self.training)
+            out = M.reshape(out, [b, -1, self.embed_dim])
+            return self.out_proj(out), self.QuantizedFixedCache(qk, sk, qv, sv, cache.pos + s)
         else:
             k, v = self._proj_kv(key, value)
             if isinstance(cache, self.Cache):

@@ -316,6 +316,18 @@ def _analyze_serving(reqs: List[dict]) -> dict:
                      if isinstance(ev.get("fuse"), int)})
     if depths:
         out["fuse_depths"] = depths  # noqa: PTA104 (host-side report printer)
+    # serving hot-path round 3: speculative decoding + quantized KV cache
+    spec = [ev for ev in finished if isinstance(ev.get("spec_acceptance"), (int, float))]
+    if spec:
+        out["spec_decode"] = {  # noqa: PTA104 (host-side report printer)
+            "spec_k": sorted({int(ev["spec_k"]) for ev in spec
+                              if isinstance(ev.get("spec_k"), int)}),
+            "acceptance_rate": spec[-1]["spec_acceptance"],  # cumulative: last wins
+        }
+    kvb = [ev["kv_bytes_per_slot"] for ev in finished
+           if isinstance(ev.get("kv_bytes_per_slot"), int)]
+    if kvb:
+        out["kv_cache"] = {"bytes_per_slot": max(kvb)}  # noqa: PTA104 (host-side report printer)
     stalls = sorted(ev["stall_seconds"] for ev in admitted
                     if isinstance(ev.get("stall_seconds"), (int, float)))
     if stalls:
@@ -650,6 +662,14 @@ def print_report(path: str, a: dict) -> None:
         if sv.get("fuse_depths"):
             print(f"    fused decode depth: "  # noqa: PTA105 (host-side report printer)
                   f"{'/'.join(str(d) for d in sv['fuse_depths'])} tokens/dispatch")
+        sp = sv.get("spec_decode")
+        if sp:
+            print(f"    speculative decode: K="  # noqa: PTA105 (host-side report printer)
+                  f"{'/'.join(str(k) for k in sp['spec_k'])}   "
+                  f"acceptance {sp['acceptance_rate'] * 100:.1f}%")
+        kv = sv.get("kv_cache")
+        if kv:
+            print(f"    kv cache: {kv['bytes_per_slot']} bytes/slot")  # noqa: PTA105 (host-side report printer)
         stall = sv.get("prefill_stall")
         if stall:
             print(f"    prefill stall: p50 {stall['p50_seconds'] * 1e3:.2f} ms   "  # noqa: PTA105 (host-side report printer)

@@ -169,6 +169,10 @@ SERVING_COUNTERS: Tuple[str, ...] = (
     "serving.requests_cancelled", "serving.deadline_exceeded",
     "serving.prefix_hits", "serving.prefix_misses",
     "serving.prefix_tokens_reused",
+    # speculative decoding (PR 18): proposals drafted vs accepted — their
+    # ratio is the serving.spec_acceptance_rate gauge and the lever behind
+    # decode_dispatches_per_token dropping below 1/(spec_k acceptance)
+    "infer.spec_draft_tokens", "infer.spec_accepted_tokens",
 )
 
 # Serving-fleet tier (inference/fleet.py + router.py): the failure-handling
@@ -272,6 +276,10 @@ OBS_COUNTERS: Tuple[str, ...] = (
 KNOWN_GAUGES: Tuple[str, ...] = (
     "serving.prefix_cache_bytes", "serving.queue_depth",
     "serving.active_slots",
+    # cumulative accepted/drafted ratio of the speculative decoder, and the
+    # stored (post-quantization) HBM cost of one KV slot — concurrent-slot
+    # capacity planning divides free HBM by this number
+    "serving.spec_acceptance_rate", "infer.kv_bytes_per_slot",
     "fleet.replicas_alive", "fleet.replicas_dead", "fleet.queue_depth",
     "stability.lr", "amp.loss_scale",
 )
