@@ -47,7 +47,16 @@ Prints ONE JSON line on the bench.py schema: {"metric", "value", "unit",
    ``decode_dispatches_per_token``; both arms assert bitwise parity with
    the plain engine) and the int8 KV cache (``kv_bytes_per_slot`` int8 vs
    f32, the shrink ratio, and ``max_concurrent_slots`` under a notional
-   64 MiB KV budget — the concurrency the quantization buys).
+   64 MiB KV budget — the concurrency the quantization buys);
+7. **alerts phase** (own ``BENCH_BUDGET_ALERTS`` budget, own subprocess):
+   the observability round-3 alerting arm — a TTFT SLO with sub-second
+   burn windows over a live fleet, a chaos latency spike
+   (``FLAGS_chaos_replica_slow_ms``), and the judgment layer's reaction
+   time: ``alert_detection_ms`` (chaos start → page alert firing, within
+   the fast window), ``alert_firing_ms`` (page → cleared once the spike
+   ages out of the windows under recovery traffic), and
+   ``slo_eval_overhead_pct`` — the monitor's evaluation cost over the
+   serving run's wall time at a 50ms cadence (< 2% budget).
 
 Like bench.py, the process NEVER hangs into the driver's timeout and never
 exits non-zero: the default backend is probed in a throwaway child first and
@@ -643,7 +652,184 @@ def _measure_spec():
     }
 
 
+def _measure_alerts():
+    """The round-3 alerting arm: the SLO engine watching a live fleet.
+
+    Installs one TTFT SLO with sub-second burn windows (the production
+    ~5min/1h windows shrunk so the bench finishes), injects a chaos
+    latency spike (``FLAGS_chaos_replica_slow_ms``), and measures the
+    judgment layer's reaction time: ``alert_detection_ms`` — wall time
+    from the start of the degraded run to the page-severity alert firing
+    — and ``alert_firing_ms`` — page until the alert cleared as the
+    spike aged out of both windows. The spike size and
+    objective threshold are machine-relative (multiples of the measured
+    healthy TTFT) so the arm pages on the chaos and never on the host's
+    own speed. ``slo_eval_overhead_pct`` is the monitor's cost while the
+    healthy run was serving: Σ ``slo.eval_seconds`` over the run's wall
+    time, evaluated every 50ms — budget < 2%."""
+    import jax
+
+    if os.environ.get("BENCH_FORCE_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+
+    import paddle_tpu as paddle
+    from paddle_tpu.inference import ServingFleet
+    from paddle_tpu.models.gpt import GPTConfig, GPTForPretraining
+    from paddle_tpu.observability import metrics, slo
+    from paddle_tpu.testing import chaos
+
+    d0 = jax.devices()[0]
+    on_tpu = d0.platform in ("tpu", "axon") or "TPU" in getattr(d0, "device_kind", "")
+    if on_tpu:
+        cfg = GPTConfig(vocab_size=50304, hidden_size=1024, num_layers=16,
+                        num_heads=16, max_seq_len=1024)
+        slots, max_seq, max_new, n_requests = 8, 1024, 16, 12
+        chunk, fuse, n_replicas = 128, 8, 2
+    else:
+        cfg = GPTConfig.tiny()
+        slots, max_seq, max_new, n_requests = 2, 128, 6, 6
+        chunk, fuse, n_replicas = 16, 2, 2
+
+    paddle.seed(0)
+    model = GPTForPretraining(cfg)
+    model.eval()
+    rng = np.random.default_rng(0)
+    kw = dict(max_batch_slots=slots, max_seq_len=max_seq, prefill_chunk=chunk,
+              fuse=fuse)
+    prompts = [rng.integers(0, cfg.vocab_size, (int(n),)).astype("int32")
+               for n in rng.integers(max(1, chunk // 4), chunk, n_requests)]
+
+    import tempfile
+
+    cache_dir = tempfile.mkdtemp(prefix="bench_alerts_aot_")
+    log_dir = tempfile.mkdtemp(prefix="bench_alerts_log_")
+    prev_flags = paddle.get_flags(["FLAGS_compile_cache_dir",
+                                   "FLAGS_run_log_dir"])
+    paddle.set_flags({"FLAGS_compile_cache_dir": cache_dir,
+                      "FLAGS_run_log_dir": log_dir})
+
+    def serve(tag):
+        fl = ServingFleet(model, replicas=n_replicas, **kw)
+        for i, p in enumerate(prompts):
+            fl.submit(p, max_new_tokens=max_new, seed=i)
+        t0 = time.perf_counter()
+        fl.run()
+        return time.perf_counter() - t0
+
+    try:
+        serve("warm")   # compile + serialize the program family
+        serve("healthy")  # healthy TTFT sample, monitor not yet installed
+        ttft_hist = metrics.histogram("serving.ttft_seconds")
+        healthy_ms = (ttft_hist.percentile(50) or 0.01) * 1e3
+        # objective + spike sized off the measured healthy TTFT so the arm
+        # alerts on the chaos, not on the host's own speed
+        threshold_ms = max(50.0, 2.0 * healthy_ms)
+        slow_ms = int(min(2500.0, max(150.0, 4.0 * healthy_ms)))
+        # the fast window must hold several chaos-slowed ticks: the first
+        # degraded TTFT only exists a few ticks into the incident, so a
+        # window shorter than that could never contain its own detection
+        fast_w = max(2.0, 6.0 * slow_ms / 1e3)
+        slow_w = 4.0 * fast_w
+        spec = slo.SLO("serving.ttft_p50_ms", "percentile",
+                       threshold=threshold_ms, histogram="serving.ttft_seconds",
+                       q=50, scale=1e3, page_burn=1.2, warn_burn=1.0,
+                       description="bench alerting arm: machine-relative TTFT")
+        mon = slo.install([spec], with_regress=False, eval_every_s=0.05,
+                          fast_window_s=fast_w, slow_window_s=slow_w)
+        mon.evaluate()  # baseline snapshot before the overhead-metered run
+
+        # --- monitor overhead while serving healthy traffic ---------------
+        eval_sum0 = metrics.histogram("slo.eval_seconds").sum
+        evals0 = metrics.counters("slo.")["slo.evaluations"]
+        dt_healthy = serve("metered")
+        eval_cost = metrics.histogram("slo.eval_seconds").sum - eval_sum0
+        overhead_pct = eval_cost / dt_healthy * 100.0 if dt_healthy else None
+        evaluations = int(metrics.counters("slo.")["slo.evaluations"] - evals0)
+        paged_on_healthy = mon.states()[0]["severity"] is not None
+
+        # --- chaos latency spike: page within the fast window -------------
+        # quiesce one fast window first: otherwise the healthy run's TTFT
+        # samples share the window with the first chaos samples and hold
+        # the percentile down, inflating detection by ~the window length
+        time.sleep(fast_w)
+        mon.evaluate()
+        t_chaos = time.time()
+        with chaos.inject(FLAGS_chaos_replica_slow_ms=str(slow_ms)):
+            serve("chaos")  # tick loops drive the monitor's 50ms cadence
+        mon.evaluate()
+
+        # --- recovery: healthy traffic, spike ages out of both windows ----
+        serve("recovery")
+        deadline = time.time() + 4 * slow_w
+        while time.time() < deadline:
+            mon.evaluate()
+            if mon.states()[0]["severity"] is None:
+                break
+            time.sleep(0.1)
+
+        # detection/clear come from the run-log alert events: with chaos
+        # ticks longer than the fast window the alert can fire AND clear
+        # inside the chaos run itself, so post-run monitor state alone
+        # would under-report what the judgment layer actually did
+        events = []
+        for name in sorted(os.listdir(log_dir)):
+            if not (name.startswith("run-") and name.endswith(".jsonl")):
+                continue
+            with open(os.path.join(log_dir, name)) as f:
+                for line in f:
+                    try:
+                        ev = json.loads(line)
+                    except ValueError:
+                        continue
+                    if (ev.get("event") == "alert"
+                            and ev.get("slo") == spec.name
+                            and ev.get("ts", 0) >= t_chaos):
+                        events.append(ev)
+        events.sort(key=lambda e: e.get("ts", 0))
+        pages = [e for e in events if e.get("state") == "firing"
+                 and e.get("severity") == "page"]
+        severity = "page" if pages else (
+            events[-1].get("severity") if events else None)
+        detection_ms = ((pages[0]["since"] - t_chaos) * 1e3
+                        if pages and pages[0].get("since") else None)
+        cleared = [e for e in events if e.get("state") == "cleared"
+                   and pages and e["ts"] >= pages[0]["ts"]]
+        clear_ms = ((cleared[-1]["ts"] - pages[0]["since"]) * 1e3
+                    if cleared and pages[0].get("since") else None)
+        final_quiet = mon.states()[0]["severity"] is None
+        return {
+            "replicas": n_replicas,
+            "healthy_ttft_p50_ms": round(healthy_ms, 2),
+            "ttft_threshold_ms": round(threshold_ms, 2),
+            "chaos_slow_ms": slow_ms,
+            "fast_window_s": fast_w,
+            "slow_window_s": slow_w,
+            "alert_severity": severity,
+            "alert_detection_ms": (round(detection_ms, 1)
+                                   if detection_ms is not None else None),
+            "detected_within_fast_window": (
+                detection_ms is not None and detection_ms <= fast_w * 1e3),
+            "alert_cleared": bool(cleared) and final_quiet,
+            "alert_firing_ms": (round(clear_ms, 1)
+                                if clear_ms is not None else None),
+            "slo_evaluations": evaluations,
+            "slo_eval_overhead_pct": (round(overhead_pct, 4)
+                                      if overhead_pct is not None else None),
+            "paged_on_healthy_traffic": paged_on_healthy,
+            "page_alerts_fired": int(metrics.counters("alerts.")["alerts.page"]),
+        }
+    finally:
+        slo.uninstall()
+        try:
+            paddle.set_flags(prev_flags)
+        except Exception:
+            pass
+
+
 def main():
+    if os.environ.get("BENCH_ONE") == "alerts":
+        print(json.dumps(_measure_alerts()))
+        return
     if os.environ.get("BENCH_ONE") == "spec":
         print(json.dumps(_measure_spec()))
         return
@@ -663,11 +849,13 @@ def main():
     budget_fleet = float(os.environ.get("BENCH_BUDGET_FLEET", 300))
     budget_procfleet = float(os.environ.get("BENCH_BUDGET_PROCFLEET", 300))
     budget_spec = float(os.environ.get("BENCH_BUDGET_SPEC", 300))
+    budget_alerts = float(os.environ.get("BENCH_BUDGET_ALERTS", 240))
     verdict = _probe_default_backend(timeout=75.0)
     extras = None
     fleet_info = None
     procfleet_info = None
     spec_info = None
+    alerts_info = None
     error = None
     fallback = None
     if verdict is None:
@@ -690,6 +878,11 @@ def main():
         except Exception as exc:
             procfleet_info = {"status": "error",
                               "error": f"{type(exc).__name__}: {exc}"}
+        try:
+            alerts_info = _measure_alerts()
+        except Exception as exc:
+            alerts_info = {"status": "error",
+                           "error": f"{type(exc).__name__}: {exc}"}
     else:
         import subprocess
 
@@ -745,6 +938,17 @@ def main():
         except Exception as exc:
             procfleet_info = {"status": "error",
                               "error": f"{type(exc).__name__}"}
+        # alerting arm (round 3): chaos spike -> page -> clear, plus the
+        # monitor's eval overhead — own budget and child like the others
+        try:
+            alerts_info = _child(force_cpu=(verdict is not True),
+                                 which="alerts", timeout=budget_alerts)
+        except subprocess.TimeoutExpired:
+            alerts_info = {"status": "timeout",
+                           "budget_seconds": budget_alerts}
+        except Exception as exc:
+            alerts_info = {"status": "error",
+                           "error": f"{type(exc).__name__}"}
 
     if extras is None:
         print(json.dumps({"metric": "gpt_serving_throughput", "value": None,
@@ -752,6 +956,7 @@ def main():
                           "requests_per_sec": None, "latency_p50_ms": None,
                           "latency_p99_ms": None, "fleet": fleet_info,
                           "procfleet": procfleet_info, "spec": spec_info,
+                          "alerts": alerts_info,
                           "error": error or "bench_error"}))
         return
 
@@ -792,6 +997,8 @@ def main():
         out["fleet"] = fleet_info
     if procfleet_info is not None:
         out["procfleet"] = procfleet_info
+    if alerts_info is not None:
+        out["alerts"] = alerts_info
     if fallback:
         out["fallback"] = fallback
     print(json.dumps(out))

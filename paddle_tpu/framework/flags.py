@@ -100,6 +100,10 @@ define_flag("FLAGS_run_log_keep", 16, "keep-last-k GC of stale run logs: when a 
 define_flag("FLAGS_trace", True, "distributed tracing plane (observability/trace.py): deterministic per-request/per-run trace ids propagated through ServingFleet submit->route->prefill->decode->requeue->delivery and run_resilient per-step/per-incident spans, emitted as 'span' run-log events; off allocates no ids and emits no span events (the bench's tracing-off arm)")
 define_flag("FLAGS_metrics_port", 0, "live metrics export (observability/exporter.py): serve /metrics (Prometheus text), /healthz and /snapshot (JSON) on this localhost port from a stdlib HTTP server started by ServingFleet and run_resilient workers; 0 (default) disables the server")
 define_flag("FLAGS_flightrec_events", 256, "crash flight recorder (observability/flightrec.py): dump the last N run-log ring events plus a metrics snapshot to flightrec-<pid>.json on replica death, DivergenceFault, PTA204/205 analysis errors and unhandled dispatch exceptions; 0 disables the recorder")
+define_flag("FLAGS_slo", False, "judgment layer (observability/slo.py + regress.py): auto-install the default SLO spec set on the first serving/training tick and evaluate it on the FLAGS_slo_eval_every_s cadence — error budgets, multi-window burn-rate alerts ('alert' run-log events, /alerts, degraded /healthz while a page fires) and the perf-regression sentinel; off keeps every tick-loop hook a single flag check (explicit slo.install() still works)")
+define_flag("FLAGS_slo_eval_every_s", 5.0, "SLOMonitor evaluation cadence in seconds: tick-loop hooks (scheduler/fleet/procfleet step, TrainStep.run_steps) evaluate the registered spec set at most this often; evaluation is host-side reads of the lock-free metrics registries — never a device sync")
+define_flag("FLAGS_slo_fast_window_s", 300.0, "fast burn-rate window (seconds) for SLO alerting: the page-severity window — a burn rate >= the spec's page_burn sustained over this window pages. ~5 minutes in production; tests and the bench alerting arm shrink it to sub-second")
+define_flag("FLAGS_slo_slow_window_s", 3600.0, "slow burn-rate window (seconds) for SLO alerting: the warn-severity window and the second gate of the page condition for ratio SLOs (classic multi-window burn-rate alerting). ~1 hour in production")
 
 # Fault-tolerance runtime (distributed/resilience.py).
 define_flag("FLAGS_collective_timeout_s", 0.0, "watchdog: report a cross-process collective still pending after this many seconds (0 = off)")

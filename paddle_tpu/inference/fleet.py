@@ -54,6 +54,7 @@ from ..analysis import sanitizer as _sanitizer
 from ..observability import exporter as _exporter
 from ..observability import flightrec as _flightrec
 from ..observability import runlog as _runlog
+from ..observability import slo as _slo
 from ..observability import trace as _trace
 from ..observability.metrics import counter_inc, gauge_set, observe
 from ..testing import chaos
@@ -438,6 +439,13 @@ class ServingFleet:
             _sanitizer.note_ledger(
                 "fleet", "requests", len(self.requests),
                 bound=2 * self.keep_finished + self.max_queue_depth)
+        alive = [rep for rep in self.replicas.values() if rep.alive]
+        if alive:
+            # in the in-process fleet the last tick's duration IS the
+            # heartbeat age: a straggling replica shows up as a long tick
+            gauge_set("fleet.heartbeat_staleness_seconds",
+                      max(rep.last_tick_seconds for rep in alive))
+        _slo.on_tick()  # judgment layer: single flag check until armed
         return done
 
     _TERMINAL = ("finished", "cancelled", "deadline_exceeded")

@@ -65,6 +65,7 @@ from ..analysis import sanitizer as _sanitizer
 from ..framework.flags import flag
 from ..observability import flightrec as _flightrec
 from ..observability import runlog as _runlog
+from ..observability import slo as _slo
 from ..observability import trace as _trace
 from ..observability.metrics import counter_inc, gauge_set, observe
 from ..testing import chaos
@@ -719,6 +720,11 @@ class ProcServingFleet:
                 continue  # noqa: PTA103 (host-side serving loop, never traced)
             self._sweep_beat(rep, done)
         gauge_set("fleet.queue_depth", self.queue_depth())
+        beats = [time.monotonic() - rep.last_beat
+                 for rep in self.replicas.values() if rep.alive]
+        if beats:
+            gauge_set("fleet.heartbeat_staleness_seconds", max(beats))
+        _slo.on_tick()  # judgment layer: single flag check until armed
         self._gc_ledger(protect={r.fid for r in done})
         if _sanitizer.enabled():
             # runtime PTA305: post-GC the ledger is keep-last-k + in-flight

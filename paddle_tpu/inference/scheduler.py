@@ -370,6 +370,11 @@ class ContinuousBatchingScheduler:
         fresh = ({rid for rid in self.finished if rid not in before}
                  | {rid for rid in self.cancelled if rid not in before_cancelled})
         self._gc_ledgers(protect=fresh)
+        from ..observability import slo as _slo
+
+        # judgment layer: cadence-gated host-side evaluate — a single flag
+        # check per tick until FLAGS_slo (or an explicit install) arms it
+        _slo.on_tick()
         return done
 
     def _gc_ledgers(self, protect=()) -> None:

@@ -514,6 +514,11 @@ class TrainStep:
         fp = getattr(getattr(self, "plan", None), "fingerprint", None)
         if fp and sp.seconds is not None:
             _measured.record(fp, sp.seconds, k)
+        from ..observability import slo as _slo
+
+        # judgment layer: cadence-gated host-side evaluate — a single flag
+        # check per dispatch until FLAGS_slo (or an explicit install) arms it
+        _slo.on_tick()
         return {name: _wrap_tree(v) for name, v in metrics.items()}
 
     def explain(self, analyze: bool = False) -> list:

@@ -29,17 +29,30 @@ PR 14 adds the cross-process plane on top:
   ring + metrics snapshot to ``flightrec-<pid>.json`` on replica death,
   DivergenceFault, PTA204/205 errors, and dispatch exceptions.
 - :mod:`.measured` — measured step times persisted per plan fingerprint
-  under ``FLAGS_compile_cache_dir/measured/``.
+  under ``FLAGS_compile_cache_dir/measured/`` (per-pid shards, merged on
+  load).
+
+PR 19 adds the judgment layer over the collection plane:
+
+- :mod:`.slo` — declarative SLO specs + :class:`~.slo.SLOMonitor`: error
+  budgets and multi-window burn-rate alerts (``alert`` run-log events,
+  ``/alerts``, degraded ``/healthz``) evaluated host-side on a cadence
+  from the serving/training tick loops (``FLAGS_slo``).
+- :mod:`.regress` — perf-regression sentinel: median+MAD drift detection
+  over every measured doc and the live serving rates, ``perf_regression``
+  events, flight record on the critical path.
 
 Everything is gated by ``FLAGS_monitor`` (default on; spans and events
 become no-ops when off); reading logs back is
 ``python -m paddle_tpu.observability report <run.jsonl>`` — or, fleet
-wide, ``report --merge <dir>`` / ``trace <dir> --out trace.json``.
+wide, ``report --merge <dir>`` / ``trace <dir> --out trace.json`` — and
+``watch <dir>`` renders the live fleet console (``--once`` for a CI
+snapshot).
 """
 from __future__ import annotations
 
 from . import exporter, flightrec, introspect, measured  # noqa: F401
-from . import metrics, runlog, spans, trace  # noqa: F401
+from . import metrics, regress, runlog, slo, spans, trace  # noqa: F401
 from .introspect import cost_summary, format_cost_table  # noqa: F401
 from .metrics import observe, prometheus_text, snapshot  # noqa: F401
 from .runlog import Monitor, emit, monitor  # noqa: F401
@@ -48,10 +61,10 @@ from .trace import attach, new_trace_id, span_event, trace_span  # noqa: F401
 
 __all__ = [
     "metrics", "runlog", "spans", "introspect", "trace", "exporter",
-    "flightrec", "measured", "Monitor", "monitor", "emit", "span", "Span",
-    "observe", "snapshot", "prometheus_text", "cost_summary",
-    "format_cost_table", "new_trace_id", "attach", "trace_span",
-    "span_event",
+    "flightrec", "measured", "slo", "regress", "Monitor", "monitor",
+    "emit", "span", "Span", "observe", "snapshot", "prometheus_text",
+    "cost_summary", "format_cost_table", "new_trace_id", "attach",
+    "trace_span", "span_event",
 ]
 
 # Pre-declare the runtime's counter series so a Prometheus scrape (or the
@@ -71,6 +84,7 @@ for _name in (
 ) + metrics.SERVING_COUNTERS + metrics.FLEET_COUNTERS + metrics.KERNEL_COUNTERS \
         + metrics.ANALYSIS_COUNTERS + metrics.HYGIENE_COUNTERS \
         + metrics.PLANNER_COUNTERS \
-        + metrics.RECSYS_COUNTERS + metrics.OBS_COUNTERS:
+        + metrics.RECSYS_COUNTERS + metrics.OBS_COUNTERS \
+        + metrics.SLO_COUNTERS:
     metrics.declare_counter(_name)
 del _name

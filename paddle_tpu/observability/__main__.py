@@ -47,6 +47,7 @@ import json
 import os
 import re
 import sys
+import time
 from collections import defaultdict
 from typing import Dict, List
 
@@ -778,6 +779,175 @@ def print_report(path: str, a: dict) -> None:
                   f"{row['fallback']}   [{impls}]")
 
 
+# --------------------------------------------------------------- watch verb
+_WATCH_WINDOW_S = 60.0
+
+
+def _scrape(address: str, path: str, timeout: float = 0.5):
+    """Best-effort GET http://<address><path> → parsed JSON, or None."""
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(f"http://{address}{path}",
+                                    timeout=timeout) as resp:
+            return json.loads(resp.read().decode())
+    except Exception:  # noqa: PTA105 (host-side scrape: dead exporter is normal)
+        return None
+
+
+def _watch_alert_key(ev: dict) -> str:
+    if ev.get("event") == "perf_regression":
+        return f"regress/{ev.get('kind')}/{ev.get('fingerprint')}"
+    return f"slo/{ev.get('slo')}"
+
+
+def build_watch_snapshot(root: str, window_s: float = _WATCH_WINDOW_S,
+                         scrape: bool = True) -> dict:
+    """One watch-console frame: tail every run log under ``root`` and
+    (optionally) scrape each discovered exporter's /alerts + /healthz.
+
+    The serving window anchors on the NEWEST event timestamp, not wall
+    time, so a snapshot of a finished run still renders its last minute
+    of traffic (the CI ``--once`` path)."""
+    procs = load_processes(root)
+    merged = merge_processes(procs)
+    latest = max((e["ts"] for e in merged
+                  if isinstance(e.get("ts"), (int, float))), default=0.0)
+    cutoff = latest - window_s
+    finished = [e for e in merged
+                if e.get("event") == "request" and e.get("status") == "finished"
+                and e.get("ts", 0.0) >= cutoff]
+    lat = sorted(float(e["total_seconds"]) for e in finished
+                 if e.get("total_seconds") is not None)
+    ttft = sorted(float(e["ttft_seconds"]) for e in finished
+                  if e.get("ttft_seconds") is not None)
+    span = (min(window_s, latest - min(e["ts"] for e in finished))
+            if finished else window_s)
+    span = max(1.0, span)  # burst logs written in one flush stay sane
+    serving = {
+        "requests": len(finished),
+        "rps": len(finished) / span if span > 0 else 0.0,
+        "p50_ms": _percentile(lat, 50) * 1e3 if lat else None,
+        "p99_ms": _percentile(lat, 99) * 1e3 if lat else None,
+        "ttft_p50_ms": _percentile(ttft, 50) * 1e3 if ttft else None,
+        "window_s": window_s,
+    }
+    # replica liveness: the newest membership event per process
+    membership: Dict[int, dict] = {}
+    for ev in merged:
+        if ev.get("event") == "fleet" and ev.get("kind") == "membership":
+            membership[ev["_pid"]] = {"alive": ev.get("alive") or [],
+                                      "dead": ev.get("dead") or []}
+    # firing alerts, replayed from the structured event stream: the last
+    # state transition per alert key wins
+    firing: Dict[str, dict] = {}
+    for ev in merged:
+        if ev.get("event") not in ("alert", "perf_regression"):
+            continue
+        key = _watch_alert_key(ev)
+        if ev.get("state") == "cleared":
+            firing.pop(key, None)
+        else:
+            firing[key] = ev
+    # exporter discovery (metrics_exporter events) + live scrape
+    exporters: Dict[int, dict] = {}
+    for ev in merged:
+        if ev.get("event") == "metrics_exporter" and ev.get("address"):
+            exporters[ev["_pid"]] = {"address": ev["address"]}
+    if scrape:
+        for doc in exporters.values():
+            alerts = _scrape(doc["address"], "/alerts")
+            health = _scrape(doc["address"], "/healthz")
+            doc["reachable"] = alerts is not None or health is not None  # noqa: PTA104 (host-side, never traced)
+            if health is not None:
+                doc["status"] = health.get("status",  # noqa: PTA104 (host-side, never traced)
+                                           "ok" if health.get("ok") else "degraded")
+            if alerts is not None:
+                doc["firing"] = alerts.get("firing", 0)  # noqa: PTA104 (host-side, never traced)
+                doc["page"] = alerts.get("page", 0)  # noqa: PTA104 (host-side, never traced)
+                for a in alerts.get("alerts", []):
+                    key = (f"slo/{a.get('slo')}" if a.get("slo")
+                           else f"regress/{a.get('kind')}/{a.get('fingerprint')}")
+                    firing.setdefault(key, a)
+    # local SLO state (a monitor installed in THIS process — the bench and
+    # the tests drive watch in-process): per-spec budget + burn table
+    from . import slo as _slo
+
+    mon = _slo.installed()
+    slo_states = mon.states() if mon is not None else []
+    return {"root": root, "latest_ts": latest,
+            "processes": {pid: {"events": len(info["events"]),
+                                "rank": info["rank"]}
+                          for pid, info in procs.items()},
+            "serving": serving, "membership": membership,
+            "alerts": sorted(firing.values(),
+                             key=lambda a: str(a.get("severity"))),
+            "slo": slo_states, "exporters": exporters}
+
+
+def _fmt_ms(v) -> str:
+    return "-" if v is None else f"{v:.1f}ms"
+
+
+def render_watch(snap: dict) -> str:
+    """Render one snapshot as the fleet console frame (plain text)."""
+    lines: List[str] = []
+    ts = time.strftime("%H:%M:%S", time.localtime(snap["latest_ts"] or time.time()))
+    nev = sum(p["events"] for p in snap["processes"].values())
+    lines.append(f"paddle_tpu watch — {snap['root']} @ {ts} "
+                 f"({len(snap['processes'])} process(es), {nev} events)")
+    s = snap["serving"]
+    lines.append(f"  serving   rps {s['rps']:6.1f}   p50 {_fmt_ms(s['p50_ms']):>9} "
+                 f"  p99 {_fmt_ms(s['p99_ms']):>9}   ttft p50 {_fmt_ms(s['ttft_p50_ms']):>9} "
+                 f"  ({s['requests']} finished / {s['window_s']:g}s window)")
+    for pid, m in sorted(snap["membership"].items()):
+        lines.append(f"  fleet     pid {pid}: {len(m['alive'])} alive "
+                     f"{sorted(m['alive'])}  {len(m['dead'])} dead {sorted(m['dead'])}")
+    for doc in snap["exporters"].values():
+        status = doc.get("status", "unreachable" if doc.get("reachable") is False else "?")
+        extra = (f"  firing {doc['firing']} (page {doc['page']})"
+                 if "firing" in doc else "")
+        lines.append(f"  exporter  {doc['address']}  healthz={status}{extra}")
+    for st in snap["slo"]:
+        sev = st["severity"] or "ok"
+        sli = "-" if st["sli"] is None else f"{st['sli']:.4g}"
+        lines.append(f"  slo       {st['slo']:<28} [{sev:>4}]  sli {sli:>8} "
+                     f" ({st['objective']})  burn {st['burn_fast']:.2f}/{st['burn_slow']:.2f} "
+                     f" budget {st['budget_remaining'] * 100:.0f}%")
+    if snap["alerts"]:
+        for a in snap["alerts"]:
+            name = a.get("slo") or a.get("fingerprint")
+            detail = (f"sli {a.get('sli'):.4g} vs {a.get('objective')}"
+                      if a.get("sli") is not None and a.get("objective")
+                      else f"{a.get('before')} -> {a.get('after')}")
+            lines.append(f"  ALERT     [{a.get('severity', '?'):>8}] {name}: {detail} "
+                         f" burn {a.get('burn_fast', 0) or 0:.2f}/{a.get('burn_slow', 0) or 0:.2f}")
+    else:
+        lines.append("  alerts    none firing")
+    return "\n".join(lines)
+
+
+def _cmd_watch(args) -> int:
+    if not collect_run_logs(args.path):
+        print(f"[watch] no run-*.jsonl under {args.path}", file=sys.stderr)  # noqa: PTA105 (host-side, never traced)
+        return 1
+    if args.once:
+        snap = build_watch_snapshot(args.path, args.window,
+                                    scrape=not args.no_scrape)
+        print(render_watch(snap))  # noqa: PTA105 (host-side console, never traced)
+        return 0
+    try:
+        while True:
+            snap = build_watch_snapshot(args.path, args.window,
+                                        scrape=not args.no_scrape)
+            # clear screen + home, then one frame — a live console
+            sys.stdout.write("\x1b[2J\x1b[H" + render_watch(snap) + "\n")
+            sys.stdout.flush()
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="python -m paddle_tpu.observability")
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -795,7 +965,21 @@ def main(argv=None) -> int:
     tr.add_argument("path", help="run-log directory (FLAGS_run_log_dir)")
     tr.add_argument("--out", default="trace.json",
                     help="output chrome-trace path (default: trace.json)")
+    w = sub.add_parser("watch", help="live fleet console: serving rps/p99/"
+                                     "TTFT, SLO burn + budget, replica "
+                                     "liveness, firing alerts")
+    w.add_argument("path", help="run-log directory (FLAGS_run_log_dir)")
+    w.add_argument("--once", action="store_true",
+                   help="render one snapshot and exit (CI-able)")
+    w.add_argument("--interval", type=float, default=2.0,
+                   help="refresh period in seconds (default: 2)")
+    w.add_argument("--window", type=float, default=_WATCH_WINDOW_S,
+                   help="serving-stats window in seconds (default: 60)")
+    w.add_argument("--no-scrape", action="store_true",
+                   help="skip scraping discovered exporters' /alerts+/healthz")
     args = p.parse_args(argv)
+    if args.cmd == "watch":
+        return _cmd_watch(args)
     if args.cmd == "trace":
         doc = chrome_trace_doc(args.path)
         n = sum(1 for ev in doc["traceEvents"] if ev.get("ph") != "M")

@@ -8,7 +8,13 @@ instead of only writing a post-mortem run log:
   (:func:`paddle_tpu.observability.metrics.prometheus_text`);
 - ``GET /healthz``  — JSON liveness: process pid/uptime plus every
   registered component health probe (fleet replica liveness, resilient
-  worker step progress); HTTP 200 when all probes pass, 503 otherwise;
+  worker step progress); HTTP 200 + ``status: "ok"`` when all probes
+  pass, 503 + ``status: "degraded"`` otherwise — the SLO monitor's probe
+  degrades it while any page-severity alert fires, so a load balancer
+  can rotate the process out before a human reads a dashboard;
+- ``GET /alerts``   — JSON of the currently-firing alerts from every
+  registered provider (the SLO engine's burn-rate alerts, the
+  perf-regression sentinel), ``{"alerts": [...], "firing": n, "page": n}``;
 - ``GET /snapshot`` — the full JSON metrics snapshot (counters, gauges,
   histogram summaries), the same document ``bench.py`` embeds.
 
@@ -34,13 +40,16 @@ from ..framework.flags import flag
 from . import metrics
 
 __all__ = ["MetricsExporter", "ensure_started", "register_health",
-           "current", "stop", "ADDR_KEY_PREFIX"]
+           "register_alerts", "current", "stop", "ADDR_KEY_PREFIX"]
 
 ADDR_KEY_PREFIX = "__obs__"
 
 # name -> zero-arg probe returning a JSON-able health doc; a probe that
 # raises or returns {"ok": False, ...} degrades /healthz to 503.
 _HEALTH: Dict[str, Callable[[], dict]] = {}
+# name -> zero-arg provider returning the currently-firing alert docs
+# (SLO engine, perf-regression sentinel); /alerts merges them all.
+_ALERTS: Dict[str, Callable[[], list]] = {}
 _EXPORTER: Optional["MetricsExporter"] = None
 _START_TIME = time.time()
 
@@ -55,6 +64,32 @@ def unregister_health(name: str) -> None:
     _HEALTH.pop(name, None)
 
 
+def register_alerts(name: str, provider: Callable[[], list]) -> None:
+    """Register (or replace) a firing-alerts provider merged into
+    ``/alerts``. The provider returns a list of JSON-able alert docs,
+    each with at least ``severity``."""
+    _ALERTS[name] = provider
+
+
+def unregister_alerts(name: str) -> None:
+    _ALERTS.pop(name, None)
+
+
+def _alerts_doc() -> dict:
+    alerts = []
+    for name, provider in list(_ALERTS.items()):  # noqa: PTA102 (host-side, never traced)
+        try:
+            for a in provider():
+                alerts.append(dict(a, source=name))  # noqa: PTA104 (host-side, never traced)
+        except Exception as exc:  # noqa: PTA105 (host-side provider guard, never traced)
+            alerts.append({"source": name, "severity": "warn",  # noqa: PTA104 (host-side, never traced)
+                           "error": f"{type(exc).__name__}: {exc}"})
+    page = sum(1 for a in alerts
+               if a.get("severity") in ("page", "critical"))
+    return {"ts": time.time(), "pid": os.getpid(),
+            "firing": len(alerts), "page": page, "alerts": alerts}
+
+
 def _health_doc() -> dict:
     components = {}
     ok = True
@@ -66,7 +101,8 @@ def _health_doc() -> dict:
         if not doc.get("ok", True):
             ok = False
         components[name] = doc  # noqa: PTA104 (host-side, never traced)
-    return {"ok": ok, "pid": os.getpid(),
+    return {"ok": ok, "status": "ok" if ok else "degraded",
+            "pid": os.getpid(),
             "uptime_seconds": time.time() - _START_TIME,
             "components": components}
 
@@ -82,6 +118,9 @@ class _Handler(BaseHTTPRequestHandler):
             doc = _health_doc()
             body = (json.dumps(doc, default=repr) + "\n").encode()
             ctype, code = "application/json", 200 if doc["ok"] else 503
+        elif path == "/alerts":
+            body = (json.dumps(_alerts_doc(), default=repr) + "\n").encode()
+            ctype, code = "application/json", 200
         elif path == "/snapshot":
             body = (json.dumps(metrics.snapshot(), default=repr) + "\n").encode()
             ctype, code = "application/json", 200

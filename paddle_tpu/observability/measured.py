@@ -5,13 +5,16 @@ model; the *measured* wall time of the plan that actually ran is strictly
 better evidence. ``TrainStep.run_steps`` reports every dispatch here and
 the samples accumulate under::
 
-    FLAGS_compile_cache_dir/measured/<fingerprint>.json
+    FLAGS_compile_cache_dir/measured/<fingerprint>.<pid>.json
 
-one JSON document per plan fingerprint (the schedule digest from
-``distributed.planner``; steps built without a plan key on a signature
-hash instead). This PR persists and schema-stabilizes the data; feeding
-it back into plan search is future work — the document format is the
-contract::
+one JSON shard per (plan fingerprint, writer pid). Sharding is the
+concurrency story: ``record`` only ever rewrites its *own* pid's shard
+(load-own → mutate → temp + atomic rename), so two processes recording
+the same fingerprint — a procfleet parent and a bench subprocess sharing
+``FLAGS_compile_cache_dir`` — can never lose each other's samples to a
+load→mutate→replace race. ``load`` merges every shard (plus any legacy
+un-sharded ``<fingerprint>.json`` doc from older writers) into one
+aggregate document; the merged schema is the contract::
 
     {"format": 1, "fingerprint": ..., "samples": <dispatch count>,
      "steps": <fused steps total>, "total_seconds": ...,
@@ -20,36 +23,91 @@ contract::
 
 Writes are atomic (temp + rename, the compile-cache idiom) and best
 effort: a read-only cache dir must never fail a training step. No-op when
-``FLAGS_compile_cache_dir`` is unset.
+``FLAGS_compile_cache_dir`` is unset. The perf-regression sentinel
+(:mod:`.regress`) reads these docs back — ``fingerprints()`` lists what
+is on disk.
 """
 from __future__ import annotations
 
 import json
 import os
-from typing import Optional
+import re
+from typing import List, Optional
 
 from ..framework.flags import flag
-from . import metrics
 
-__all__ = ["record", "load", "path_for"]
+__all__ = ["record", "load", "path_for", "shard_paths", "fingerprints"]
 
 _RECENT_KEEP = 64
+_SHARD_RE = re.compile(r"^(?P<fp>.+)\.(?P<pid>\d+)\.json$")
 
 
-def path_for(fingerprint: str) -> Optional[str]:
-    """Where ``fingerprint``'s measurement doc lives, or None when
-    persistence is off (no compile cache dir)."""
+def _measured_dir() -> Optional[str]:
     d = flag("FLAGS_compile_cache_dir")
     if not d:
         return None
-    return os.path.join(str(d), "measured", f"{fingerprint}.json")
+    return os.path.join(str(d), "measured")
 
 
-def load(fingerprint: str) -> Optional[dict]:
-    """The persisted measurement doc for ``fingerprint``, or None."""
-    path = path_for(fingerprint)
-    if path is None:
+def path_for(fingerprint: str) -> Optional[str]:
+    """Where ``fingerprint``'s legacy (un-sharded) measurement doc lives,
+    or None when persistence is off (no compile cache dir). Current
+    writers shard per pid — see :func:`shard_paths` for everything
+    :func:`load` merges."""
+    d = _measured_dir()
+    if d is None:
         return None
+    return os.path.join(d, f"{fingerprint}.json")
+
+
+def _shard_path(fingerprint: str, pid: Optional[int] = None) -> Optional[str]:
+    d = _measured_dir()
+    if d is None:
+        return None
+    return os.path.join(d, f"{fingerprint}.{pid or os.getpid()}.json")
+
+
+def shard_paths(fingerprint: str) -> List[str]:
+    """Every on-disk doc holding samples for ``fingerprint``: the legacy
+    combined ``<fp>.json`` (if an older writer left one) plus all per-pid
+    ``<fp>.<pid>.json`` shards, sorted for determinism."""
+    d = _measured_dir()
+    if d is None:
+        return []
+    out = []
+    legacy = os.path.join(d, f"{fingerprint}.json")
+    if os.path.exists(legacy):
+        out.append(legacy)
+    try:
+        names = os.listdir(d)
+    except OSError:
+        return out
+    for name in sorted(names):
+        m = _SHARD_RE.match(name)
+        if m and m.group("fp") == fingerprint:
+            out.append(os.path.join(d, name))  # noqa: PTA104 (host-side, never traced)
+    return out
+
+
+def fingerprints() -> List[str]:
+    """Distinct plan fingerprints with measurement docs on disk."""
+    d = _measured_dir()
+    if d is None:
+        return []
+    try:
+        names = os.listdir(d)
+    except OSError:
+        return []
+    fps = set()
+    for name in names:
+        if not name.endswith(".json"):
+            continue
+        m = _SHARD_RE.match(name)
+        fps.add(m.group("fp") if m else name[:-len(".json")])
+    return sorted(fps)
+
+
+def _read_doc(path: str) -> Optional[dict]:
     try:
         with open(path) as f:
             doc = json.load(f)
@@ -58,17 +116,44 @@ def load(fingerprint: str) -> Optional[dict]:
     return doc if doc.get("format") == 1 else None
 
 
+def load(fingerprint: str) -> Optional[dict]:
+    """The merged measurement doc for ``fingerprint`` (all pid shards +
+    any legacy combined doc), or None when nothing is persisted. Counts
+    sum across shards; ``recent_step_seconds`` concatenates shard recents
+    in ``updated_unix`` order and keeps the newest 64."""
+    docs = [d for d in (_read_doc(p) for p in shard_paths(fingerprint)) if d]
+    if not docs:
+        return None
+    docs.sort(key=lambda d: d.get("updated_unix", 0.0))
+    merged = {
+        "format": 1, "fingerprint": fingerprint,
+        "samples": sum(int(d.get("samples", 0)) for d in docs),
+        "steps": sum(int(d.get("steps", 0)) for d in docs),
+        "total_seconds": sum(float(d.get("total_seconds", 0.0)) for d in docs),
+        "updated_unix": max(float(d.get("updated_unix", 0.0)) for d in docs),
+    }
+    merged["mean_step_seconds"] = (
+        merged["total_seconds"] / merged["steps"] if merged["steps"] else 0.0)
+    recent: List[float] = []
+    for d in docs:
+        recent.extend(float(x) for x in d.get("recent_step_seconds", []))
+    merged["recent_step_seconds"] = recent[-_RECENT_KEEP:]
+    return merged
+
+
 def record(fingerprint: Optional[str], seconds: float,
            k: int = 1) -> Optional[str]:
     """Fold one measured dispatch (``k`` fused steps over ``seconds``
-    wall) into ``fingerprint``'s doc; returns the path written, or None
-    when persistence is off. Never raises."""
+    wall) into this process's shard of ``fingerprint``'s doc; returns the
+    shard path written, or None when persistence is off. Never raises.
+    Only the caller's own pid shard is rewritten, so concurrent writers
+    never drop each other's samples."""
     if not fingerprint:
         return None
-    path = path_for(fingerprint)
+    path = _shard_path(fingerprint)
     if path is None:
         return None
-    doc = load(fingerprint) or {
+    doc = _read_doc(path) or {
         "format": 1, "fingerprint": fingerprint, "samples": 0, "steps": 0,
         "total_seconds": 0.0, "recent_step_seconds": [],
     }
@@ -91,5 +176,7 @@ def record(fingerprint: Optional[str], seconds: float,
         os.replace(tmp, path)
     except OSError:
         return None
+    from . import metrics
+
     metrics.counter_inc("measured.persists")
     return path

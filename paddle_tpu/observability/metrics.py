@@ -55,7 +55,8 @@ class Histogram:
     updates. No allocation, no lock — single-writer-per-GIL-slice safe.
     """
 
-    __slots__ = ("bounds", "bucket_counts", "count", "sum", "min", "max")
+    __slots__ = ("bounds", "bucket_counts", "count", "sum", "min", "max",
+                 "overflow_min")
 
     def __init__(self, bounds: Optional[Iterable[float]] = None):
         self.bounds: Tuple[float, ...] = tuple(bounds) if bounds is not None else DEFAULT_BUCKETS
@@ -66,6 +67,10 @@ class Histogram:
         self.sum = 0.0
         self.min = math.inf
         self.max = -math.inf
+        # smallest value that landed in the overflow bucket: the overflow
+        # bucket's true lower edge for percentile interpolation (bounds[-1]
+        # is a lie when the whole distribution sits above it)
+        self.overflow_min = math.inf
 
     def observe(self, value: float) -> None:
         i = 0
@@ -74,6 +79,8 @@ class Histogram:
                 break
             i += 1
         self.bucket_counts[i] += 1
+        if i == len(self.bounds) and value < self.overflow_min:
+            self.overflow_min = value
         self.count += 1
         self.sum += value
         if value < self.min:
@@ -83,7 +90,14 @@ class Histogram:
 
     def percentile(self, q: float) -> Optional[float]:
         """Approximate percentile (0..100) by linear interpolation inside
-        the bucket holding the q-th observation; None when empty."""
+        the bucket holding the q-th observation; None when empty.
+
+        The overflow bucket anchors its low edge at ``overflow_min`` (the
+        smallest value actually observed past the last bound) instead of
+        ``bounds[-1]`` — with out-of-range distributions the old anchor
+        skewed percentiles toward the bound. All anchors degrade to bucket
+        bounds when the running min/max are not finite (delta histograms
+        built from bucket-count snapshots never observe values)."""
         if self.count == 0:
             return None
         target = max(1.0, (q / 100.0) * self.count)
@@ -92,14 +106,25 @@ class Histogram:
             if n == 0:
                 continue
             if seen + n >= target:
-                lo = self.bounds[i - 1] if i > 0 else min(self.min, self.bounds[0] if self.bounds else self.min)
-                hi = self.bounds[i] if i < len(self.bounds) else self.max
-                lo = max(lo, self.min)
-                hi = min(hi, self.max) if self.max >= lo else hi
+                if i >= len(self.bounds):  # overflow bucket
+                    lo = self.overflow_min
+                    if not math.isfinite(lo):
+                        lo = self.bounds[-1] if self.bounds else 0.0
+                    hi = self.max if math.isfinite(self.max) else lo
+                elif i > 0:
+                    lo, hi = self.bounds[i - 1], self.bounds[i]
+                else:
+                    lo = (self.min if math.isfinite(self.min)
+                          else min(0.0, self.bounds[0]))
+                    hi = self.bounds[0]
+                if math.isfinite(self.min):
+                    lo = max(lo, self.min)
+                if math.isfinite(self.max) and self.max >= lo:
+                    hi = min(hi, self.max)
                 frac = (target - seen) / n
                 return lo + (hi - lo) * frac
             seen += n
-        return self.max
+        return self.max if math.isfinite(self.max) else None
 
     def summary(self) -> dict:
         if self.count == 0:
@@ -268,6 +293,20 @@ OBS_COUNTERS: Tuple[str, ...] = (
 )
 
 
+# Judgment layer (PR 19: slo.py + regress.py — the detection plane over the
+# collection plane). slo.* meters the monitor itself (evaluations, specs
+# that violated their objective this pass); alerts.* is the burn-rate alert
+# ledger (fired/cleared transitions, split by severity); regress.* is the
+# perf-regression sentinel (histories checked, regressions fired/cleared,
+# flight records dumped on the critical path).
+SLO_COUNTERS: Tuple[str, ...] = (
+    "slo.evaluations", "slo.violations",
+    "alerts.fired", "alerts.cleared", "alerts.page", "alerts.warn",
+    "regress.checks", "regress.regressions", "regress.cleared",
+    "regress.flightrecs",
+)
+
+
 # Every gauge_set / observe call in paddle_tpu/ with a literal series name
 # must appear in the matching tuple below — tests/test_observability.py's
 # declaration drift guard greps the package and fails on a name set here
@@ -282,6 +321,11 @@ KNOWN_GAUGES: Tuple[str, ...] = (
     "serving.spec_acceptance_rate", "infer.kv_bytes_per_slot",
     "fleet.replicas_alive", "fleet.replicas_dead", "fleet.queue_depth",
     "stability.lr", "amp.loss_scale",
+    # judgment layer (PR 19): age of the stalest alive replica heartbeat
+    # (the runtime.heartbeat_staleness_s SLO input) and the count of SLOs
+    # currently firing an alert, split out for the page severity
+    "fleet.heartbeat_staleness_seconds",
+    "slo.firing", "slo.firing_page",
 )
 
 KNOWN_HISTOGRAMS: Tuple[str, ...] = (
@@ -290,6 +334,9 @@ KNOWN_HISTOGRAMS: Tuple[str, ...] = (
     "serving.queue_seconds", "serving.latency_seconds",
     "fleet.latency_seconds",
     "hapi.step",
+    # judgment layer (PR 19): cost of one SLOMonitor.evaluate pass — the
+    # series behind the bench's slo_eval_overhead_pct budget
+    "slo.eval_seconds",
 )
 
 

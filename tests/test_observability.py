@@ -3,9 +3,11 @@ compiled-program introspection, report CLI, and the profiler satellites
 (host-event leak, Profiler.step, stop/export hardening, chrome fallback).
 """
 import json
+import math
 import os
 import re
 import time
+from unittest import mock
 
 import numpy as np
 import pytest
@@ -59,6 +61,56 @@ class TestMetricsRegistry:
             h.observe(v)
         assert h.bucket_counts == [1, 1, 2]  # overflow bucket catches the tail
         assert h.count == 4
+
+    def test_percentile_single_sample(self):
+        h = metrics.Histogram(bounds=[1.0, 10.0])
+        h.observe(5.0)
+        assert h.percentile(50) == pytest.approx(5.0)
+        assert h.percentile(99) == pytest.approx(5.0)
+
+    def test_percentile_empty_is_none(self):
+        assert metrics.Histogram(bounds=[1.0]).percentile(50) is None
+
+    def test_percentile_all_overflow_anchors_on_observed_min(self):
+        """Every sample past the last bound: the overflow bucket's low edge
+        is the smallest observed overflow value, not bounds[-1]."""
+        h = metrics.Histogram(bounds=[1.0])
+        for v in (50.0, 60.0, 70.0):
+            h.observe(v)
+        p50 = h.percentile(50)
+        assert 50.0 <= p50 <= 70.0
+        assert p50 == pytest.approx(60.0)
+        assert h.percentile(100) == pytest.approx(70.0)
+
+    def test_percentile_mixed_overflow_not_skewed_to_last_bound(self):
+        """A percentile landing in the overflow bucket must interpolate
+        from where the overflow population actually starts (10), not from
+        bounds[-1] (1) — the old anchor skewed it low."""
+        h = metrics.Histogram(bounds=[1.0])
+        for v in (0.5, 10.0, 20.0, 30.0):
+            h.observe(v)
+        p50 = h.percentile(50)
+        assert p50 > h.bounds[-1]
+        assert 10.0 <= p50 <= 30.0
+
+    def test_percentile_delta_histogram_without_extrema(self):
+        """The SLO monitor builds window-delta histograms from bucket-count
+        snapshots: min/max/overflow_min are never observed and stay
+        non-finite. percentile() must interpolate on bucket bounds alone —
+        finite, never NaN."""
+        h = metrics.Histogram(bounds=[1.0, 2.0])
+        h.bucket_counts = [0, 3, 2]
+        h.count = 5
+        p50 = h.percentile(50)
+        assert p50 is not None and math.isfinite(p50)
+        assert 1.0 <= p50 <= 2.0
+        p99 = h.percentile(99)  # lands in the overflow bucket
+        assert p99 is not None and math.isfinite(p99)
+        assert p99 >= 2.0
+        empty = metrics.Histogram(bounds=[1.0])
+        empty.bucket_counts = [0, 0]
+        empty.count = 0
+        assert empty.percentile(50) is None
 
     def test_declared_counters_survive_reset(self):
         metrics.counter_inc("executor.runs", 3)
@@ -551,6 +603,29 @@ class TestDeclarationDriftGuard:
         for name in metrics.OBS_COUNTERS:
             assert name in metrics._DECLARED_COUNTERS
 
+    def test_slo_counters_declared(self):
+        """slo.* / alerts.* / regress.* series export from an idle process
+        (declared at 0) — the SLO engine's scrapes need no warm-up."""
+        for name in metrics.SLO_COUNTERS:
+            assert name in metrics._DECLARED_COUNTERS
+        assert "slo.firing" in metrics.KNOWN_GAUGES
+        assert "slo.firing_page" in metrics.KNOWN_GAUGES
+        assert "fleet.heartbeat_staleness_seconds" in metrics.KNOWN_GAUGES
+        assert "slo.eval_seconds" in metrics.KNOWN_HISTOGRAMS
+
+    def test_default_slo_specs_documented_in_readme(self):
+        """Every shipped SLO spec name appears in README's SLO table — the
+        spec set and its documentation cannot drift apart."""
+        from paddle_tpu.observability import slo
+
+        readme = open(os.path.join(os.path.dirname(__file__), os.pardir,
+                                   "README.md")).read()
+        specs = slo.default_specs()
+        assert len(specs) >= 10
+        missing = [s.name for s in specs if s.name not in readme]
+        assert not missing, (
+            f"default SLO specs missing from README's SLO table: {missing}")
+
 
 # -------------------------------------- Prometheus conformance + golden pin
 class TestPrometheusConformance:
@@ -625,7 +700,9 @@ class TestMeasuredStepTimes:
         from paddle_tpu.observability import measured
 
         p = measured.record("fp123", 0.25, k=5)
-        assert p == str(cache_dir / "measured" / "fp123.json")
+        # writers shard per pid; load() merges shards + any legacy doc
+        assert p == str(
+            cache_dir / "measured" / f"fp123.{os.getpid()}.json")
         measured.record("fp123", 0.15, k=5)
         doc = measured.load("fp123")
         assert doc["format"] == 1
@@ -638,6 +715,47 @@ class TestMeasuredStepTimes:
         # a corrupt doc reads as absent, not a crash
         open(p, "w").write("not json{")
         assert measured.load("fp123") is None
+
+    def test_two_writers_never_lose_samples(self, cache_dir):
+        """Regression for the load->mutate->replace race: two interleaved
+        writer pids each rewrite only their own shard, so neither can
+        clobber the other's samples. Before sharding, the loser of the
+        interleave silently dropped the winner's doc."""
+        from paddle_tpu.observability import measured
+
+        real_pid = os.getpid()
+        # interleave A, B, A, B on one fingerprint
+        measured.record("fp_race", 0.10, k=1)
+        with mock.patch.object(os, "getpid", return_value=real_pid + 1):
+            measured.record("fp_race", 0.20, k=1)
+            with mock.patch.object(os, "getpid", return_value=real_pid):
+                measured.record("fp_race", 0.30, k=1)
+            measured.record("fp_race", 0.40, k=1)
+        doc = measured.load("fp_race")
+        assert doc["samples"] == 4 and doc["steps"] == 4
+        assert abs(doc["total_seconds"] - 1.00) < 1e-9
+        assert sorted(doc["recent_step_seconds"]) == pytest.approx(
+            [0.10, 0.20, 0.30, 0.40])
+        assert len(measured.shard_paths("fp_race")) == 2
+        assert "fp_race" in measured.fingerprints()
+
+    def test_load_merges_legacy_unsharded_doc(self, cache_dir):
+        """Docs left by pre-sharding writers (<fp>.json) still count."""
+        from paddle_tpu.observability import measured
+
+        legacy = cache_dir / "measured"
+        legacy.mkdir()
+        (legacy / "fp_old.json").write_text(json.dumps({
+            "format": 1, "fingerprint": "fp_old", "samples": 3, "steps": 3,
+            "total_seconds": 0.3, "mean_step_seconds": 0.1,
+            "recent_step_seconds": [0.1, 0.1, 0.1], "updated_unix": 1.0}))
+        measured.record("fp_old", 0.2, k=1)
+        doc = measured.load("fp_old")
+        assert doc["samples"] == 4 and doc["steps"] == 4
+        assert abs(doc["total_seconds"] - 0.5) < 1e-9
+        # legacy recents order before the newer shard's
+        assert doc["recent_step_seconds"] == pytest.approx(
+            [0.1, 0.1, 0.1, 0.2])
 
     def test_noop_without_cache_dir(self):
         from paddle_tpu.observability import measured

@@ -407,6 +407,99 @@ class TestExporter:
             exporter.unregister_health("t_bad")
             exp.stop()
 
+    def _get_with_headers(self, port, path):
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
+                                    timeout=5) as r:
+            return r.status, dict(r.headers), r.read().decode()
+
+    def test_alerts_endpoint_schema_and_content_type(self):
+        """/alerts is JSON with the pinned envelope; registered providers'
+        docs merge in tagged with their source; a raising provider yields
+        a warn doc instead of a 500."""
+        exp = exporter.MetricsExporter(port=0).start()
+        exporter.register_alerts(
+            "t_prov", lambda: [{"slo": "t.x", "severity": "page",
+                                "burn_fast": 20.0}])
+
+        def boom():
+            raise RuntimeError("provider died")
+
+        exporter.register_alerts("t_boom", boom)
+        try:
+            code, headers, text = self._get_with_headers(exp.port, "/alerts")
+            assert code == 200
+            assert headers["Content-Type"] == "application/json"
+            doc = json.loads(text)
+            assert doc["pid"] == os.getpid() and doc["ts"] > 0
+            assert doc["firing"] == len(doc["alerts"]) == 2
+            assert doc["page"] == 1
+            by_src = {a["source"]: a for a in doc["alerts"]}
+            assert by_src["t_prov"]["slo"] == "t.x"
+            assert by_src["t_prov"]["burn_fast"] == 20.0
+            assert "RuntimeError" in by_src["t_boom"]["error"]
+            assert by_src["t_boom"]["severity"] == "warn"
+        finally:
+            exporter.unregister_alerts("t_prov")
+            exporter.unregister_alerts("t_boom")
+            exp.stop()
+
+    def test_healthz_ok_degraded_ok_cycle(self):
+        """healthz flips 200/ok -> 503/degraded -> 200/ok as a probe's
+        verdict changes — the load-balancer rotation contract."""
+        exp = exporter.MetricsExporter(port=0).start()
+        verdict = {"ok": True}
+        exporter.register_health("t_cycle", lambda: dict(verdict))
+        try:
+            code, _, text = self._get_with_headers(exp.port, "/healthz")
+            assert code == 200
+            assert json.loads(text)["status"] == "ok"
+            verdict["ok"] = False
+            try:
+                self._get(exp.port, "/healthz")
+                code, text = None, None
+            except urllib.error.HTTPError as e:
+                code, text = e.code, e.read().decode()
+            assert code == 503
+            doc = json.loads(text)
+            assert doc["status"] == "degraded" and doc["ok"] is False
+            verdict["ok"] = True
+            code, _, text = self._get_with_headers(exp.port, "/healthz")
+            assert code == 200
+            assert json.loads(text)["status"] == "ok"
+        finally:
+            exporter.unregister_health("t_cycle")
+            exp.stop()
+
+    def test_ensure_started_republishes_addr(self):
+        """Repeat ensure_started calls re-publish the bound address — a
+        restarted TCPStore (fresh kv) relearns the scrape target."""
+        class FakeStore:
+            def __init__(self):
+                self.kv = {}
+
+            def set(self, k, v):
+                self.kv[k] = v
+
+        import socket
+
+        probe = socket.socket()
+        probe.bind(("127.0.0.1", 0))
+        free_port = probe.getsockname()[1]
+        probe.close()
+        paddle.set_flags({"FLAGS_metrics_port": free_port})
+        try:
+            store = FakeStore()
+            exp = exporter.ensure_started(store=store, rank=1)
+            assert exp is not None
+            key = f"{exporter.ADDR_KEY_PREFIX}/1/metrics_addr"
+            assert store.kv[key] == exp.address
+            store.kv.clear()  # simulate a store restart losing the key
+            assert exporter.ensure_started(store=store, rank=1) is exp
+            assert store.kv[key] == exp.address
+        finally:
+            paddle.set_flags({"FLAGS_metrics_port": 0})
+            exporter.stop()
+
     def test_ensure_started_gated_by_flag_and_publishes_addr(self):
         import socket
 
