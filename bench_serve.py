@@ -56,7 +56,21 @@ Prints ONE JSON line on the bench.py schema: {"metric", "value", "unit",
    the fast window), ``alert_firing_ms`` (page → cleared once the spike
    ages out of the windows under recovery traffic), and
    ``slo_eval_overhead_pct`` — the monitor's evaluation cost over the
-   serving run's wall time at a 50ms cadence (< 2% budget).
+   serving run's wall time at a 50ms cadence (< 2% budget);
+8. **ingress phase** (own ``BENCH_BUDGET_INGRESS`` budget, own
+   subprocess): the round-4 HTTP front door + socket fast path —
+   ``ingress_requests_per_sec`` through ``ServingIngress`` vs the same
+   fleet driven in-process (``requests_per_sec_inproc``),
+   ``socket_vs_store_overhead_pct``: the socket-transport fleet's wall
+   time vs the identical workload on the store-poll transport
+   (negative == the fast path is faster), ``stream_ttft_p50_ms`` over
+   HTTP chunked streaming, ``disconnect_cancel_ms`` (client socket
+   dropped mid-stream → mid-decode cancel observed),
+   ``drain_under_load_ms`` (SIGTERM-style drain with requests in flight:
+   rc 0, every accepted request finished), and the end-to-end chaos pin:
+   replica ``kill -9`` mid-decode UNDER the ingress with streams open —
+   every HTTP stream completes bitwise-identical to the unkilled
+   reference (``exactly_once_under_sigkill``).
 
 Like bench.py, the process NEVER hangs into the driver's timeout and never
 exits non-zero: the default backend is probed in a throwaway child first and
@@ -826,6 +840,256 @@ def _measure_alerts():
             pass
 
 
+def _measure_ingress():
+    """The round-4 front-door phase: HTTP ingress over the cross-process
+    fleet on the socket fast path. Measures the HTTP hop against the same
+    fleet driven in-process, the socket transport against the store-poll
+    transport on an identical workload, streaming TTFT over chunked
+    transfer, the disconnect→cancel reaction, a drain under load, and the
+    headline chaos pin: ``kill -9`` of a replica mid-decode with HTTP
+    streams open — every stream must complete bitwise-identical to the
+    unkilled reference, exactly once, through the real socket path."""
+    import http.client
+    import threading
+
+    import jax
+
+    if os.environ.get("BENCH_FORCE_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+
+    import paddle_tpu as paddle
+    from paddle_tpu.inference import ProcServingFleet, ServingIngress
+    from paddle_tpu.models.gpt import GPTConfig
+    from paddle_tpu.testing import chaos
+
+    d0 = jax.devices()[0]
+    on_tpu = d0.platform in ("tpu", "axon") or "TPU" in getattr(d0, "device_kind", "")
+    if on_tpu:
+        cfg = GPTConfig(vocab_size=50304, hidden_size=1024, num_layers=16,
+                        num_heads=16, max_seq_len=1024)
+        slots, max_seq, max_new, n_requests = 8, 1024, 16, 16
+        chunk, fuse, n_replicas = 128, 8, 2
+    else:
+        cfg = GPTConfig.tiny()
+        slots, max_seq, max_new, n_requests = 2, 128, 8, 8
+        chunk, fuse, n_replicas = 16, 2, 2
+
+    rng = np.random.default_rng(0)
+    kw = dict(max_batch_slots=slots, max_seq_len=max_seq, prefill_chunk=chunk,
+              fuse=fuse, heartbeat_timeout=120.0)
+    prompts = [rng.integers(0, cfg.vocab_size, (int(n),)).astype("int32")
+               for n in rng.integers(max(1, chunk // 4), chunk, n_requests)]
+    bodies = [{"prompt": [int(t) for t in p], "max_new_tokens": max_new,
+               "seed": i} for i, p in enumerate(prompts)]
+
+    def _post(port, body, stream=False, key=None, timeout=600):
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+        headers = {"Content-Type": "application/json"}
+        if key:
+            headers["Idempotency-Key"] = key
+        conn.request("POST", "/v1/generate",
+                     body=json.dumps(body).encode(), headers=headers)
+        r = conn.getresponse()
+        if not stream:
+            doc = json.loads(r.read())
+            conn.close()
+            return r.status, doc, None
+        toks, t_first, final = [], None, None
+        while True:
+            line = r.readline()
+            if not line:
+                break
+            doc = json.loads(line)
+            if t_first is None:
+                t_first = time.perf_counter()
+            if "tokens" in doc:
+                toks.extend(doc["tokens"])
+            else:
+                final = doc
+        conn.close()
+        return r.status, {"tokens": toks, "final": final}, t_first
+
+    import tempfile
+
+    cache_dir = tempfile.mkdtemp(prefix="bench_ingress_aot_")
+    paddle.set_flags({"FLAGS_compile_cache_dir": cache_dir})
+    try:
+        # --- socket-transport fleet: in-process reference, then HTTP -----
+        pf = ProcServingFleet(cfg, replicas=n_replicas, **kw)
+        try:
+            # untimed warm-up: the children compile their program family on
+            # first prefill — both transport arms are timed warm
+            for i, p in enumerate(prompts):
+                pf.submit(p, max_new_tokens=max_new, seed=500 + i)
+            pf.run(timeout_s=600)
+            fids = [pf.submit(p, max_new_tokens=max_new, seed=i)
+                    for i, p in enumerate(prompts)]
+            t0 = time.perf_counter()
+            pf.run(timeout_s=600)
+            dt_direct = time.perf_counter() - t0
+            assert all(pf.requests[f].status == "finished" for f in fids), \
+                "direct run lost completions"
+            want = [list(pf.requests[f].tokens) for f in fids]
+            rps_direct = len(fids) / dt_direct if dt_direct > 0 else None
+
+            ing = ServingIngress(pf, port=0)
+            results = [None] * n_requests
+            nthreads = min(4, n_requests)
+
+            def http_worker(idxs):
+                for i in idxs:
+                    st, doc, _ = _post(ing.port, bodies[i])
+                    results[i] = (st, doc)
+
+            t0 = time.perf_counter()
+            ts = [threading.Thread(target=http_worker,
+                                   args=(range(k, n_requests, nthreads),))
+                  for k in range(nthreads)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            dt_http = time.perf_counter() - t0
+            for i, (st, doc) in enumerate(results):
+                assert st == 200 and doc["status"] == "finished", (st, doc)
+                assert doc["tokens"] == want[i], "http run diverged"
+            rps_http = n_requests / dt_http if dt_http > 0 else None
+
+            # streaming TTFT over HTTP (sequential — isolates the hop)
+            ttfts = []
+            for i in range(min(4, n_requests)):
+                t0 = time.perf_counter()
+                st, doc, t_first = _post(ing.port, dict(bodies[i], stream=True),
+                                         stream=True)
+                assert st == 200 and doc["tokens"] == want[i], "stream diverged"
+                ttfts.append(t_first - t0)
+            ttfts.sort()
+
+            # client disconnect mid-stream -> mid-decode cancel
+            long_body = dict(bodies[0], max_new_tokens=max_new * 8,
+                             stream=True)
+            conn = http.client.HTTPConnection("127.0.0.1", ing.port,
+                                              timeout=600)
+            conn.request("POST", "/v1/generate",
+                         body=json.dumps(long_body).encode(),
+                         headers={"Idempotency-Key": "bench-disconnect"})
+            r = conn.getresponse()
+            r.readline()  # one chunk is flowing; the request is mid-decode
+            fid = ing._idem["bench-disconnect"].fid
+            t0 = time.perf_counter()
+            conn.sock.close()
+            conn.close()
+            while (pf.requests[fid].status not in
+                   ("finished", "cancelled", "deadline_exceeded")
+                   and time.perf_counter() - t0 < 30):
+                time.sleep(0.002)
+            disconnect_ms = (time.perf_counter() - t0) * 1e3
+            disconnect_status = pf.requests[fid].status
+
+            # drain under load: requests in flight when the drain begins
+            drain_docs = []
+
+            def drain_worker(i):
+                _, doc, _ = _post(ing.port, dict(bodies[i], seed=100 + i))
+                drain_docs.append(doc)
+
+            dts = [threading.Thread(target=drain_worker, args=(i,))
+                   for i in range(3)]
+            for t in dts:
+                t.start()
+            t0 = time.perf_counter()
+            while len(ing._active) < 3 and time.perf_counter() - t0 < 30:
+                time.sleep(0.002)
+            t0 = time.perf_counter()
+            ing.begin_drain()
+            drain_rc = ing.drain(grace=300)
+            drain_ms = (time.perf_counter() - t0) * 1e3
+            for t in dts:
+                t.join()
+            drain_finished = sum(1 for d in drain_docs
+                                 if d.get("status") == "finished")
+        finally:
+            pf.shutdown()
+
+        # --- store-poll transport: identical workload, sockets off -------
+        pf_s = ProcServingFleet(cfg, replicas=n_replicas, use_sockets=False,
+                                **kw)
+        try:
+            for i, p in enumerate(prompts):  # warm, like the socket arm
+                pf_s.submit(p, max_new_tokens=max_new, seed=500 + i)
+            pf_s.run(timeout_s=600)
+            fids = [pf_s.submit(p, max_new_tokens=max_new, seed=i)
+                    for i, p in enumerate(prompts)]
+            t0 = time.perf_counter()
+            pf_s.run(timeout_s=600)
+            dt_store = time.perf_counter() - t0
+            assert all(pf_s.requests[f].status == "finished" for f in fids), \
+                "store run lost completions"
+            got = [list(pf_s.requests[f].tokens) for f in fids]
+            assert got == want, "store transport diverged"
+        finally:
+            pf_s.shutdown()
+
+        # --- kill -9 through the ingress: bitwise exactly-once -----------
+        with chaos.inject(
+                FLAGS_chaos_replica_sigkill_at=f"{n_replicas - 1}:2"):
+            pf_k = ProcServingFleet(cfg, replicas=n_replicas, **kw)
+            ing_k = ServingIngress(pf_k, port=0)
+            try:
+                kill_docs = [None] * 4
+
+                def kill_worker(i):
+                    st, doc, _ = _post(ing_k.port,
+                                       dict(bodies[i], stream=True),
+                                       stream=True)
+                    kill_docs[i] = (st, doc)
+
+                kts = [threading.Thread(target=kill_worker, args=(i,))
+                       for i in range(4)]
+                for t in kts:
+                    t.start()
+                for t in kts:
+                    t.join()
+                for i, (st, doc) in enumerate(kill_docs):
+                    assert st == 200, f"kill arm http {st}"
+                    assert doc["final"]["status"] == "finished", doc["final"]
+                    assert doc["tokens"] == want[i], \
+                        f"kill arm diverged on stream {i}"
+                stats_k = pf_k.stats()
+            finally:
+                ing_k.stop()
+                pf_k.shutdown()
+    finally:
+        try:
+            paddle.set_flags({"FLAGS_compile_cache_dir": ""})
+        except Exception:
+            pass
+
+    socket_vs_store = ((dt_direct / dt_store - 1.0) * 100.0
+                       if dt_store > 0 else None)
+    return {
+        "replicas": n_replicas,
+        "requests": n_requests,
+        "ingress_requests_per_sec": round(rps_http, 3) if rps_http else None,
+        "requests_per_sec_inproc": round(rps_direct, 3) if rps_direct else None,
+        "http_overhead_pct": (round((dt_http / dt_direct - 1.0) * 100.0, 2)
+                              if dt_direct > 0 else None),
+        "socket_vs_store_overhead_pct": (round(socket_vs_store, 2)
+                                         if socket_vs_store is not None
+                                         else None),
+        "stream_ttft_p50_ms": round(_percentile(ttfts, 50) * 1e3, 2),
+        "disconnect_cancel_ms": round(disconnect_ms, 2),
+        "disconnect_status": disconnect_status,
+        "drain_under_load_ms": round(drain_ms, 2),
+        "drain_rc": drain_rc,
+        "drain_finished": drain_finished,
+        "drain_inflight": len(drain_docs),
+        "exactly_once_under_sigkill": True,  # asserted above, bitwise
+        "requeues_under_sigkill": stats_k["requeues"],
+        "replica_deaths": len(stats_k["dead"]),
+    }
+
+
 def main():
     if os.environ.get("BENCH_ONE") == "alerts":
         print(json.dumps(_measure_alerts()))
@@ -839,6 +1103,9 @@ def main():
     if os.environ.get("BENCH_ONE") == "procfleet":
         print(json.dumps(_measure_procfleet()))
         return
+    if os.environ.get("BENCH_ONE") == "ingress":
+        print(json.dumps(_measure_ingress()))
+        return
     if os.environ.get("BENCH_ONE"):
         print(json.dumps(_measure()))
         return
@@ -850,12 +1117,14 @@ def main():
     budget_procfleet = float(os.environ.get("BENCH_BUDGET_PROCFLEET", 300))
     budget_spec = float(os.environ.get("BENCH_BUDGET_SPEC", 300))
     budget_alerts = float(os.environ.get("BENCH_BUDGET_ALERTS", 240))
+    budget_ingress = float(os.environ.get("BENCH_BUDGET_INGRESS", 420))
     verdict = _probe_default_backend(timeout=75.0)
     extras = None
     fleet_info = None
     procfleet_info = None
     spec_info = None
     alerts_info = None
+    ingress_info = None
     error = None
     fallback = None
     if verdict is None:
@@ -883,6 +1152,11 @@ def main():
         except Exception as exc:
             alerts_info = {"status": "error",
                            "error": f"{type(exc).__name__}: {exc}"}
+        try:
+            ingress_info = _measure_ingress()
+        except Exception as exc:
+            ingress_info = {"status": "error",
+                            "error": f"{type(exc).__name__}: {exc}"}
     else:
         import subprocess
 
@@ -949,6 +1223,17 @@ def main():
         except Exception as exc:
             alerts_info = {"status": "error",
                            "error": f"{type(exc).__name__}"}
+        # ingress phase (round 4): HTTP front door + socket fast path under
+        # real SIGKILL — own budget and child like the other fleet phases
+        try:
+            ingress_info = _child(force_cpu=(verdict is not True),
+                                  which="ingress", timeout=budget_ingress)
+        except subprocess.TimeoutExpired:
+            ingress_info = {"status": "timeout",
+                            "budget_seconds": budget_ingress}
+        except Exception as exc:
+            ingress_info = {"status": "error",
+                            "error": f"{type(exc).__name__}"}
 
     if extras is None:
         print(json.dumps({"metric": "gpt_serving_throughput", "value": None,
@@ -956,7 +1241,7 @@ def main():
                           "requests_per_sec": None, "latency_p50_ms": None,
                           "latency_p99_ms": None, "fleet": fleet_info,
                           "procfleet": procfleet_info, "spec": spec_info,
-                          "alerts": alerts_info,
+                          "alerts": alerts_info, "ingress": ingress_info,
                           "error": error or "bench_error"}))
         return
 
@@ -999,6 +1284,8 @@ def main():
         out["procfleet"] = procfleet_info
     if alerts_info is not None:
         out["alerts"] = alerts_info
+    if ingress_info is not None:
+        out["ingress"] = ingress_info
     if fallback:
         out["fallback"] = fallback
     print(json.dumps(out))

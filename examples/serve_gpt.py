@@ -19,9 +19,12 @@ batching.
 5. (``--fleet``) the fault-tolerant fleet tier: 2 engine replicas behind
    the prefix-affinity router, a chaos-injected replica kill mid-stream,
    and every request finishing exactly once with tokens bitwise-equal to
-   the unkilled run — plus a load-shed and a deadline expiry.
+   the unkilled run — plus a load-shed and a deadline expiry;
+6. (``--http``) the network boundary: ``ServingIngress`` in front of the
+   fleet — a real HTTP POST, an idempotent retry replaying the same
+   answer, a chunked per-token stream, and a graceful drain to exit 0.
 
-Run:  python examples/serve_gpt.py [--fleet]
+Run:  python examples/serve_gpt.py [--fleet] [--http]
 """
 import os
 import sys
@@ -111,6 +114,10 @@ def main():
     if "--fleet" in sys.argv:
         fleet_stage(model, rng, cfg)
 
+    # 6) (--http) the network boundary: HTTP front door over the fleet
+    if "--http" in sys.argv:
+        http_stage(model, rng, cfg)
+
 
 def fleet_stage(model, rng, cfg):
     from paddle_tpu.inference import FleetOverloadError, ServingFleet
@@ -150,6 +157,54 @@ def fleet_stage(model, rng, cfg):
     except FleetOverloadError as e:
         print(f"  overload shed: {e}")
     small.run()
+
+
+def http_stage(model, rng, cfg):
+    import http.client
+    import json
+
+    from paddle_tpu.inference import ServingFleet, ServingIngress
+
+    kw = dict(max_batch_slots=2, max_seq_len=64, prefill_chunk=8, fuse=2)
+    fleet = ServingFleet(model, replicas=2, **kw)
+    ing = ServingIngress(fleet, port=0)
+    prompt = rng.integers(0, cfg.vocab_size, (6,)).astype("int32").tolist()
+
+    def post(body, key=None, stream=False):
+        conn = http.client.HTTPConnection("127.0.0.1", ing.port, timeout=60)
+        hdrs = {"Content-Type": "application/json"}
+        if key:
+            hdrs["Idempotency-Key"] = key
+        conn.request("POST", "/v1/generate", json.dumps(body), hdrs)
+        resp = conn.getresponse()
+        if stream:
+            lines = [json.loads(ln) for ln in resp.read().splitlines() if ln]
+            conn.close()
+            return lines
+        doc = json.loads(resp.read())
+        conn.close()
+        return doc
+
+    # a real request over the wire, then an idempotent retry of the same
+    # key: the ingress replays the ledger answer, never re-generates
+    body = {"prompt": prompt, "max_new_tokens": 8, "seed": 7}
+    first = post(body, key="example-1")
+    again = post(body, key="example-1")
+    replay = first["tokens"] == again["tokens"] and first["fid"] == again["fid"]
+    print(f"http: POST /v1/generate -> {first['status']}, "
+          f"{len(first['tokens'])} tokens; idempotent retry replayed "
+          f"fid {again['fid']}: {replay}")
+
+    # per-token chunked streaming rides the same exactly-once ledger
+    lines = post(dict(body, seed=8, stream=True), stream=True)
+    toks = [t for ln in lines if "tokens" in ln for t in ln["tokens"]]
+    print(f"http: streamed {len(toks)} tokens in {len(lines) - 1} chunks, "
+          f"final status {lines[-1].get('status')}")
+
+    # graceful drain: healthz flips NotReady, in-flight finishes, exit 0
+    ing.begin_drain()
+    rc = ing.drain(grace=30.0)
+    print(f"http: drained with exit code {rc}")
 
 
 if __name__ == "__main__":

@@ -281,6 +281,8 @@ def _serve(ns, script_args) -> int:
               f"{spec.get('ns', 'serve')!r} — attach with "
               f"ProcServingFleet.attach({ns.master!r})",
               file=sys.stderr, flush=True)
+        if ns.http is not None and ns.rank == 0:
+            return _serve_http(ns, spec, controller)
         return controller.watch()
     finally:
         if controller.store is not None:
@@ -288,6 +290,29 @@ def _serve(ns, script_args) -> int:
                 controller.store.close()
             except OSError:
                 pass
+
+
+def _serve_http(ns, spec, controller) -> int:
+    """``--serve --http PORT``: rank 0 also runs the front end — adopt the
+    replicas it just spawned (``ProcServingFleet.attach``) and put a
+    :class:`~...inference.ingress.ServingIngress` in front. SIGTERM drains
+    the ingress gracefully (finish in-flight, then shut the fleet down) and
+    the launcher exits 0."""
+    from ...inference.ingress import ServingIngress
+    from ...inference.procfleet import ProcServingFleet
+
+    fleet = ProcServingFleet.attach(
+        ns.master, replicas=ns.nnodes * ns.nproc_per_node,
+        ns=spec.get("ns", "serve"),
+        boot_timeout=float(spec.get("boot_timeout", 120.0)))
+    ingress = ServingIngress(fleet, port=ns.http)
+    print(f"[launch][serve] ingress on {ingress.url} "  # noqa: PTA105 (host-side, never traced)
+          f"(POST /v1/generate, GET /healthz)", file=sys.stderr, flush=True)
+    try:
+        rc = ingress.serve_until_drained()
+    finally:
+        fleet.shutdown()
+    return rc
 
 
 def _parser():
@@ -302,6 +327,7 @@ def _parser():
     p.add_argument("--elastic_np", type=str, default=os.environ.get("PADDLE_ELASTIC_NP"), help="elastic node range 'min:max' (or 'n'): membership-managed launch with rescaling")
     p.add_argument("--elastic_timeout", type=float, default=3.0, help="heartbeat staleness (s) before a node is considered gone")
     p.add_argument("--serve", action="store_true", help="boot cross-process serving replicas (paddle_tpu.inference.procfleet) instead of a training script; the positional argument is the fleet spec JSON (model config + engine kwargs), rank 0 hosts the store at --master, and a front-end adopts the fleet with ProcServingFleet.attach")
+    p.add_argument("--http", type=int, default=None, metavar="PORT", help="with --serve: rank 0 also attaches the fleet and runs the HTTP ingress (ServingIngress) on PORT; SIGTERM drains gracefully and exits 0")
     p.add_argument("training_script", type=str)
     return p
 

@@ -250,11 +250,21 @@ def _corrupt_array_data(step_dir: str):
 def retry(max_attempts: int = 3, base_delay: float = 0.05,
           max_delay: float = 2.0,
           retry_on: Tuple[type, ...] = (OSError, TimeoutError),
-          jitter: Optional[bool] = None):
+          jitter: Optional[bool] = None,
+          deadline_s: Optional[float] = None):
     """Bounded exponential-backoff retry for transient store/IO failures.
 
     Attempt i's backoff cap is ``min(max_delay, base_delay * 2**i)``; after
     ``max_attempts`` failures the last exception propagates unchanged.
+
+    ``deadline_s`` adds an overall wall-clock budget per CALL (measured
+    from its first attempt): once the budget is spent no further attempt
+    is made and the last exception propagates unchanged — the bound a
+    caller's SLA actually needs, where ``max_attempts x max_delay`` only
+    bounds the sleep time and says nothing about how long the attempts
+    themselves block. A backoff sleep is clamped to the remaining budget,
+    so the final retry fires just before the deadline instead of
+    overshooting it. None (the default) keeps the attempts-only bound.
 
     ``jitter`` selects the sleep inside that cap (None defers to
     ``FLAGS_store_retry_jitter``, read per call so ``set_flags`` applies to
@@ -270,6 +280,8 @@ def retry(max_attempts: int = 3, base_delay: float = 0.05,
     """
     if max_attempts < 1:
         raise ValueError(f"max_attempts must be >= 1, got {max_attempts}")
+    if deadline_s is not None and deadline_s <= 0:
+        raise ValueError(f"deadline_s must be > 0, got {deadline_s}")
 
     def deco(fn: Callable):
         from ..framework import random as _random
@@ -280,6 +292,7 @@ def retry(max_attempts: int = 3, base_delay: float = 0.05,
 
         @functools.wraps(fn)
         def wrapper(*args, **kwargs):
+            t0 = time.monotonic() if deadline_s is not None else 0.0
             for attempt in range(max_attempts):
                 try:
                     return fn(*args, **kwargs)
@@ -292,6 +305,11 @@ def retry(max_attempts: int = 3, base_delay: float = 0.05,
                         if not rng_box:
                             rng_box.append(_random.host_generator(tag))  # noqa: PTA104 (host-side retry backoff, never traced)
                         cap = float(rng_box[0].uniform(0.0, cap))
+                    if deadline_s is not None:
+                        remaining = deadline_s - (time.monotonic() - t0)
+                        if remaining <= 0:
+                            raise  # budget spent: last exception unchanged
+                        cap = min(cap, remaining)
                     time.sleep(cap)
 
         return wrapper
@@ -304,15 +322,19 @@ class RetryingStore:
     add/wait/delete_key/num_keys) in the ``retry`` decorator; everything
     else passes through. ``jitter`` has :func:`retry` semantics (None
     defers to ``FLAGS_store_retry_jitter`` — full jitter by default, so a
-    fleet of replicas retrying one dead store doesn't thundering-herd)."""
+    fleet of replicas retrying one dead store doesn't thundering-herd);
+    ``deadline_s`` is the per-call wall-clock retry budget (None keeps the
+    attempts-only bound)."""
 
     _RETRIED = ("set", "get", "add", "wait", "delete_key", "num_keys")
 
     def __init__(self, store, max_attempts: int = 3, base_delay: float = 0.05,
-                 max_delay: float = 2.0, jitter: Optional[bool] = None):
+                 max_delay: float = 2.0, jitter: Optional[bool] = None,
+                 deadline_s: Optional[float] = None):
         self._store = store
         deco = retry(max_attempts=max_attempts, base_delay=base_delay,
-                     max_delay=max_delay, retry_on=(OSError,), jitter=jitter)
+                     max_delay=max_delay, retry_on=(OSError,), jitter=jitter,
+                     deadline_s=deadline_s)
         for name in self._RETRIED:
             setattr(self, name, deco(getattr(store, name)))
 

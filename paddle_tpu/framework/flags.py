@@ -129,3 +129,6 @@ define_flag("FLAGS_chaos_replica_kill_at", "", "kill a serving-fleet engine repl
 define_flag("FLAGS_chaos_replica_slow_ms", "", "inject per-tick latency into serving-fleet replicas: 'MS' slows every replica, 'R:MS' only replica R, by MS milliseconds per scheduler tick (a straggler/overloaded host; long enough and the fleet's heartbeat tracking declares it dead)")
 define_flag("FLAGS_chaos_replica_sigkill_at", "", "SIGKILL a cross-process serving replica mid-stream: 'R:K' makes the ProcServingFleet parent send SIGKILL to replica R's subprocess after harvesting its K-th tick message (fires exactly once per replica per process). The real-process form of FLAGS_chaos_replica_kill_at — no Python exception, the child just dies")
 define_flag("FLAGS_chaos_replica_hang_ms", "", "wedge a cross-process serving replica without exiting: 'MS' (every replica) or 'R:MS' (one) makes the child stop publishing heartbeats for MS milliseconds after its first served tick while the process stays alive (a zombie the parent's stale-beat sweep must catch). Fires exactly once per replica per process")
+define_flag("FLAGS_chaos_socket_drop_at", "", "kill the fast-path RPC socket mid-stream: 'R:K' (replica R) or 'K' (any) makes a SocketChannel writer kill its connection right before its K-th socket send (fires exactly once per replica per process). The channel must degrade to the store transport with no chunk lost or duplicated — the socket-fallback chaos pin")
+define_flag("FLAGS_chaos_ingress_disconnect_at", -1, "drop the HTTP client connection mid-stream: the ingress force-closes a streaming response socket after writing N chunks (fires exactly once per process; -1 = off). Drives the client-disconnect -> mid-decode cancel() test without a real flaky client")
+define_flag("FLAGS_chaos_net_delay_ms", 0.0, "sleep this many milliseconds before every fast-path socket frame send (both directions, both ends) — deterministic WAN latency for the transport-lag backpressure and TTFT-under-latency tests")

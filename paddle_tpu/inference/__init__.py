@@ -25,7 +25,14 @@ On top of the artifact predictor sit the serving-engine pieces:
   fault-tolerant tier: N engine replicas behind prefix-cache-affinity
   placement, heartbeat health tracking, kill-safe drain/requeue
   (exactly-once, bitwise-identical completions through a mid-stream
-  replica death), queue-depth load shedding, and AOT-warm scale-out.
+  replica death), queue-depth load shedding, and AOT-warm scale-out;
+- :class:`ServingIngress` (``.ingress``) — the stdlib HTTP/1.1 front
+  door over either fleet: per-token chunked streaming off the same
+  exactly-once ledger, idempotency keys, deadline propagation,
+  disconnect→cancel, 429/503 backpressure with ``Retry-After``, and
+  SIGTERM graceful drain; the cross-process fleet's hot channels ride a
+  direct socket fast path (``.rpc.SocketChannel``) that degrades back to
+  the TCPStore transport on any socket fault without losing a chunk.
 
 Backend placement is honest: ``Config.enable_use_gpu`` records the REQUEST
 and the resolved backend is whatever the runtime actually has (TPU when
@@ -50,7 +57,9 @@ from .fleet import (
     FleetOverloadError,
     FleetRequest,
     ServingFleet,
+    retry_after_estimate,
 )
+from .ingress import ServingIngress
 from .prefix_cache import PrefixCache
 from .procfleet import ProcReplica, ProcServingFleet, TokenStream
 from .router import Router
@@ -63,6 +72,7 @@ __all__ = [
     "ServingFleet", "EngineReplica", "FleetRequest", "Router",
     "FleetOverloadError", "FleetDrainedError",
     "ProcServingFleet", "ProcReplica", "TokenStream",
+    "ServingIngress", "retry_after_estimate",
 ]
 
 

@@ -45,6 +45,7 @@ finished), and an ``observability report`` fleet section.
 """
 from __future__ import annotations
 
+import collections
 import time
 from typing import Any, Dict, List, Optional
 
@@ -62,22 +63,44 @@ from .router import Router
 from .scheduler import ContinuousBatchingScheduler
 
 __all__ = ["ServingFleet", "EngineReplica", "FleetRequest",
-           "FleetOverloadError", "FleetDrainedError"]
+           "FleetOverloadError", "FleetDrainedError", "retry_after_estimate"]
+
+
+def retry_after_estimate(depth: int, rate: Optional[float],
+                         lo: float = 0.5, hi: float = 30.0) -> float:
+    """How long a shed client should wait before retrying: queue depth ÷
+    recent finish rate (seconds until the backlog plausibly drains),
+    clamped to ``[lo, hi]``. With no finish history yet (``rate`` None or
+    0) an overloaded fleet answers the pessimistic ``hi`` — better to
+    overshoot the wait than to invite an immediate re-shed."""
+    if rate is None or rate <= 0:
+        est = hi if depth > 0 else lo
+    else:
+        est = depth / rate
+    return float(min(hi, max(lo, est)))
 
 
 class FleetOverloadError(RuntimeError):
     """Structured load-shed: the fleet's queues are at capacity and this
     request was REJECTED at admission (nothing was enqueued). Callers
     retry with backoff or surface a 429-style answer; ``queued``/``limit``/
-    ``replicas_alive`` say how overloaded the fleet was."""
+    ``replicas_alive`` say how overloaded the fleet was and
+    ``retry_after_s`` (queue depth ÷ recent finish rate, clamped — see
+    :func:`retry_after_estimate`) is the backoff hint the ingress forwards
+    as the ``Retry-After`` header."""
 
-    def __init__(self, queued: int, limit: int, replicas_alive: int):
+    def __init__(self, queued: int, limit: int, replicas_alive: int,
+                 retry_after_s: Optional[float] = None):
         self.queued = int(queued)
         self.limit = int(limit)
         self.replicas_alive = int(replicas_alive)
+        self.retry_after_s = (None if retry_after_s is None
+                              else float(retry_after_s))
+        hint = ("" if self.retry_after_s is None
+                else f"; retry after {self.retry_after_s:.1f}s")
         super().__init__(
             f"fleet overloaded: {queued} requests queued >= limit {limit} "
-            f"across {replicas_alive} alive replica(s); request shed")
+            f"across {replicas_alive} alive replica(s); request shed{hint}")
 
 
 class FleetDrainedError(RuntimeError):
@@ -246,6 +269,9 @@ class ServingFleet:
         self._next_fid = 0
         self._next_rid = 0
         self.requeues = 0
+        # recent completion timestamps (monotonic) — the finish-rate window
+        # behind FleetOverloadError.retry_after_s and the ingress backoff
+        self._finish_times: collections.deque = collections.deque(maxlen=64)
         # cascade-death bookkeeping: _on_replica_death is re-entrant (a
         # survivor can die while absorbing requeued work — _place runs a
         # synchronous submit); the outermost call owns the drain loop
@@ -332,6 +358,24 @@ class ServingFleet:
         number admission control compares against ``max_queue_depth``."""
         return sum(len(rep.scheduler.queue) for rep in self._alive().values())
 
+    def finish_rate(self) -> Optional[float]:
+        """Recent completions per second over the sliding finish window
+        (None until two completions exist) — the denominator of
+        :func:`retry_after_estimate`."""
+        t = self._finish_times
+        if len(t) < 2 or t[-1] <= t[0]:
+            return None
+        return (len(t) - 1) / (t[-1] - t[0])
+
+    def transport_lag(self) -> Dict[str, float]:
+        """Transport-health watermarks the ingress reads for backpressure.
+        The in-process fleet has no wire: backlog is always 0 and the beat
+        age is the slowest alive replica's last tick duration (a straggler
+        shows up here exactly like a laggy socket would)."""
+        alive = [rep for rep in self.replicas.values() if rep.alive]
+        beat = max((rep.last_tick_seconds for rep in alive), default=0.0)
+        return {"out_backlog": 0.0, "beat_age_s": float(beat)}
+
     def submit(self, prompt, max_new_tokens: int = 16,
                eos_token_id: Optional[int] = None, seed: int = 0,
                deadline_s: Optional[float] = None,
@@ -355,7 +399,9 @@ class ServingFleet:
             counter_inc("fleet.sheds")
             _runlog.emit("fleet", kind="shed", component="fleet",
                          queued=depth, limit=self.max_queue_depth)
-            raise FleetOverloadError(depth, self.max_queue_depth, len(alive))
+            raise FleetOverloadError(
+                depth, self.max_queue_depth, len(alive),
+                retry_after_s=retry_after_estimate(depth, self.finish_rate()))
         if replica is not None:
             if replica not in alive:
                 raise ValueError(f"replica {replica} is not alive")
@@ -396,6 +442,51 @@ class ServingFleet:
         _runlog.emit("fleet", kind="placed", component="fleet", id=freq.fid,
                      replica=rid, reason=reason, attempt=freq.attempts,
                      trace=freq.trace_id)
+
+    def _local_rid(self, fid: int) -> Optional[int]:
+        """The scheduler-local rid currently running fleet request ``fid``
+        (None when it is not in flight on any replica)."""
+        freq = self.requests.get(fid)
+        if freq is None or freq.replica is None:
+            return None
+        for local, f in self._inflight.get(freq.replica, {}).items():  # noqa: PTA102 (host-side serving transport, never traced)
+            if f == fid:
+                return local  # noqa: PTA101 (host-side serving transport, never traced)
+        return None
+
+    def tokens_so_far(self, fid: int) -> List[int]:
+        """Live view of ``fid``'s generated tokens — the ledger's copy once
+        terminal, the owning scheduler's in-progress run while decoding.
+        The ingress streams from this without waiting for completion."""
+        freq = self.requests[fid]
+        if freq.status not in self._TERMINAL:
+            local = self._local_rid(fid)
+            if local is not None:
+                r = self.replicas[freq.replica].scheduler.find(local)
+                if r is not None:
+                    return list(r.tokens)
+        return list(freq.tokens)
+
+    def cancel(self, fid: int, status: str = "cancelled") -> bool:
+        """Cancel one in-flight request (client went away, deadline raced):
+        frees its scheduler slot mid-decode and marks the ledger terminal.
+        False when the request is unknown or already terminal."""
+        freq = self.requests.get(fid)
+        if freq is None or freq.status in self._TERMINAL:
+            return False
+        local = self._local_rid(fid)
+        if local is None:
+            return False
+        rep = self.replicas[freq.replica]
+        if not (rep.alive and rep.scheduler.cancel(local, status=status)):
+            return False
+        self._inflight[freq.replica].pop(local, None)
+        freq.status = status
+        freq.finished_ts = time.perf_counter()
+        counter_inc("fleet.cancels")
+        _runlog.emit("fleet", kind="cancelled", component="fleet", id=fid,
+                     replica=freq.replica, status=status, trace=freq.trace_id)
+        return True
 
     # ----------------------------------------------------------- the loop
     def step(self) -> List[FleetRequest]:
@@ -480,6 +571,7 @@ class ServingFleet:
                 freq.first_token_ts = r.first_token_ts  # noqa: PTA104 (host-side serving loop, never traced)
             rep.completed += 1  # noqa: PTA104 (host-side serving loop, never traced)
             self.finished_total += 1  # noqa: PTA104 (host-side serving loop)
+            self._finish_times.append(time.monotonic())  # noqa: PTA104, PTA305 (host-side, never traced; deque bounded at maxlen=64)
             counter_inc("fleet.requests_completed")
             observe("fleet.latency_seconds", freq.total_seconds)
             _runlog.emit("fleet", kind="finished", component="fleet",

@@ -69,9 +69,11 @@ from ..observability import slo as _slo
 from ..observability import trace as _trace
 from ..observability.metrics import counter_inc, gauge_set, observe
 from ..testing import chaos
-from .fleet import FleetDrainedError, FleetOverloadError, FleetRequest
+from .fleet import (FleetDrainedError, FleetOverloadError, FleetRequest,
+                    retry_after_estimate)
 from .router import Router
-from .rpc import Channel, Heartbeat, channel_prefix
+from .rpc import (Heartbeat, SocketChannel, SocketListener, channel_prefix,
+                  connect_socket, sock_key)
 
 __all__ = ["ProcServingFleet", "ProcReplica", "TokenStream", "replica_main"]
 
@@ -83,6 +85,7 @@ _FLAG_FORWARD = (
     "FLAGS_compile_cache_dir", "FLAGS_run_log_dir", "FLAGS_monitor",
     "FLAGS_trace", "FLAGS_flightrec_events", "FLAGS_chaos",
     "FLAGS_chaos_replica_hang_ms", "FLAGS_chaos_replica_slow_ms",
+    "FLAGS_chaos_socket_drop_at", "FLAGS_chaos_net_delay_ms",
     "FLAGS_sanitize", "FLAGS_sanitize_strict", "FLAGS_sanitize_max_recompiles",
 )
 
@@ -212,8 +215,22 @@ def replica_main(spec: Optional[dict] = None) -> int:
     engine = DecodeEngine(model, **spec.get("engine_kwargs", {}))
     sched = ContinuousBatchingScheduler(engine)
 
-    in_ch = Channel(store, channel_prefix(ns, rid, "in"))
-    out_ch = Channel(store, channel_prefix(ns, rid, "out"))
+    # hot-path transport: both logical channels share one fast-path socket
+    # (installed into conn_box when the parent dials in); until then — and
+    # after any socket death — the same channels ride the store
+    conn_box: List[Any] = [None]
+    in_ch = SocketChannel(store, channel_prefix(ns, rid, "in"), "in",
+                          conn_box, rid=rid)
+    out_ch = SocketChannel(store, channel_prefix(ns, rid, "out"), "out",
+                           conn_box, rid=rid)
+    listener = None
+    if spec.get("socket", True):
+        adv = ("127.0.0.1" if host in ("127.0.0.1", "localhost", "0.0.0.0")
+               else socket.gethostname())
+        listener = SocketListener(advertise_host=adv)
+        # the endpoint must be advertised BEFORE the ready beat: the parent
+        # dials exactly once, when it first observes ready
+        store.set(sock_key(ns, rid), listener.address)
     store.add(f"procfleet/{ns}/members_n", 1)  # launcher-mode membership
     state["ready"] = True
     beater.beat_once()
@@ -232,6 +249,10 @@ def replica_main(spec: Optional[dict] = None) -> int:
     sent: Dict[int, int] = {}    # fid -> tokens already chunk-streamed
     idle_sleep = float(spec.get("idle_sleep", 0.005))
     while True:
+        if listener is not None and conn_box[0] is None:
+            conn = listener.try_accept()
+            if conn is not None:
+                conn_box[0] = conn  # noqa: PTA104 (host-side, never traced)
         for m in in_ch.recv():
             kind = m["kind"]
             if kind == "submit":
@@ -249,16 +270,23 @@ def replica_main(spec: Optional[dict] = None) -> int:
                 if req is not None:
                     sched.cancel(req.rid, status=m.get("status", "cancelled"))
             elif kind == "drain":
+                # flip NotReady FIRST: an attach() racing this drain sees a
+                # non-ready beat and times out with a structured error
+                # instead of adopting a corpse
+                state["ready"] = False  # noqa: PTA104 (host-side, never traced)
                 out_ch.send("bye", ticks=state["ticks"])
-                beater.stop_ev.set()
                 beater.beat_once()
+                beater.stop_ev.set()
+                if listener is not None:
+                    listener.close()
+                if conn_box[0] is not None:
+                    conn_box[0].close()
                 store.close()
                 return 0  # noqa: PTA101 (host-side, never traced)
-        if not (sched.queue or sched.prefilling or sched.running):
-            time.sleep(idle_sleep)
-            continue
-        sched.step()
-        state["ticks"] += 1  # noqa: PTA104 (host-side, never traced)
+        busy = bool(sched.queue or sched.prefilling or sched.running)
+        if busy:
+            sched.step()
+            state["ticks"] += 1  # noqa: PTA104 (host-side, never traced)
         finished_fids: List[int] = []
         for fid, req in list(local.items()):  # noqa: PTA102 (host-side serving loop, never traced)
             if len(req.tokens) > sent[fid]:
@@ -273,6 +301,11 @@ def replica_main(spec: Optional[dict] = None) -> int:
                             trace=req.trace_id)
                 finished_fids.append(fid)  # noqa: PTA104 (host-side serving loop, never traced)
                 del local[fid], sent[fid]
+        if not busy and not finished_fids:
+            # idle — but only after the report sweep: a cancel() that just
+            # emptied the scheduler still owes its terminal message
+            time.sleep(idle_sleep)
+            continue
         state["load"] = len(sched.queue) + len(sched.prefilling) + len(sched.running)  # noqa: PTA104 (host-side, never traced)
         out_ch.send("tick", tick=state["ticks"], finished=finished_fids,
                     load=state["load"])
@@ -296,12 +329,15 @@ class ProcReplica:
     clock — wall-clock skew cannot fake a death)."""
 
     def __init__(self, rid: int, proc: Optional[subprocess.Popen],
-                 in_ch: Channel, out_ch: Channel, hb: Heartbeat):
+                 in_ch: SocketChannel, out_ch: SocketChannel, hb: Heartbeat,
+                 conn_box: Optional[list] = None):
         self.rid = int(rid)
         self.proc = proc
         self.in_ch = in_ch
         self.out_ch = out_ch
         self.hb = hb
+        self.conn_box = conn_box if conn_box is not None else [None]
+        self.sock_tried = False  # the parent dials the fast path exactly once
         self.alive = True
         self.draining = False
         self.death_reason: Optional[str] = None
@@ -411,7 +447,8 @@ class ProcServingFleet:
                  ns: Optional[str] = None, boot_timeout: float = 120.0,
                  beat_interval: float = 0.05, poll_s: float = 0.002,
                  affinity_load_slack: int = 2, spawn: bool = True,
-                 keep_finished: int = 256, **engine_kwargs):
+                 keep_finished: int = 256, use_sockets: bool = True,
+                 **engine_kwargs):
         if replicas < 1:
             raise ValueError(f"replicas must be >= 1, got {replicas}")
         if max_queue_depth < 1:
@@ -443,6 +480,10 @@ class ProcServingFleet:
         self.boot_timeout = float(boot_timeout)
         self.beat_interval = float(beat_interval)
         self.poll_s = float(poll_s)
+        # socket fast path: children advertise a framed-TCP endpoint the
+        # parent dials once ready; False pins everything to the store
+        # transport (the bench's socket_vs_store_overhead_pct baseline arm)
+        self.use_sockets = bool(use_sockets)
         self.router = Router(chunk=engine_kwargs.get("prefill_chunk"),
                              affinity_load_slack=affinity_load_slack)
 
@@ -456,8 +497,11 @@ class ProcServingFleet:
             endpoint = f"127.0.0.1:{raw_store.port}"
         else:
             host, port = endpoint.rsplit(":", 1)
+            # a dead endpoint must fail within the caller's boot budget,
+            # not the store client's own (longer) default connect window
             raw_store = TCPStore(host, int(port), is_master=False,
-                                 world_size=1, timeout=60.0)
+                                 world_size=1,
+                                 timeout=min(60.0, self.boot_timeout))
         self._raw_store = raw_store
         self._store = RetryingStore(raw_store)
         self.endpoint = endpoint
@@ -476,6 +520,11 @@ class ProcServingFleet:
         self._next_fid = 0
         self._next_rid = 0
         self.requeues = 0
+        # recent completion timestamps (monotonic) — the finish-rate window
+        # behind FleetOverloadError.retry_after_s and the ingress backoff
+        import collections as _collections
+
+        self._finish_times = _collections.deque(maxlen=64)
         self._pending_done: List[FleetRequest] = []
         self._requeue_backlog: List[int] = []
         self._draining = False
@@ -522,13 +571,29 @@ class ProcServingFleet:
 
     # ------------------------------------------------------------ replicas
     def _make_replica(self, rid: int, proc) -> ProcReplica:
+        conn_box: list = [None]
         rep = ProcReplica(
             rid, proc,
-            in_ch=Channel(self._store, channel_prefix(self.ns, rid, "in")),
-            out_ch=Channel(self._store, channel_prefix(self.ns, rid, "out")),
-            hb=Heartbeat(self._store, self.ns, rid))
+            in_ch=SocketChannel(self._store, channel_prefix(self.ns, rid, "in"),
+                                "in", conn_box, rid=rid),
+            out_ch=SocketChannel(self._store, channel_prefix(self.ns, rid, "out"),
+                                 "out", conn_box, rid=rid),
+            hb=Heartbeat(self._store, self.ns, rid),
+            conn_box=conn_box)
         self.replicas[rid] = rep
         return rep
+
+    def _maybe_connect_socket(self, rep: ProcReplica) -> None:
+        """Dial the replica's advertised fast-path socket — exactly once,
+        the first time it is seen ready (its sock key is published before
+        the ready beat, so one attempt suffices). Failure or a missing
+        advertisement just leaves the channels on the store transport."""
+        if not self.use_sockets or rep.sock_tried or not rep.ready:
+            return
+        rep.sock_tried = True
+        conn = connect_socket(self._store, self.ns, rep.rid)
+        if conn is not None:
+            rep.conn_box[0] = conn  # noqa: PTA104 (host-side serving transport, never traced)
 
     def _spawn_replica(self) -> ProcReplica:
         rid = self._next_rid
@@ -538,6 +603,7 @@ class ProcServingFleet:
                           "config": self.model_config},
                 "engine_kwargs": self.engine_kwargs,
                 "beat_interval": self.beat_interval,
+                "socket": self.use_sockets,
                 "jax_config": current_jax_config()}
         # PADDLE_TRAINER_ID decorrelates the child's trace/span id streams
         # from the parent (rank 0) and its siblings — launcher discipline
@@ -565,6 +631,7 @@ class ProcServingFleet:
                 doc = rep.hb.read(timeout=0.05)
                 if doc is not None and doc.get("ready"):
                     self._observe_beat(rep, doc)
+                    self._maybe_connect_socket(rep)
                     waiting.discard(rid)  # noqa: PTA104 (host-side, never traced)
             if waiting and time.monotonic() > deadline:
                 raise TimeoutError(
@@ -617,6 +684,35 @@ class ProcServingFleet:
         ``max_queue_depth``."""
         return sum(rep.load() for rep in self._alive().values())
 
+    def finish_rate(self) -> Optional[float]:
+        """Recent completions per second over the sliding finish window
+        (None until two completions exist) — the denominator of
+        :func:`~.fleet.retry_after_estimate`."""
+        t = self._finish_times
+        if len(t) < 2 or t[-1] <= t[0]:
+            return None
+        return (len(t) - 1) / (t[-1] - t[0])
+
+    def transport_lag(self) -> Dict[str, float]:
+        """Transport-health watermarks for ingress backpressure:
+        ``out_backlog`` is the deepest unacknowledged fast-path send window
+        across alive replicas (how far the wire is behind the writers) and
+        ``beat_age_s`` the stalest alive heartbeat on the parent's clock.
+        Either climbing past the ingress watermarks means the fleet is
+        falling behind its transport — shed before the queues do it."""
+        alive = [rep for rep in self.replicas.values() if rep.alive]
+        beat = max(((time.monotonic() - rep.last_beat) for rep in alive),
+                   default=0.0)
+        backlog = max((float(rep.in_ch.backlog() + rep.out_ch.backlog())
+                       for rep in alive), default=0.0)
+        return {"out_backlog": backlog, "beat_age_s": float(beat)}
+
+    def tokens_so_far(self, fid: int) -> List[int]:
+        """Live view of ``fid``'s generated tokens — the append-only chunk
+        ledger, which grows as stream chunks arrive. The ingress streams
+        from this (same cursor discipline as :class:`TokenStream`)."""
+        return list(self.requests[fid].tokens)
+
     def submit(self, prompt, max_new_tokens: int = 16,
                eos_token_id: Optional[int] = None, seed: int = 0,
                deadline_s: Optional[float] = None,
@@ -637,7 +733,9 @@ class ProcServingFleet:
             counter_inc("fleet.sheds")
             _runlog.emit("fleet", kind="shed", component="procfleet",
                          queued=depth, limit=self.max_queue_depth)
-            raise FleetOverloadError(depth, self.max_queue_depth, len(alive))
+            raise FleetOverloadError(
+                depth, self.max_queue_depth, len(alive),
+                retry_after_s=retry_after_estimate(depth, self.finish_rate()))
         if replica is not None:
             if replica not in alive:
                 raise ValueError(f"replica {replica} is not alive")
@@ -705,6 +803,7 @@ class ProcServingFleet:
         for rid, rep in list(self.replicas.items()):  # noqa: PTA102 (host-side serving loop, never traced)
             if not rep.alive:
                 continue
+            self._maybe_connect_socket(rep)
             try:
                 msgs = rep.out_ch.recv()
             except (TimeoutError, OSError) as exc:
@@ -842,6 +941,7 @@ class ProcServingFleet:
             freq.first_token_ts = freq.finished_ts  # noqa: PTA104 (host-side serving loop, never traced)
         rep.completed += 1  # noqa: PTA104 (host-side serving loop, never traced)
         self.finished_total += 1
+        self._finish_times.append(time.monotonic())  # noqa: PTA305 (bounded deque, maxlen=64)
         counter_inc("fleet.requests_completed")
         observe("fleet.latency_seconds", freq.total_seconds)
         _runlog.emit("fleet", kind="finished", component="procfleet", id=fid,
@@ -875,6 +975,8 @@ class ProcServingFleet:
         rep.death_reason = f"{type(exc).__name__}: {exc}"
         counter_inc("fleet.replica_deaths")
         rep.sigkill()  # reap the husk: hung children must not linger
+        if rep.conn_box[0] is not None:
+            rep.conn_box[0].kill("replica dead")
         self.router.forget_replica(rep.rid)
         pending = sorted(rep.assigned)
         rep.assigned = set()
@@ -1020,6 +1122,13 @@ class ProcServingFleet:
                 "load": rep.load(),
                 "counters": dict(rep.counters),
                 "death_reason": rep.death_reason,
+                "transport": {
+                    "socket": (rep.conn_box[0] is not None
+                               and rep.conn_box[0].alive),
+                    "socket_msgs": rep.in_ch.socket_msgs + rep.out_ch.socket_msgs,
+                    "store_msgs": rep.in_ch.store_msgs + rep.out_ch.store_msgs,
+                    "fallbacks": rep.in_ch.fallbacks + rep.out_ch.fallbacks,
+                },
             } for rid, rep in self.replicas.items()},
         }
 

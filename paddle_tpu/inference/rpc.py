@@ -18,7 +18,26 @@ counter never acknowledged. Reads are destructive (``delete_key`` after
 fetch) so a long-lived serving store doesn't accumulate the whole token
 history. Ordering is total per channel: sequence numbers are assigned by
 the writer, drained in order by the reader — the property the per-token
-streaming ledger's chunk sequence numbers build on.
+streaming ledger's chunk sequence numbers build on. A drain interrupted by
+a store failure returns the messages it already consumed (a **partial
+drain**) instead of discarding them with the exception: those bodies are
+deleted and the cursor has moved, so dropping them would lose acknowledged
+messages; the failing sequence number stays unconsumed and the next call
+retries it.
+
+:class:`SocketChannel` (PR 20) is the **hot-path fast lane** over the same
+contract: one full-duplex length-prefixed-frame TCP socket per replica
+carries submit/chunk/tick traffic directly between parent and child (the
+store's ~3x polling overhead drops to a socket write), while the TCPStore
+stays authoritative for membership, heartbeats, and boot. Sequence numbers
+are still writer-assigned and delivery is still in-order and exactly-once:
+the writer retains every socket-sent message until the reader acknowledges
+it (acks ride the same socket), and ANY socket error — connect failure,
+reset, a chaos ``FLAGS_chaos_socket_drop_at`` kill — degrades the channel
+back to the store transport mid-stream by republishing the unacknowledged
+window under the same sequence numbers (set-then-bump, as ever). The
+reader dedups by cursor, so the fallback can replay generously without
+ever delivering a message twice, dropping one, or reordering.
 
 Heartbeats deliberately do NOT ride the message log (a beat per tick would
 dominate the store traffic): each replica overwrites one well-known key,
@@ -35,10 +54,14 @@ prefill/decode spans, requeue, and delivery across process boundaries.
 from __future__ import annotations
 
 import json
+import select
+import socket
 import time
 from typing import Any, Dict, List, Optional
 
-__all__ = ["Channel", "Heartbeat", "channel_prefix", "hb_key"]
+__all__ = ["Channel", "SocketChannel", "SocketConn", "SocketListener",
+           "Heartbeat", "channel_prefix", "hb_key", "sock_key",
+           "connect_socket"]
 
 
 def channel_prefix(ns: str, rid: int, direction: str) -> str:
@@ -49,6 +72,11 @@ def channel_prefix(ns: str, rid: int, direction: str) -> str:
 
 def hb_key(ns: str, rid: int) -> str:
     return f"procfleet/{ns}/{rid}/hb"
+
+
+def sock_key(ns: str, rid: int) -> str:
+    """Where a replica advertises its fast-path socket endpoint."""
+    return f"procfleet/{ns}/{rid}/sock"
 
 
 class Channel:
@@ -82,19 +110,344 @@ class Channel:
         """Drain every message published since the last call, in order.
         Non-blocking when nothing is pending (one counter read); the
         ``timeout`` only bounds the body fetch of an acknowledged message
-        (which the writer has already set — it arrives immediately)."""
+        (which the writer has already set — it arrives immediately).
+
+        A store failure partway through the drain returns the messages
+        already consumed (their bodies are deleted and the cursor moved —
+        discarding them would silently lose acknowledged messages); the
+        failing sequence number is NOT consumed, so the next call retries
+        it, and a drain that fails before consuming anything raises."""
         n = int(self.store.add(f"{self.prefix}/n", 0))
         out: List[Dict[str, Any]] = []
         while self._read < n:
             seq = self._read + 1
-            raw = self.store.get(f"{self.prefix}/m/{seq}", timeout=timeout)
-            out.append(json.loads(raw if isinstance(raw, str) else raw.decode()))  # noqa: PTA104 (host-side serving loop, never traced)
+            try:
+                raw = self.store.get(f"{self.prefix}/m/{seq}", timeout=timeout)
+                msg = json.loads(raw if isinstance(raw, str) else raw.decode())  # noqa: PTA104 (host-side serving loop, never traced)
+            except (TimeoutError, OSError, ValueError):
+                if out:
+                    from ..observability.metrics import counter_inc
+
+                    counter_inc("rpc.partial_drains")
+                    return out  # partial drain: consumed messages survive  # noqa: PTA101 (host-side serving transport, never traced)
+                raise
+            out.append(msg)  # noqa: PTA104 (host-side serving transport, never traced)
             try:
                 self.store.delete_key(f"{self.prefix}/m/{seq}")
             except OSError:
                 pass  # GC is best-effort; the counter already moved on
             self._read = seq  # noqa: PTA104 (host-side, never traced)
         return out
+
+
+# =====================================================================
+# socket fast path
+# =====================================================================
+
+class SocketConn:
+    """One full-duplex framed TCP connection between the parent and one
+    replica child, multiplexing both hot channels ('in': parent->child,
+    'out': child->parent) plus piggybacked acknowledgements.
+
+    Frames are 4-byte big-endian length + JSON:
+    ``{"ch": name, "msg": {...}}`` carries one channel message,
+    ``{"ackch": name, "ack": N}`` acknowledges in-order delivery through
+    sequence N on channel ``name``. Any socket error (send failure, EOF,
+    torn frame) marks the connection dead — callers degrade to the store
+    transport; there is no reconnect (the store path is always correct,
+    just slower)."""
+
+    def __init__(self, sock: socket.socket, timeout: float = 5.0):
+        sock.settimeout(timeout)
+        try:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:
+            pass
+        self.sock = sock
+        self.alive = True
+        self.death_reason: Optional[str] = None
+        self._rbuf = b""
+        self.inbox: Dict[str, List[dict]] = {}   # channel -> received msgs
+        self.acks: Dict[str, int] = {}           # channel -> peer's ack
+
+    def send_frame(self, doc: dict) -> bool:
+        """Write one frame; False (and the conn is dead) on any error."""
+        if not self.alive:
+            return False
+        from ..testing import chaos
+
+        delay = chaos.net_delay_ms()
+        if delay > 0:
+            time.sleep(delay / 1e3)
+        data = json.dumps(doc).encode()
+        try:
+            self.sock.sendall(len(data).to_bytes(4, "big") + data)
+            return True
+        except (OSError, ValueError):
+            self.kill("send error")
+            return False
+
+    def poll(self) -> None:
+        """Drain every readable byte (never blocks) and parse complete
+        frames into :attr:`inbox` / :attr:`acks`."""
+        if not self.alive:
+            return
+        try:
+            while True:
+                r, _, _ = select.select([self.sock], [], [], 0)
+                if not r:
+                    break
+                data = self.sock.recv(1 << 16)
+                if not data:
+                    self.kill("peer closed")
+                    break
+                self._rbuf += data  # noqa: PTA104 (host-side transport, never traced)
+        except (OSError, ValueError):
+            self.kill("recv error")
+        while len(self._rbuf) >= 4:
+            ln = int.from_bytes(self._rbuf[:4], "big")
+            if len(self._rbuf) < 4 + ln:
+                break
+            body, self._rbuf = self._rbuf[4:4 + ln], self._rbuf[4 + ln:]  # noqa: PTA104 (host-side serving transport, never traced)
+            try:
+                doc = json.loads(body)
+            except ValueError:
+                self.kill("torn frame")
+                return  # noqa: PTA101 (host-side serving transport, never traced)
+            if "msg" in doc:
+                self.inbox.setdefault(doc.get("ch"), []).append(doc["msg"])  # noqa: PTA104, PTA305 (host-side, never traced; one list per channel, drained by take())
+            if doc.get("ack") is not None:
+                ch = doc.get("ackch", doc.get("ch"))
+                self.acks[ch] = max(self.acks.get(ch, 0), int(doc["ack"]))  # noqa: PTA104, PTA305 (host-side, never traced; one cursor per channel, overwritten)
+
+    def take(self, channel: str) -> List[dict]:
+        msgs = self.inbox.get(channel) or []
+        self.inbox[channel] = []
+        return msgs
+
+    def kill(self, reason: str = "") -> None:
+        if self.alive:
+            self.alive = False  # noqa: PTA104 (host-side serving transport, never traced)
+            self.death_reason = reason or "killed"  # noqa: PTA104 (host-side serving transport, never traced)
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+    close = kill
+
+
+class SocketListener:
+    """The child side's accept socket: bind an ephemeral port, advertise
+    ``host:port`` (via the store's :func:`sock_key`), accept the parent's
+    one connection non-blockingly from the serving loop."""
+
+    def __init__(self, advertise_host: str = "127.0.0.1"):
+        self.sock = socket.socket()
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.sock.bind(("", 0))
+        self.sock.listen(1)
+        self.sock.setblocking(False)
+        self.port = self.sock.getsockname()[1]
+        self.address = f"{advertise_host}:{self.port}"
+
+    def try_accept(self) -> Optional[SocketConn]:
+        try:
+            s, _addr = self.sock.accept()
+        except (BlockingIOError, InterruptedError):
+            return None
+        except OSError:
+            return None
+        from ..observability.metrics import counter_inc
+
+        counter_inc("rpc.socket_connects")
+        return SocketConn(s)
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+def connect_socket(store, ns: str, rid: int,
+                   timeout: float = 0.25) -> Optional[SocketConn]:
+    """Parent-side dial of replica ``rid``'s advertised fast-path socket.
+    None when the replica never advertised one (socket fast path disabled,
+    or an old child) or the dial fails — callers simply stay on the store
+    transport."""
+    try:
+        raw = store.get(sock_key(ns, rid), timeout=timeout)
+    except (TimeoutError, OSError):
+        return None
+    addr = raw if isinstance(raw, str) else raw.decode()
+    host, _, port = addr.rpartition(":")
+    try:
+        s = socket.create_connection((host, int(port)), timeout=2.0)
+    except (OSError, ValueError):
+        return None
+    from ..observability.metrics import counter_inc
+
+    counter_inc("rpc.socket_connects")
+    return SocketConn(s)
+
+
+class SocketChannel(Channel):
+    """A :class:`Channel` with a direct-socket fast lane and automatic,
+    loss-free degradation back to the store transport.
+
+    Both ends share one :class:`SocketConn` per replica (held in a mutable
+    one-slot ``conn_box`` so the serving loop can install it after boot);
+    the channel ``name`` ('in'/'out') tags frames on the shared wire.
+
+    **Writer protocol**: every message gets the next writer-local seq and
+    is retained in an unacked window; while the socket is alive it travels
+    as one frame (no store ops at all). On any socket failure — or when no
+    socket ever connected — :meth:`_flush_to_store` republishes every
+    retained message the peer has not acknowledged under its ORIGINAL
+    ``m/<seq>`` key and advances the ``n`` counter to the latest seq
+    (set-then-bump, the same ordering contract). Acked messages are
+    dropped from the window (the reader's cursor passed them; the counter
+    may skip their bodies safely).
+
+    **Reader protocol**: socket frames land in a pending map and are
+    delivered strictly in cursor order; the store counter is consulted
+    when the socket is dead, when a gap suggests store-published messages,
+    and periodically (every :data:`STORE_CHECK_EVERY` drains) as a
+    half-open-socket safety net — so the steady-state hot path costs zero
+    store round-trips. Delivery acks ride back on the socket, bounding the
+    writer's window. Cursor dedup makes fallback replays harmless: a
+    message can arrive on both transports and is delivered exactly once,
+    in order."""
+
+    STORE_CHECK_EVERY = 32
+
+    def __init__(self, store, prefix: str, name: str, conn_box: list,
+                 rid: int = 0):
+        super().__init__(store, prefix)
+        self.name = name
+        self.rid = int(rid)
+        self._conn_box = conn_box
+        self._unacked: Dict[int, dict] = {}  # writer: replay window
+        self._pending: Dict[int, dict] = {}  # reader: out-of-order arrivals
+        self._store_n = 0      # counter value this writer has driven
+        self._calls = 0
+        self.socket_msgs = 0   # sent via socket
+        self.store_msgs = 0    # published to the store
+        self.fallbacks = 0     # socket->store degradations observed
+
+    def _conn(self) -> Optional[SocketConn]:
+        return self._conn_box[0] if self._conn_box else None
+
+    def backlog(self) -> int:
+        """Writer-side transport lag: messages sent but not yet
+        acknowledged by the peer (0 in pure store mode — the store IS the
+        ack). The ingress reads this as a backpressure watermark."""
+        return len(self._unacked)
+
+    # ------------------------------------------------------------- writer
+    def send(self, kind: str, **payload: Any) -> int:
+        from ..observability.metrics import counter_inc
+        from ..testing import chaos
+
+        self._sent += 1
+        msg = {"kind": kind, "seq": self._sent}
+        msg.update(payload)
+        self._unacked[self._sent] = msg
+        conn = self._conn()
+        if conn is not None and conn.alive:
+            if chaos.socket_drop_due(self.rid, self.socket_msgs + 1):
+                conn.kill("chaos: socket drop")
+                self.fallbacks += 1  # noqa: PTA104 (host-side serving transport, never traced)
+                counter_inc("rpc.socket_fallbacks")
+            else:
+                ack = conn.acks.get(self.name, 0)
+                for seq in [s for s in self._unacked if s <= ack]:
+                    del self._unacked[seq]
+                if conn.send_frame({"ch": self.name, "msg": msg}):
+                    self.socket_msgs += 1  # noqa: PTA104 (host-side serving transport, never traced)
+                    counter_inc("rpc.socket_msgs")
+                    return self._sent
+                self.fallbacks += 1  # noqa: PTA104 (host-side serving transport, never traced)
+                counter_inc("rpc.socket_fallbacks")
+        self._flush_to_store()
+        return self._sent
+
+    def _flush_to_store(self) -> None:
+        """Republish the unacknowledged window under the original seqs and
+        bump the counter to the latest — the loss-free fallback seam. Safe
+        to call repeatedly; already-published seqs are skipped and the
+        counter only ever moves forward."""
+        from ..observability.metrics import counter_inc
+
+        conn = self._conn()
+        acked = conn.acks.get(self.name, 0) if conn is not None else 0
+        for seq in sorted(self._unacked):
+            if seq <= acked:
+                continue  # delivered: the reader's cursor already passed it
+            msg = self._unacked[seq]
+            self.store.set(f"{self.prefix}/m/{seq}", json.dumps(msg))
+            self.store_msgs += 1  # noqa: PTA104 (host-side serving transport, never traced)
+            counter_inc("rpc.store_msgs")
+        if self._sent > self._store_n:
+            self.store.add(f"{self.prefix}/n", self._sent - self._store_n)  # noqa: PTA104 (host-side serving transport, never traced)
+            self._store_n = self._sent  # noqa: PTA104 (host-side transport)
+        self._unacked.clear()  # everything <= _sent is published or acked
+
+    # ------------------------------------------------------------- reader
+    def recv(self, timeout: float = 2.0) -> List[Dict[str, Any]]:
+        self._calls += 1
+        conn = self._conn()
+        if conn is not None and conn.alive:
+            conn.poll()
+            for m in conn.take(self.name):
+                seq = int(m.get("seq", 0))
+                if seq > self._read:
+                    self._pending[seq] = m  # noqa: PTA104 (host-side transport)
+        out: List[Dict[str, Any]] = []
+        self._deliver(out)
+        conn = self._conn()  # poll may have killed it
+        socket_ok = conn is not None and conn.alive
+        if (not socket_ok or self._pending
+                or self._calls % self.STORE_CHECK_EVERY == 0):
+            self._drain_store(out, timeout)
+        if out and socket_ok:
+            conn.send_frame({"ackch": self.name, "ack": self._read})
+        return out
+
+    def _deliver(self, out: List[dict]) -> None:
+        while self._read + 1 in self._pending:
+            self._read += 1  # noqa: PTA104 (host-side serving transport, never traced)
+            out.append(self._pending.pop(self._read))  # noqa: PTA104 (host-side serving transport, never traced)
+
+    def _drain_store(self, out: List[dict], timeout: float) -> None:
+        """Fetch store-published messages past the cursor, interleaving
+        socket arrivals (pending entries win: their body fetch is free and
+        the store copy of a socket-delivered seq is just the fallback
+        replay). Same partial-drain discipline as :class:`Channel`."""
+        n = int(self.store.add(f"{self.prefix}/n", 0))
+        while self._read < n:
+            seq = self._read + 1
+            m = self._pending.pop(seq, None)
+            if m is None:
+                try:
+                    raw = self.store.get(f"{self.prefix}/m/{seq}", timeout=timeout)
+                    m = json.loads(raw if isinstance(raw, str) else raw.decode())  # noqa: PTA104 (host-side transport, never traced)
+                except (TimeoutError, OSError, ValueError):
+                    if out:
+                        from ..observability.metrics import counter_inc
+
+                        counter_inc("rpc.partial_drains")
+                        return  # partial drain: keep what was consumed  # noqa: PTA101 (host-side serving transport, never traced)
+                    raise
+            out.append(m)  # noqa: PTA104 (host-side serving transport, never traced)
+            try:
+                self.store.delete_key(f"{self.prefix}/m/{seq}")
+            except OSError:
+                pass
+            self._read = seq  # noqa: PTA104 (host-side transport, never traced)
+            self._deliver(out)  # socket arrivals past the store counter
+        self._deliver(out)
 
 
 class Heartbeat:

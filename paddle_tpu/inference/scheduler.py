@@ -226,6 +226,21 @@ class ContinuousBatchingScheduler:
                      deadline_s=r.deadline_s, trace=r.trace_id)
         return True
 
+    def find(self, rid: int):
+        """The in-flight :class:`Request` with id ``rid`` wherever it is
+        (queued, prefilling, or decoding), else None — the live-progress
+        view a streaming front end polls without touching slot state."""
+        for q in self.queue:
+            if q.rid == rid:
+                return q  # noqa: PTA101 (host-side serving transport, never traced)
+        for cand in self.prefilling.values():
+            if cand.rid == rid:
+                return cand  # noqa: PTA101 (host-side serving transport, never traced)
+        for cand in self.running.values():
+            if cand.rid == rid:
+                return cand  # noqa: PTA101 (host-side serving transport, never traced)
+        return None
+
     def _expire_deadlines(self) -> None:
         """Reclaim every in-flight request whose deadline has passed (one
         sweep per tick: queued, prefilling, and decoding alike)."""

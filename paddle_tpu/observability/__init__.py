@@ -85,6 +85,6 @@ for _name in (
         + metrics.ANALYSIS_COUNTERS + metrics.HYGIENE_COUNTERS \
         + metrics.PLANNER_COUNTERS \
         + metrics.RECSYS_COUNTERS + metrics.OBS_COUNTERS \
-        + metrics.SLO_COUNTERS:
+        + metrics.SLO_COUNTERS + metrics.INGRESS_COUNTERS:
     metrics.declare_counter(_name)
 del _name

@@ -142,6 +142,10 @@ def analyze(events: List[dict]) -> dict:
     flt = [ev for ev in events if ev.get("event") == "fleet"]
     if flt:
         out["fleet"] = _analyze_fleet(flt)  # noqa: PTA104 (host-side report printer)
+    # HTTP front-door section from the ingress event stream
+    ing = [ev for ev in events if ev.get("event") == "ingress"]
+    if ing:
+        out["ingress"] = _analyze_ingress(ing)  # noqa: PTA104 (host-side report printer)
     # sharding-analysis section from the SPMD analyzer's shard_check events
     # (FLAGS_shard_check: one per analyzed specialization)
     checks = [ev for ev in events if ev.get("event") == "shard_check"]
@@ -383,6 +387,47 @@ def _analyze_fleet(flt: List[dict]) -> dict:
             }
         replays = [ev for ev in fin if int(ev.get("attempts") or 1) > 1]
         out["finished_after_requeue"] = len(replays)  # noqa: PTA104 (host-side report printer)
+    return out
+
+
+def _analyze_ingress(ing: List[dict]) -> dict:
+    """HTTP front-door stats from ``ingress`` events (requests, responses,
+    rejects by reason, disconnect cancels, drains)."""
+    by_kind = defaultdict(list)
+    for ev in ing:
+        by_kind[ev.get("kind", "?")].append(ev)  # noqa: PTA104 (host-side report printer)
+    rejects = by_kind.get("reject", [])
+    reasons: dict = defaultdict(int)
+    for ev in rejects:
+        reasons[ev.get("reason", "?")] += 1  # noqa: PTA104 (host-side report printer)
+    resp = by_kind.get("response", [])
+    out = {
+        "requests": len(by_kind.get("request", [])),
+        "responses": len(resp),
+        "rejects": dict(sorted(reasons.items())),
+        "disconnect_cancels": len(by_kind.get("disconnect", [])),
+        "idempotent_replays": sum(1 for ev in by_kind.get("request", [])
+                                  if ev.get("idempotent")),
+        "drains": len(by_kind.get("drain_begin", [])),
+    }
+    total = out["requests"] + len(rejects)
+    out["reject_rate"] = (len(rejects) / total) if total else None
+    lats = sorted(ev["seconds"] for ev in resp
+                  if isinstance(ev.get("seconds"), (int, float)))
+    if lats:
+        out["latency"] = {  # noqa: PTA104 (host-side report printer)
+            "p50_seconds": _percentile(lats, 50),
+            "p99_seconds": _percentile(lats, 99),
+        }
+    streamed = [ev for ev in resp if ev.get("stream")]
+    if streamed:
+        out["streamed"] = len(streamed)  # noqa: PTA104 (host-side report printer)
+        out["streamed_tokens"] = sum(int(ev.get("new_tokens") or 0)  # noqa: PTA104 (host-side report printer)
+                                     for ev in streamed)
+    drains = by_kind.get("drain_done", [])
+    if drains:
+        out["drain_seconds"] = drains[-1].get("seconds")  # noqa: PTA104 (host-side report printer)
+        out["drain_cancelled"] = drains[-1].get("cancelled")  # noqa: PTA104 (host-side report printer)
     return out
 
 
@@ -706,6 +751,27 @@ def print_report(path: str, a: dict) -> None:
                 f"r{rid} {v:.2f}/s" if v is not None else f"r{rid} -"
                 for rid, v in rps.items())
             print(f"    per-replica throughput: {parts}")  # noqa: PTA105 (host-side report printer)
+    ig = a.get("ingress")
+    if ig:
+        print("  ingress (HTTP front door):")  # noqa: PTA105 (host-side report printer)
+        rej = "  ".join(f"{k} x{n}" for k, n in ig["rejects"].items()) or "none"
+        rr = ig.get("reject_rate")
+        print(f"    requests: {ig['requests']}   responses: {ig['responses']}   "  # noqa: PTA105 (host-side report printer)
+              f"rejects: {rej}"
+              + (f" ({rr * 100:.1f}%)" if rr is not None else ""))
+        print(f"    idempotent replays: {ig['idempotent_replays']}   "  # noqa: PTA105 (host-side report printer)
+              f"disconnect cancels: {ig['disconnect_cancels']}   "
+              f"drains: {ig['drains']}")
+        lat = ig.get("latency")
+        if lat:
+            print(f"    latency: p50 {lat['p50_seconds'] * 1e3:.2f} ms   "  # noqa: PTA105 (host-side report printer)
+                  f"p99 {lat['p99_seconds'] * 1e3:.2f} ms")
+        if ig.get("streamed"):
+            print(f"    streamed: {ig['streamed']} responses, "  # noqa: PTA105 (host-side report printer)
+                  f"{ig['streamed_tokens']} tokens")
+        if ig.get("drain_seconds") is not None:
+            print(f"    drain: {ig['drain_seconds']:.2f}s, "  # noqa: PTA105 (host-side report printer)
+                  f"{ig.get('drain_cancelled', 0)} cancelled at grace")
     sh = a.get("sharding")
     if sh:
         print("  sharding analysis (SPMD PTA2xx pre-flight, FLAGS_shard_check):")  # noqa: PTA105 (host-side report printer)

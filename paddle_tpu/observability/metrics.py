@@ -213,6 +213,28 @@ FLEET_COUNTERS: Tuple[str, ...] = (
     # cross-process tier (inference/procfleet.py): token chunks applied to
     # the parent ledger from replica-subprocess stream messages
     "fleet.stream_chunks",
+    # explicit mid-decode cancellations through the fleet front (client
+    # disconnects routed down from the ingress, admin cancels)
+    "fleet.cancels",
+)
+
+# Network ingress + RPC transport (PR 20: inference/ingress.py + rpc.py).
+# ingress.* is the HTTP front door's admission ledger — requests accepted,
+# responses served, the three structured rejection classes (429 overload,
+# 503 transport backpressure, 503 draining), idempotency-key replays
+# served from the ledger without re-generating, and client disconnects
+# turned into mid-decode cancels. rpc.* meters the transport split: how
+# much of the hot path rode the fast-path socket vs the TCPStore, socket
+# connects, socket->store degradations, and partial drains returned when
+# a flaky store failed mid-drain (the acknowledged-message-loss fix).
+INGRESS_COUNTERS: Tuple[str, ...] = (
+    "ingress.requests", "ingress.responses",
+    "ingress.rejected_overload", "ingress.rejected_backpressure",
+    "ingress.rejected_draining",
+    "ingress.idempotent_hits", "ingress.disconnect_cancels",
+    "ingress.drains",
+    "rpc.socket_msgs", "rpc.store_msgs", "rpc.socket_connects",
+    "rpc.socket_fallbacks", "rpc.partial_drains",
 )
 
 # Kernel-registry selection series (paddle_tpu.ops.registry): one
@@ -326,6 +348,9 @@ KNOWN_GAUGES: Tuple[str, ...] = (
     # currently firing an alert, split out for the page severity
     "fleet.heartbeat_staleness_seconds",
     "slo.firing", "slo.firing_page",
+    # network ingress (PR 20): streams/requests currently being served by
+    # the HTTP front door — the number graceful drain waits on
+    "ingress.inflight",
 )
 
 KNOWN_HISTOGRAMS: Tuple[str, ...] = (
@@ -333,6 +358,9 @@ KNOWN_HISTOGRAMS: Tuple[str, ...] = (
     "serving.prefill_stall_seconds", "serving.ttft_seconds",
     "serving.queue_seconds", "serving.latency_seconds",
     "fleet.latency_seconds",
+    # network ingress (PR 20): wall time of one HTTP request end-to-end
+    # and time-to-first-streamed-chunk as the client sees them
+    "ingress.request_seconds", "ingress.ttft_seconds",
     "hapi.step",
     # judgment layer (PR 19): cost of one SLOMonitor.evaluate pass — the
     # series behind the bench's slo_eval_overhead_pct budget

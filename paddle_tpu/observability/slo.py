@@ -477,6 +477,12 @@ def default_specs() -> List[SLO]:
         SLO("serving.spec_acceptance", "gauge", threshold=0.5, op=">=",
             gauge="serving.spec_acceptance_rate",
             description="speculative-decoding draft acceptance"),
+        SLO("ingress.reject_rate", "ratio", threshold=0.05,
+            counter_bad=("ingress.rejected_overload",
+                         "ingress.rejected_backpressure",
+                         "ingress.rejected_draining"),
+            counter_total=("ingress.requests",),
+            min_count=5, description="front-door 429/503 rejections"),
         # ------------------------------------------------------ training
         SLO("train.bad_step_rate", "ratio", threshold=0.001,
             counter_bad="train_step.skipped", counter_total="train_step.steps",

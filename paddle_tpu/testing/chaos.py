@@ -44,6 +44,17 @@ Injection points:
   ('MS' or 'R:MS') exactly once as a heartbeat blackout — the child stays
   alive but stops beating for MS milliseconds (a zombie only the parent's
   stale-beat sweep can catch, since the process never exits).
+- **network faults** (ingress + socket fast path, PR 20):
+  ``socket_drop_due(rid, nsent)`` is True exactly once when
+  ``FLAGS_chaos_socket_drop_at`` ('R:K' or 'K') says the fast-path socket
+  should die right before replica R's K-th socket send — the
+  SocketChannel answers True by killing the connection, which must
+  degrade to the store transport with zero chunk loss or duplication;
+  ``ingress_disconnect_due(nchunks)`` is True exactly once per process
+  when ``FLAGS_chaos_ingress_disconnect_at`` chunks have been streamed —
+  the ingress answers True by dropping the client socket (the
+  disconnect -> mid-decode cancel path); ``net_delay_ms()`` adds
+  deterministic latency before every fast-path frame send.
 """
 from __future__ import annotations
 
@@ -228,6 +239,61 @@ def replica_slow_ms(replica_id) -> float:
     if not sep:
         return float(rid)
     return float(ms) if str(replica_id) == rid else 0.0
+
+
+def socket_drop_due(replica_id, nsent) -> bool:
+    """True — exactly once per (replica, process) — when
+    ``FLAGS_chaos_socket_drop_at`` ('R:K' for replica R, bare 'K' for any
+    replica) says the fast-path socket should die right before the K-th
+    socket send. The SocketChannel writer answers True by killing its
+    connection mid-stream — the degradation the store fallback must
+    absorb without losing or duplicating a chunk."""
+    if not enabled():
+        return False
+    spec = flag("FLAGS_chaos_socket_drop_at")
+    if not spec:
+        return False
+    rid, sep, at = spec.partition(":")
+    if sep:
+        if str(replica_id) != rid:
+            return False
+        at = int(at or 0)
+    else:
+        at = int(rid)
+    if int(nsent) < at:
+        return False
+    key = ("socket_drop", str(replica_id))
+    if key in _fired:
+        return False
+    _fired.add(key)
+    _emit_inject(kind="socket_drop", replica=replica_id, nsent=int(nsent))
+    return True
+
+
+def ingress_disconnect_due(nchunks) -> bool:
+    """True — exactly once per process — when the ingress has streamed
+    ``FLAGS_chaos_ingress_disconnect_at`` chunks to a client. The ingress
+    answers True by force-closing the client socket, which must turn into
+    a mid-decode ``cancel()`` that frees the slot."""
+    if not enabled():
+        return False
+    at = int(flag("FLAGS_chaos_ingress_disconnect_at"))
+    if at < 0 or int(nchunks) < at:
+        return False
+    key = ("ingress_disconnect",)
+    if key in _fired:
+        return False
+    _fired.add(key)
+    _emit_inject(kind="ingress_disconnect", chunks=int(nchunks))
+    return True
+
+
+def net_delay_ms() -> float:
+    """Deterministic latency (milliseconds) injected before every
+    fast-path socket frame send; 0.0 when chaos is off."""
+    if not enabled():
+        return 0.0
+    return float(flag("FLAGS_chaos_net_delay_ms"))
 
 
 def heartbeat_frozen(node_id) -> bool:

@@ -49,6 +49,14 @@ def test_serve_gpt_example():
 
 
 @pytest.mark.slow
+def test_serve_gpt_http_example():
+    out = _run("serve_gpt.py", "--http")
+    assert "idempotent retry replayed" in out and "True" in out
+    assert "final status finished" in out
+    assert "drained with exit code 0" in out
+
+
+@pytest.mark.slow
 def test_serve_gpt_fleet_example():
     out = _run("serve_gpt.py", "--fleet")
     assert "bitwise-equal to the unkilled run: True" in out

@@ -182,6 +182,63 @@ class TestRetry:
             broken()
         assert len(calls) == 1
 
+    def test_deadline_budget_bounds_wall_clock(self):
+        """deadline_s is an overall wall-clock budget per call: slow
+        attempts eat it, no further attempt fires once it is spent, and
+        the LAST exception propagates unchanged (not a new TimeoutError)."""
+        calls = []
+
+        @retry(max_attempts=50, base_delay=0.05, max_delay=1.0,
+               jitter=False, deadline_s=0.3)
+        def slow_and_down():
+            calls.append(1)
+            time.sleep(0.12)
+            raise OSError(f"down #{len(calls)}")
+
+        t0 = time.monotonic()
+        with pytest.raises(OSError) as ei:
+            slow_and_down()
+        dt = time.monotonic() - t0
+        # budget + one in-flight attempt, NOT 50 x (sleep + backoff)
+        assert dt < 1.5, f"deadline_s=0.3 took {dt:.2f}s"
+        assert 2 <= len(calls) <= 5
+        # last exception unchanged: message names the final attempt
+        assert str(ei.value) == f"down #{len(calls)}"
+
+    def test_deadline_clamps_final_backoff(self):
+        """The backoff sleep before the last attempt is clamped to the
+        remaining budget, so the final retry fires just before the
+        deadline instead of overshooting it."""
+        calls = []
+
+        @retry(max_attempts=10, base_delay=5.0, max_delay=5.0,
+               jitter=False, deadline_s=0.2)
+        def always_down():
+            calls.append(time.monotonic())
+            raise OSError("down")
+
+        t0 = time.monotonic()
+        with pytest.raises(OSError, match="down"):
+            always_down()
+        dt = time.monotonic() - t0
+        # without the clamp the first backoff alone would sleep 5s
+        assert dt < 1.0, f"backoff not clamped to budget: {dt:.2f}s"
+        assert len(calls) == 2  # first attempt + one clamped retry
+
+    def test_retrying_store_deadline_budget(self):
+        """RetryingStore forwards deadline_s to every wrapped op."""
+        store = _master_store()
+        try:
+            rs = RetryingStore(store, max_attempts=50, base_delay=0.05,
+                               deadline_s=0.25)
+            with chaos.inject(FLAGS_chaos_store_drop_ops="add"):
+                t0 = time.monotonic()
+                with pytest.raises(OSError, match="chaos"):
+                    rs.add("ctr", 1)
+                assert time.monotonic() - t0 < 2.0
+        finally:
+            store.close()
+
     def test_retrying_store_heals_injected_drops(self):
         store = _master_store()
         try:
